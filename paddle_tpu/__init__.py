@@ -1,0 +1,99 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's capabilities.
+
+Built on JAX/XLA/PJRT (compute), GSPMD (parallelism), Pallas (custom kernels).
+See SURVEY.md for the reference blueprint this implements.
+"""
+from __future__ import annotations
+
+import importlib
+
+# core types
+from .core.tensor import Tensor, Parameter
+from .core.dtype import (
+    bool_, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128, float8_e4m3fn, float8_e5m2,
+    set_default_dtype, get_default_dtype,
+)
+from .core.device import (
+    set_device, get_device, device_count, is_compiled_with_cuda,
+    is_compiled_with_xpu, is_compiled_with_cinn, Place,
+)
+from .core.flags import set_flags, get_flags
+from .core.rng import seed, get_rng_state, set_rng_state, Generator
+from .core import enforce
+
+# ops (flat namespace like paddle.*)
+from .ops import *  # noqa: F401,F403
+from .ops import creation as _creation
+
+# autograd
+from .autograd import no_grad, enable_grad, set_grad_enabled, is_grad_enabled, grad
+from . import autograd
+
+from .version import __version__
+
+bool = bool_  # paddle.bool
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def tensor(data, dtype=None, place=None, stop_gradient=True):
+    return _creation.to_tensor(data, dtype, place, stop_gradient)
+
+
+def in_dynamic_mode():
+    from .core.dispatch import _state
+    return _state.trace_ctx is None
+
+
+def in_dynamic_or_pir_mode():
+    return True
+
+
+def enable_static():  # static mode is to_static-based; kept for API compat
+    pass
+
+
+def disable_static():
+    pass
+
+
+def disable_signal_handler():
+    pass
+
+
+# Subpackages load lazily (PEP 562) so `import paddle_tpu` stays light and the
+# core never depends on higher layers.
+_LAZY = {
+    "nn", "optimizer", "amp", "io", "jit", "distributed", "static", "framework",
+    "device", "profiler", "metric", "vision", "incubate", "sparse",
+    "distribution", "hapi", "utils", "models", "parallel", "text", "audio",
+    "quantization", "onnx", "inference", "geometric", "signal", "fft", "linalg_ns",
+}
+
+_LAZY_ATTRS = {
+    "save": ("paddle_tpu.framework.io", "save"),
+    "load": ("paddle_tpu.framework.io", "load"),
+    "DataParallel": ("paddle_tpu.distributed.parallel", "DataParallel"),
+    "Model": ("paddle_tpu.hapi.model", "Model"),
+    "summary": ("paddle_tpu.hapi.model", "summary"),
+    "flops": ("paddle_tpu.hapi.model", "flops"),
+    "linalg": ("paddle_tpu.ops", "linalg"),
+    "CPUPlace": ("paddle_tpu.core.device", "Place"),
+    "get_default_generator": ("paddle_tpu.core.rng", "default_generator"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        mod = importlib.import_module(f"paddle_tpu.{name}")
+        globals()[name] = mod
+        return mod
+    if name in _LAZY_ATTRS:
+        modname, attr = _LAZY_ATTRS[name]
+        val = getattr(importlib.import_module(modname), attr)
+        globals()[name] = val
+        return val
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

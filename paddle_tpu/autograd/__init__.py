@@ -1,0 +1,154 @@
+"""Autograd public API (reference: python/paddle/autograd/__init__.py)."""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import _state, set_grad_enabled as _set, grad_enabled
+from ..core.tensor import Tensor
+from .backward import backward, grad
+from .node import GradNode
+
+
+class no_grad:
+    """Context manager & decorator disabling tape recording (paddle.no_grad)."""
+
+    def __enter__(self):
+        self._prev = _set(False)
+        return self
+
+    def __exit__(self, *exc):
+        _set(self._prev)
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = _set(True)
+        return self
+
+    def __exit__(self, *exc):
+        _set(self._prev)
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with enable_grad():
+                return fn(*args, **kwargs)
+        return wrapper
+
+
+class set_grad_enabled:
+    def __init__(self, mode: bool):
+        self._mode = mode
+        self._prev = _set(mode)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        _set(self._prev)
+        return False
+
+
+def is_grad_enabled() -> bool:
+    return grad_enabled()
+
+
+# ---- PyLayer -----------------------------------------------------------------
+class PyLayerContext:
+    """ctx passed to PyLayer.forward/backward (python/paddle/autograd/py_layer.py:36)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, ns):
+        super().__init__(name, bases, ns)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """User-defined differentiable function with explicit forward/backward.
+
+    Implemented over jax.custom_vjp semantics but on the eager tape: forward runs
+    under no_grad; a synthetic GradNode calls the user's backward.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = grad_enabled() and any(not t.stop_gradient for t in tensor_inputs)
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        outs_t = (outs,) if single else tuple(outs)
+        if needs_grad:
+            def vjp_fn(cots):
+                cots_t = (cots,) if not isinstance(cots, (tuple, list)) else cots
+                with no_grad():
+                    gin = cls.backward(ctx, *[Tensor(c) for c in cots_t])
+                gin_t = (gin,) if not isinstance(gin, (tuple, list)) else tuple(gin)
+                arrays = []
+                gi = iter(gin_t)
+                for a in args:
+                    if isinstance(a, Tensor):
+                        g = next(gi, None)
+                        arrays.append(g._data if isinstance(g, Tensor) else
+                                      (jnp.zeros_like(a._data) if g is None else jnp.asarray(g)))
+                return tuple(arrays)
+            node = GradNode(cls.__name__, vjp_fn, tuple(tensor_inputs),
+                            tuple(o._data for o in outs_t))
+            wrapped = []
+            for i, o in enumerate(outs_t):
+                t = Tensor(o._data, stop_gradient=False)
+                t._grad_node = node
+                t._out_slot = i
+                wrapped.append(t)
+            node.set_outputs(wrapped)
+            return wrapped[0] if single else tuple(wrapped)
+        return outs
+
+
+class saved_tensors_hooks:
+    """API-compatible stub: JAX residuals are immutable device arrays; pack/unpack
+    hooks (used in the reference for CPU offload) map to jax remat policies instead."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook, self.unpack_hook = pack_hook, unpack_hook
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

@@ -1,0 +1,176 @@
+"""Reverse-mode engine over the GradNode tape.
+
+Analog of egr::Backward / RunBackward (fluid/eager/backward.cc:439,:105): dependency-
+counted topological sweep from the root tensors, accumulating cotangents per tensor,
+firing hooks, and writing `.grad` on leaves (and on tensors with retain_grads()).
+Runs identically on concrete arrays and under program capture.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .node import GradNode
+
+
+def _ones_like(t: Tensor):
+    return jnp.ones(t._data.shape, dtype=t._data.dtype)
+
+
+def _is_float0(x) -> bool:
+    return getattr(x, "dtype", None) == jax.dtypes.float0
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward analog."""
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    roots, root_cots = [], []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient and t._grad_node is None:
+            continue
+        roots.append(t)
+        if g is None:
+            root_cots.append(_ones_like(t))
+        else:
+            root_cots.append(g._data if isinstance(g, Tensor) else jnp.asarray(g))
+    if not roots:
+        return
+
+    # --- discover reachable subgraph & count consumer edges per node ---------
+    dep = defaultdict(int)     # producer node -> #pending consumer edges
+    seen = set()
+    stack = [t._grad_node for t in roots if t._grad_node is not None]
+    nodes = []
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        nodes.append(node)
+        for inp in node.inputs:
+            if inp is not None and inp._grad_node is not None:
+                dep[id(inp._grad_node)] += 1
+                stack.append(inp._grad_node)
+    node_by_id = {id(n): n for n in nodes}
+
+    # --- cotangent accumulators keyed by tensor identity ----------------------
+    cots: dict[int, object] = {}
+    keepalive: dict[int, Tensor] = {}
+
+    def accum_tensor(t: Tensor, cot):
+        if _is_float0(cot):
+            return
+        k = id(t)
+        if k in cots:
+            cots[k] = cots[k] + cot
+        else:
+            cots[k] = cot
+            keepalive[k] = t
+
+    for t, c in zip(roots, root_cots):
+        accum_tensor(t, c)
+
+    def finalize(t: Tensor):
+        """Apply hooks; write .grad for leaves / retain_grad tensors."""
+        cot = cots.get(id(t))
+        if cot is None:
+            return None
+        if t._hooks:
+            g = Tensor(cot, stop_gradient=True)
+            for hook in list(t._hooks):
+                out = hook(g)
+                if out is not None:
+                    g = out if isinstance(out, Tensor) else Tensor(jnp.asarray(out))
+            cot = g._data
+            cots[id(t)] = cot
+        is_leaf = t._grad_node is None
+        if (is_leaf and not t.stop_gradient) or t._retain_grad:
+            if t.grad is None:
+                t.grad = Tensor(cot, stop_gradient=True)
+            else:
+                t.grad = Tensor(t.grad._data + cot, stop_gradient=True)
+        return cot
+
+    # --- seed ready queue: nodes with no pending consumers --------------------
+    ready = [n for n in nodes if dep[id(n)] == 0]
+    processed = set()
+    while ready:
+        node = ready.pop()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"grad graph for node '{node.name}' was already freed; "
+                "pass retain_graph=True to backward() to backprop twice.")
+        # collect output cotangents (zeros for unused outputs)
+        out_cots = []
+        for i, ref in enumerate(node.out_refs):
+            t = ref() if ref is not None else None
+            cot = None
+            if t is not None:
+                cot = finalize(t)
+            if cot is None:
+                shape, dt = node.out_avals[i]
+                cot = jnp.zeros(shape, dtype=dt)
+            out_cots.append(cot)
+        arg = out_cots[0] if node.n_outs == 1 else tuple(out_cots)
+        in_cots = node.vjp_fn(arg)
+        if not retain_graph:
+            node.release()
+        for inp, cot in zip(node.inputs, in_cots):
+            if inp is None or inp.stop_gradient:
+                continue
+            accum_tensor(inp, cot)
+            prod = inp._grad_node
+            if prod is not None:
+                dep[id(prod)] -= 1
+                if dep[id(prod)] == 0:
+                    ready.append(node_by_id[id(prod)])
+    # finalize leaves that never went through a node's out_refs
+    for k, t in list(keepalive.items()):
+        if t._grad_node is None:
+            finalize(t)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
+         only_inputs=True, allow_unused=False, no_grad_vars=None):
+    """paddle.grad analog (python/paddle/autograd/__init__.py).
+
+    create_graph (double grad) is supported naturally: running backward under an
+    outer tape... not yet wired; round-1 supports first-order only and raises
+    otherwise.
+    """
+    if create_graph:
+        raise NotImplementedError("create_graph=True (double grad) lands in a later round")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+
+    # run a private sweep: temporarily mark inputs retain_grad, snapshot .grad
+    snap = [(t.grad, t._retain_grad) for t in inputs]
+    for t in inputs:
+        t.grad = None
+        t._retain_grad = True
+    try:
+        backward(list(outputs), grad_outputs, retain_graph=bool(retain_graph))
+        results = []
+        for t in inputs:
+            if t.grad is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        "one of the input tensors received no gradient; pass "
+                        "allow_unused=True to get None instead")
+                results.append(None)
+            else:
+                results.append(t.grad)
+        return results
+    finally:
+        for t, (g, r) in zip(inputs, snap):
+            t.grad, t._retain_grad = g, r

@@ -1,0 +1,35 @@
+"""Autograd tape node.
+
+Analog of GradNodeBase (fluid/eager/grad_node_info.h:197): produced by dispatch when
+an op runs with grad-requiring inputs. `vjp_fn` is the jax.vjp pullback closing over
+residuals (the saved-tensor analog — immutable, so no inplace-version checks needed).
+"""
+from __future__ import annotations
+
+import weakref
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class GradNode:
+    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "out_refs", "n_outs")
+
+    def __init__(self, name, vjp_fn, inputs, out_arrays):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        # keep only Tensor inputs' autograd linkage; raw arrays get None
+        self.inputs = tuple(i if isinstance(i, Tensor) else None for i in inputs)
+        self.out_avals = tuple((o.shape, np.dtype(o.dtype)) for o in out_arrays)
+        self.n_outs = len(out_arrays)
+        self.out_refs = ()
+
+    def set_outputs(self, tensors):
+        self.out_refs = tuple(weakref.ref(t) for t in tensors)
+
+    def release(self):
+        self.vjp_fn = None
+
+    def __repr__(self):
+        return f"GradNode({self.name}, n_outs={self.n_outs})"

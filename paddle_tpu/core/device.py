@@ -1,0 +1,112 @@
+"""Device / place management.
+
+Paddle's Place hierarchy (phi/common/place.h) collapses here to jax.Device: TPU is
+the first-class target, CPU is the test backend. `set_device`/`get_device` keep the
+Paddle string surface ("tpu", "tpu:0", "cpu").
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+class Place:
+    """Lightweight place wrapper over a jax.Device (phi/common/place.h analog)."""
+
+    __slots__ = ("device",)
+
+    def __init__(self, device: jax.Device):
+        self.device = device
+
+    @property
+    def platform(self) -> str:
+        return self.device.platform
+
+    def is_tpu_place(self) -> bool:
+        return self.device.platform in ("tpu", "axon")
+
+    def is_cpu_place(self) -> bool:
+        return self.device.platform == "cpu"
+
+    def is_gpu_place(self) -> bool:
+        return self.device.platform in ("gpu", "cuda")
+
+    def __eq__(self, other):
+        if isinstance(other, Place):
+            return self.device == other.device
+        return NotImplemented
+
+    def __hash__(self):
+        return hash(self.device)
+
+    def __repr__(self):
+        return f"Place({self.device.platform}:{self.device.id})"
+
+
+_current_device = None
+
+
+def _parse(device):
+    if device is None:
+        return None
+    if isinstance(device, jax.Device):
+        return device
+    if isinstance(device, Place):
+        return device.device
+    if isinstance(device, str):
+        name, _, idx = device.partition(":")
+        idx = int(idx) if idx else 0
+        name = {"tpu": None, "gpu": None, "xpu": None}.get(name, name) or _accel_platform()
+        devs = [d for d in jax.devices() if d.platform == name]
+        if not devs:
+            devs = jax.devices(name)
+        return devs[idx]
+    raise ValueError(f"cannot parse device spec {device!r}")
+
+
+@functools.lru_cache(None)
+def _accel_platform() -> str:
+    """Best accelerator platform available (tpu under axon tunnel shows as its own platform)."""
+    plats = {d.platform for d in jax.devices()}
+    for p in ("tpu", "axon", "gpu", "cuda"):
+        if p in plats:
+            return p
+    return "cpu"
+
+
+def set_device(device) -> Place:
+    """paddle.set_device analog (python/paddle/device/__init__.py)."""
+    global _current_device
+    _current_device = _parse(device)
+    jax.config.update("jax_default_device", _current_device)
+    return Place(_current_device)
+
+
+def get_device():
+    d = _current_device or jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def current_device() -> jax.Device:
+    return _current_device or jax.devices()[0]
+
+
+def current_place() -> Place:
+    return Place(current_device())
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    return False
+
+
+def device_count() -> int:
+    return len(jax.devices())

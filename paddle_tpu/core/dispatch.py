@@ -1,0 +1,166 @@
+"""Op dispatch — the single chokepoint every operator goes through.
+
+Reference analog: the generated `xxx_ad_func` layer (fluid/eager/auto_code_generator/
+generator/eager_gen.py) + phi kernel dispatch (phi/core/kernel_factory.h:326). Here an
+op is a pure jax function over arrays; dispatch:
+
+  1. unwraps Tensor args (via the active trace context if capturing, so concrete
+     values read inside a captured region are lifted to program inputs),
+  2. applies AMP autocast if active,
+  3. runs the fn — or `jax.vjp(fn, ...)` when any input requires grad — and
+  4. wraps outputs in Tensors, recording a GradNode on the tape.
+
+Everything works identically on concrete arrays and on tracers, which is what makes
+program capture (paddle_tpu.jit.to_static) a pure re-execution of eager code.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor
+from . import flags
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+        self.trace_ctx = None          # active program-capture context (jit/)
+        self.amp_state = None          # active autocast state (amp/)
+
+
+_state = _State()
+
+
+def grad_enabled() -> bool:
+    return _state.grad_enabled
+
+
+def set_grad_enabled(mode: bool) -> bool:
+    prev = _state.grad_enabled
+    _state.grad_enabled = mode
+    return prev
+
+
+def unwrap(x):
+    """Tensor -> underlying array (trace-aware read)."""
+    if isinstance(x, Tensor):
+        tc = _state.trace_ctx
+        if tc is not None:
+            return tc.on_read(x)
+        return x._buf
+    return x
+
+
+def _requires_grad(args) -> bool:
+    if not _state.grad_enabled:
+        return False
+    for a in args:
+        if isinstance(a, Tensor) and not a.stop_gradient:
+            return True
+    return False
+
+
+def _wrap_out(arr, stop_gradient):
+    t = Tensor(arr, stop_gradient=stop_gradient)
+    return t
+
+
+_FLOAT_KINDS = ("f", "V", "c")  # V covers bfloat16/fp8 extension dtypes
+
+
+def apply_op(name: str, fn: Callable, *inputs, out_treedef_hint=None):
+    """Run op `fn` over `inputs` (Tensors/arrays, the differentiable positions).
+
+    Returns Tensor or tuple-of-Tensors mirroring fn's output structure.
+    Attrs must be closed over inside `fn`.
+    """
+    arrays = tuple(unwrap(a) for a in inputs)
+    needs_grad = _requires_grad(inputs)
+
+    if flags.flag("check_nan_inf"):
+        out = _run_checked(name, fn, arrays, needs_grad, inputs)
+        return out
+
+    if needs_grad:
+        from ..autograd.node import GradNode
+        outs, vjp_fn = jax.vjp(fn, *arrays)
+        single = not isinstance(outs, (tuple, list))
+        outs_t = (outs,) if single else tuple(outs)
+        node = GradNode(name, vjp_fn, inputs, outs_t)
+        wrapped = []
+        for i, o in enumerate(outs_t):
+            diff = np.dtype(o.dtype).kind in _FLOAT_KINDS
+            t = _wrap_out(o, stop_gradient=not diff)
+            if diff:
+                t._grad_node = node
+                t._out_slot = i
+            wrapped.append(t)
+        node.set_outputs(wrapped)
+        return wrapped[0] if single else tuple(wrapped)
+    else:
+        outs = fn(*arrays)
+        if isinstance(outs, (tuple, list)):
+            return tuple(_wrap_out(o, True) for o in outs)
+        return _wrap_out(outs, True)
+
+
+def _run_checked(name, fn, arrays, needs_grad, inputs):
+    """FLAGS_check_nan_inf debug path (fluid/eager/nan_inf_utils.cc analog)."""
+    if needs_grad:
+        from ..autograd.node import GradNode
+        outs, vjp_fn = jax.vjp(fn, *arrays)
+    else:
+        outs, vjp_fn = fn(*arrays), None
+    single = not isinstance(outs, (tuple, list))
+    outs_t = (outs,) if single else tuple(outs)
+    for o in outs_t:
+        if np.dtype(o.dtype).kind in _FLOAT_KINDS and not isinstance(o, jax.core.Tracer):
+            bad = not bool(jnp.all(jnp.isfinite(o.astype(jnp.float32))))
+            if bad:
+                msg = f"nan/inf detected in output of op '{name}'"
+                if flags.flag("check_nan_inf_level") == 0:
+                    raise FloatingPointError(msg)
+                print(f"[check_nan_inf] {msg}")
+    wrapped = []
+    node = None
+    if needs_grad:
+        from ..autograd.node import GradNode
+        node = GradNode(name, vjp_fn, inputs, outs_t)
+    for i, o in enumerate(outs_t):
+        diff = needs_grad and np.dtype(o.dtype).kind in _FLOAT_KINDS
+        t = _wrap_out(o, stop_gradient=not diff)
+        if diff:
+            t._grad_node = node
+            t._out_slot = i
+        wrapped.append(t)
+    if node is not None:
+        node.set_outputs(wrapped)
+    return wrapped[0] if single else tuple(wrapped)
+
+
+def defop(name: str):
+    """Decorator: define an op by its array-level implementation.
+
+    @defop("tanh")
+    def tanh(x): return jnp.tanh(x)
+
+    The wrapped callable takes Tensors (or anything array-like) positionally for
+    differentiable inputs and keyword attrs, and routes through apply_op.
+    """
+    def deco(fn):
+        def op(*args, **kwargs):
+            if kwargs:
+                f = lambda *arrs: fn(*arrs, **kwargs)
+            else:
+                f = fn
+            return apply_op(name, f, *args)
+        op.__name__ = name
+        op.__qualname__ = name
+        op.raw = fn
+        return op
+    return deco

@@ -1,0 +1,106 @@
+"""Dtype system.
+
+Paddle exposes a closed dtype enum (paddle/phi/common/data_type.h); here dtypes ARE
+numpy/jax dtypes so everything interoperates with jnp for free. We keep the Paddle
+string names ("float32", "bfloat16", ...) and the `paddle.float32` style aliases.
+bfloat16 is the default compute dtype on TPU, float32 the default parameter dtype.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+float8_e4m3fn = ml_dtypes.float8_e4m3fn
+float8_e5m2 = ml_dtypes.float8_e5m2
+
+_ALIASES = {
+    "bool": bool_, "uint8": uint8, "int8": int8, "int16": int16,
+    "int32": int32, "int64": int64, "float16": float16, "bfloat16": bfloat16,
+    "float32": float32, "float64": float64, "complex64": complex64,
+    "complex128": complex128, "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+    # paddle legacy VarType names
+    "FP32": float32, "FP64": float64, "FP16": float16, "BF16": bfloat16,
+    "INT32": int32, "INT64": int64, "BOOL": bool_,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2}
+_INTEGER = {uint8, int8, int16, int32, int64}
+
+
+def convert_dtype(dtype):
+    """Normalize any dtype spec (str, np/jnp dtype, None) to a numpy dtype object.
+
+    When JAX x64 is disabled (the TPU default), int64/float64 requests narrow to
+    the native 32-bit types silently — Paddle's int64 surface, TPU-native storage.
+    """
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        d = np.dtype(_ALIASES[dtype]) if dtype in _ALIASES else np.dtype(dtype)
+    else:
+        d = np.dtype(dtype)
+    if not _x64_enabled():
+        if d == np.dtype(np.int64):
+            return np.dtype(np.int32)
+        if d == np.dtype(np.float64):
+            return np.dtype(np.float32)
+        if d == np.dtype(np.uint64):
+            return np.dtype(np.uint32)
+        if d == np.dtype(np.complex128):
+            return np.dtype(np.complex64)
+    return d
+
+
+def _x64_enabled() -> bool:
+    import jax
+    return bool(jax.config.read("jax_enable_x64"))
+
+
+def dtype_name(dtype) -> str:
+    return np.dtype(dtype).name
+
+
+def is_floating_point(dtype) -> bool:
+    d = np.dtype(dtype)
+    return any(d == np.dtype(f) for f in _FLOATING)
+
+
+def is_integer(dtype) -> bool:
+    d = np.dtype(dtype)
+    return any(d == np.dtype(i) for i in _INTEGER) or d == np.dtype(np.bool_)
+
+
+def is_complex(dtype) -> bool:
+    d = np.dtype(dtype)
+    return d in (np.dtype(np.complex64), np.dtype(np.complex128))
+
+
+# Default dtype management (paddle.set_default_dtype analog;
+# reference: python/paddle/base/framework.py get_default_dtype)
+_default_dtype = np.dtype(np.float32)
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = convert_dtype(d)
+    if not is_floating_point(d):
+        raise TypeError(f"set_default_dtype only supports floating dtypes, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype

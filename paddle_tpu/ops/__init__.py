@@ -1,0 +1,234 @@
+"""Op surface assembly + Tensor method installation.
+
+Reference analog: the monkey-patch of generated methods onto the eager Tensor type
+(python/paddle/base/dygraph/math_op_patch.py + tensor/__init__.py method lists).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply_op, unwrap
+from . import creation, math, manipulation, logic, linalg, search, random, stat
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .einsum_op import einsum  # noqa: F401
+
+
+# ---- indexing ----------------------------------------------------------------
+def _prep_index(item):
+    """Convert an indexing object: unwrap Tensors, pass-through slices/ints/None."""
+    if isinstance(item, tuple):
+        return tuple(_prep_index(i) for i in item)
+    if isinstance(item, Tensor):
+        return unwrap(item)
+    if isinstance(item, (list, np.ndarray)):
+        return jnp.asarray(np.asarray(item))
+    return item
+
+
+def _getitem(self, item):
+    idx = _prep_index(item)
+    return apply_op("getitem", lambda a: a[idx], self)
+
+
+def _setitem(self, item, value):
+    idx = _prep_index(item)
+    if isinstance(value, Tensor):
+        out = apply_op("setitem", lambda a, v: a.at[idx].set(v.astype(a.dtype)), self, value)
+    else:
+        v = jnp.asarray(np.asarray(value)) if not np.isscalar(value) else value
+        out = apply_op("setitem", lambda a: a.at[idx].set(v), self)
+    self._data = out._data
+    self._grad_node, self._out_slot = out._grad_node, out._out_slot
+    if not out.stop_gradient:
+        self.stop_gradient = False
+
+
+def _iter(self):
+    for i in range(len(self)):
+        yield self[i]
+
+
+# ---- astype ------------------------------------------------------------------
+def _astype(self, dtype):
+    return manipulation.cast(self, dtype)
+
+
+# ---- operator overloads ------------------------------------------------------
+def _coerce_scalar_op(name, fwd, rev=None):
+    def f(self, other):
+        o = other
+        return apply_op(name, fwd, self, o) if isinstance(other, Tensor) else \
+            apply_op(name, lambda a: fwd(a, _scalar(o, a)), self)
+    def fr(self, other):
+        o = other
+        return apply_op(name, lambda a: (rev or (lambda x, y: fwd(y, x)))(a, _scalar(o, a)), self)
+    return f, fr
+
+
+def _scalar(o, a):
+    if isinstance(o, (bool, int, float)):
+        return o
+    return jnp.asarray(np.asarray(o))
+
+
+_add, _radd = _coerce_scalar_op("add", jnp.add)
+_sub, _rsub = _coerce_scalar_op("subtract", jnp.subtract)
+_mul, _rmul = _coerce_scalar_op("multiply", jnp.multiply)
+_div, _rdiv = _coerce_scalar_op("divide", lambda a, b: jnp.true_divide(a, b))
+_fdiv, _rfdiv = _coerce_scalar_op("floor_divide", jnp.floor_divide)
+_mod, _rmod = _coerce_scalar_op("mod", jnp.mod)
+_pow, _rpow = _coerce_scalar_op("pow", jnp.power)
+_mat, _rmat = _coerce_scalar_op("matmul", jnp.matmul)
+
+
+def _neg(self):
+    return math.neg(self)
+
+
+def _abs(self):
+    return math.abs(self)
+
+
+def _invert(self):
+    return logic.bitwise_not(self) if self.dtype != np.dtype(bool) else logic.logical_not(self)
+
+
+def _cmp_method(jfn):
+    def f(self, other):
+        o = unwrap(other) if isinstance(other, Tensor) else other
+        return Tensor(jfn(unwrap(self), o))
+    return f
+
+
+def _inplace_from(fn):
+    def f(self, *args, **kw):
+        out = fn(self, *args, **kw)
+        self._data = out._data
+        self._grad_node, self._out_slot = out._grad_node, out._out_slot
+        if not out.stop_gradient:
+            self.stop_gradient = False
+        return self
+    return f
+
+
+_METHODS = {
+    # dunder
+    "__getitem__": _getitem, "__setitem__": _setitem, "__iter__": _iter,
+    "__add__": _add, "__radd__": _radd, "__sub__": _sub, "__rsub__": _rsub,
+    "__mul__": _mul, "__rmul__": _rmul, "__truediv__": _div, "__rtruediv__": _rdiv,
+    "__floordiv__": _fdiv, "__rfloordiv__": _rfdiv, "__mod__": _mod, "__rmod__": _rmod,
+    "__pow__": _pow, "__rpow__": _rpow, "__matmul__": _mat, "__rmatmul__": _rmat,
+    "__neg__": _neg, "__abs__": _abs, "__invert__": _invert,
+    "__eq__": _cmp_method(jnp.equal), "__ne__": _cmp_method(jnp.not_equal),
+    "__lt__": _cmp_method(jnp.less), "__le__": _cmp_method(jnp.less_equal),
+    "__gt__": _cmp_method(jnp.greater), "__ge__": _cmp_method(jnp.greater_equal),
+    "__and__": _cmp_method(jnp.logical_and), "__or__": _cmp_method(jnp.logical_or),
+    "__xor__": _cmp_method(jnp.logical_xor),
+    "astype": _astype, "cast": _astype,
+}
+
+# plain methods delegating to module-level ops (x.method(...) == ops.method(x, ...))
+_DELEGATED = [
+    # math
+    "exp", "log", "log2", "log10", "log1p", "expm1", "sqrt", "rsqrt", "abs", "sign",
+    "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh", "asinh",
+    "acosh", "atanh", "floor", "ceil", "round", "trunc", "frac", "square",
+    "reciprocal", "neg", "erf", "erfinv", "lgamma", "digamma", "sigmoid", "logit",
+    "conj", "angle", "real", "imag", "nan_to_num", "clip", "lerp", "isnan", "isinf",
+    "isfinite", "add", "subtract", "multiply", "divide", "floor_divide", "mod",
+    "remainder", "fmod", "maximum", "minimum", "fmax", "fmin", "atan2", "pow",
+    "scale", "sum", "mean", "prod", "max", "min", "amax", "amin", "logsumexp",
+    "cumsum", "cumprod", "cummax", "cummin", "logcumsumexp", "nansum", "nanmean",
+    "count_nonzero", "addmm", "outer", "kron", "trace", "diagonal", "dot", "matmul",
+    "mm", "bmm", "mv", "inner",
+    # logic
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than", "less_equal",
+    "logical_and", "logical_or", "logical_xor", "logical_not", "bitwise_and",
+    "bitwise_or", "bitwise_xor", "bitwise_not", "equal_all", "all", "any", "isclose",
+    "allclose", "where",
+    # manipulation
+    "reshape", "reshape_", "transpose", "moveaxis", "swapaxes", "t", "squeeze",
+    "unsqueeze", "split", "chunk", "unbind", "flatten", "tile", "expand",
+    "broadcast_to", "expand_as", "flip", "rot90", "roll", "gather", "gather_nd",
+    "take_along_axis", "put_along_axis", "index_select", "index_add", "index_put",
+    "scatter", "scatter_nd_add", "repeat_interleave", "unfold", "masked_fill",
+    "fill_diagonal", "unique", "unique_consecutive", "masked_select", "view",
+    "tensordot", "as_complex", "as_real", "cast",
+    # linalg
+    "norm", "dist", "cross", "cholesky", "inverse", "pinv", "solve", "matrix_power",
+    "det", "bincount", "histogram",
+    # search
+    "argmax", "argmin", "argsort", "sort", "topk", "kthvalue", "nonzero",
+    "index_sample", "bucketize",
+    # stat
+    "var", "std", "median", "nanmedian", "quantile", "nanquantile",
+    # creation
+    "tril", "triu", "diag", "clone",
+]
+
+_INPLACE = {
+    "add_": math.add, "subtract_": math.subtract, "multiply_": math.multiply,
+    "divide_": math.divide, "scale_": math.scale, "clip_": math.clip,
+    "floor_": math.floor, "ceil_": math.ceil, "round_": math.round,
+    "exp_": math.exp, "sqrt_": math.sqrt, "rsqrt_": math.rsqrt,
+    "reciprocal_": math.reciprocal, "tanh_": math.tanh, "sigmoid_": math.sigmoid,
+    "abs_": math.abs, "neg_": math.neg, "pow_": math.pow, "remainder_": math.mod,
+    "lerp_": math.lerp, "squeeze_": manipulation.squeeze,
+    "unsqueeze_": manipulation.unsqueeze, "flatten_": manipulation.flatten,
+    "masked_fill_": manipulation.masked_fill, "index_put_": manipulation.index_put,
+    "fill_diagonal_": manipulation.fill_diagonal, "cast_": manipulation.cast,
+    "scatter_": manipulation.scatter, "where_": logic.where,
+}
+
+
+def _install():
+    import sys
+    mod = sys.modules[__name__]
+    for name, fn in _METHODS.items():
+        setattr(Tensor, name, fn)
+    for name in _DELEGATED:
+        fn = getattr(mod, name, None)
+        if fn is None:
+            continue
+        def make(f):
+            def m(self, *a, **k):
+                return f(self, *a, **k)
+            return m
+        setattr(Tensor, name, make(fn))
+    for name, fn in _INPLACE.items():
+        setattr(Tensor, name, _inplace_from(fn))
+    # random inplace
+    from .random import uniform_, normal_, exponential_, bernoulli_
+    Tensor.uniform_ = uniform_
+    Tensor.normal_ = normal_
+    Tensor.exponential_ = exponential_
+    Tensor.bernoulli_ = bernoulli_
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        return self
+    Tensor.fill_ = fill_
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+    Tensor.zero_ = zero_
+
+    def set_value(self, value):
+        v = value._data if isinstance(value, Tensor) else jnp.asarray(np.asarray(value))
+        self._data = v.astype(self._data.dtype).reshape(self._data.shape)
+        return self
+    Tensor.set_value = set_value
+
+
+_install()

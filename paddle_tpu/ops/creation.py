@@ -1,0 +1,184 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dtype as dtypes
+from ..core.dispatch import apply_op, unwrap
+from ..core.device import _parse
+
+
+def _norm_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s)) if not isinstance(s, (int, np.integer)) else int(s) for s in shape)
+
+
+def _dt(dtype, default=None):
+    d = dtypes.convert_dtype(dtype)
+    if d is None:
+        d = default if default is not None else dtypes.get_default_dtype()
+    return d
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    if isinstance(data, Tensor):
+        t = data.astype(dtype) if dtype is not None and np.dtype(data.dtype) != dtypes.convert_dtype(dtype) else Tensor(data._data)
+        t.stop_gradient = stop_gradient
+        return t
+    if isinstance(data, (jnp.ndarray, jax.Array)) or isinstance(data, jax.core.Tracer):
+        arr = data if dtype is None else data.astype(dtypes.convert_dtype(dtype))
+    else:
+        npd = np.asarray(data)
+        if dtype is None and npd.dtype == np.float64:
+            npd = npd.astype(dtypes.get_default_dtype())  # paddle default-dtype convention
+        elif dtype is not None:
+            npd = npd.astype(dtypes.convert_dtype(dtype))
+        dev = _parse(place) if place is not None else None
+        arr = jax.device_put(npd, dev)
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_norm_shape(shape), dtype=_dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_norm_shape(shape), dtype=_dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fill = unwrap(fill_value)
+    if dtype is None and isinstance(fill_value, (bool, int, float)):
+        if isinstance(fill_value, bool):
+            dtype = dtypes.bool_
+        elif isinstance(fill_value, int):
+            dtype = dtypes.int64
+        else:
+            dtype = dtypes.get_default_dtype()
+    return Tensor(jnp.full(_norm_shape(shape), fill, dtype=_dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype, name)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(unwrap(x), dtype=dtypes.convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(unwrap(x), dtype=dtypes.convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full_like(unwrap(x), unwrap(fill_value), dtype=dtypes.convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype, name)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        py = all(isinstance(v, (int, np.integer)) for v in (start, end, step))
+        dtype = dtypes.int64 if py else dtypes.get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=dtypes.convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                               dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                               base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(a):
+        if a.ndim == 1 and padding_value != 0:
+            d = jnp.diag(a, k=offset)
+            mask = jnp.eye(d.shape[0], d.shape[1], k=offset, dtype=bool)
+            return jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+        return jnp.diag(a, k=offset)
+    return apply_op("diag", f, x)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply_op("diagflat", lambda a: jnp.diagflat(a, k=offset), x)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(a):
+        out = jnp.zeros(a.shape + (a.shape[-1] + abs(offset),), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        if offset >= 0:
+            out = out.at[..., idx, idx + offset].set(a)
+        else:
+            out = out.at[..., idx - offset, idx].set(a)
+        nd = out.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        # move last two dims to (dim1, dim2)
+        order = list(range(nd - 2))
+        order.insert(min(d1, d2), nd - 2)
+        order.insert(max(d1, d2), nd - 1)
+        return jnp.transpose(out, order)
+    return apply_op("diag_embed", f, x)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply_op("tril", lambda a: jnp.tril(a, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply_op("triu", lambda a: jnp.triu(a, k=diagonal), x)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=dtypes.convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=dtypes.convert_dtype(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    arrs = [unwrap(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    return [Tensor(g) for g in jnp.meshgrid(*arrs, indexing="ij")]
+
+
+def clone(x, name=None):
+    return apply_op("clone", lambda a: a + jnp.zeros((), a.dtype) if a.dtype != jnp.bool_ else a.copy(), x)
+
+
+def assign(x, output=None):
+    src = unwrap(x) if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+    if output is None:
+        return Tensor(src)
+    output._data = jnp.asarray(src, output._data.dtype) if hasattr(output._data, "dtype") else src
+    return output
+
+
+def complex(real, imag, name=None):
+    return apply_op("complex", lambda r, i: jax.lax.complex(r, i), real, imag)
+
+
+def polar(abs, angle, name=None):
+    return apply_op("polar", lambda r, t: jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t)), abs, angle)

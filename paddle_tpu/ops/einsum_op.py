@@ -1,0 +1,10 @@
+"""einsum (reference: python/paddle/tensor/einsum.py) — delegates to jnp.einsum (MXU)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+
+
+def einsum(equation, *operands):
+    return apply_op("einsum", lambda *arrs: jnp.einsum(equation, *arrs), *operands)

@@ -1,0 +1,198 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py; MXU-heavy ops)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply_op, unwrap
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def f(a):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(jnp.square(a)))
+            ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+            return jnp.sqrt(jnp.sum(jnp.square(a), axis=ax, keepdims=keepdim))
+        if p == np.inf or p == float("inf"):
+            ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == -np.inf or p == float("-inf"):
+            ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if ax is None:
+            a = a.reshape(-1)
+            ax = 0
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=ax, keepdims=keepdim), 1.0 / p)
+    return apply_op("p_norm", f, x)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    def f(a):
+        return jnp.linalg.norm(a, ord=None if p == "fro" else p, axis=tuple(axis), keepdims=keepdim)
+    return apply_op("matrix_norm", f, x)
+
+
+def dist(x, y, p=2, name=None):
+    return apply_op("dist", lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p), x, y)
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply_op("cross", f, x, y)
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+    return apply_op("cholesky", f, x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+    return apply_op("cholesky_solve", f, x, y)
+
+
+def inverse(x, name=None):
+    return apply_op("inverse", jnp.linalg.inv, x)
+
+
+inv = inverse
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op("pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), x)
+
+
+def solve(x, y, name=None):
+    return apply_op("solve", jnp.linalg.solve, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply_op("triangular_solve", f, x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    a, b = unwrap(x), unwrap(y)
+    sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv)
+
+
+def qr(x, mode="reduced", name=None):
+    out = jnp.linalg.qr(unwrap(x), mode=mode)
+    if mode == "r":
+        return Tensor(out)
+    return Tensor(out[0]), Tensor(out[1])
+
+
+def svd(x, full_matrices=False, name=None):
+    u, s, vh = jnp.linalg.svd(unwrap(x), full_matrices=full_matrices)
+    return Tensor(u), Tensor(s), Tensor(jnp.swapaxes(vh, -1, -2).conj())
+
+
+def svdvals(x, name=None):
+    return Tensor(jnp.linalg.svd(unwrap(x), compute_uv=False))
+
+
+def eig(x, name=None):
+    w, v = jnp.linalg.eig(np.asarray(unwrap(x)))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    w, v = jnp.linalg.eigh(unwrap(x), UPLO=UPLO)
+    return Tensor(w), Tensor(v)
+
+
+def eigvals(x, name=None):
+    return Tensor(jnp.asarray(np.linalg.eigvals(np.asarray(unwrap(x)))))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return Tensor(jnp.linalg.eigvalsh(unwrap(x), UPLO=UPLO))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return Tensor(jnp.linalg.matrix_rank(unwrap(x), rtol=tol))
+
+
+def matrix_power(x, n, name=None):
+    return apply_op("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), x)
+
+
+def slogdet(x, name=None):
+    s, ld = jnp.linalg.slogdet(unwrap(x))
+    return Tensor(jnp.stack([s, ld]))
+
+
+def det(x, name=None):
+    return apply_op("det", jnp.linalg.det, x)
+
+
+def multi_dot(x, name=None):
+    return apply_op("multi_dot", lambda *arrs: jnp.linalg.multi_dot(arrs), *x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = unwrap(fweights) if fweights is not None else None
+    aw = unwrap(aweights) if aweights is not None else None
+    return apply_op("cov", lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0,
+                                             fweights=fw, aweights=aw), x)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), x)
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    a = np.asarray(unwrap(input))
+    rng = None if (min == 0 and max == 0) else (min, max)
+    hist, _ = np.histogram(a, bins=bins, range=rng,
+                           weights=np.asarray(unwrap(weight)) if weight is not None else None,
+                           density=density)
+    return Tensor(jnp.asarray(hist if density else hist.astype(np.int64)))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    a = unwrap(x)
+    w = unwrap(weights) if weights is not None else None
+    length = int(builtins_max(int(jnp.max(a)) + 1 if a.size else 0, minlength))
+    out = jnp.zeros((length,), jnp.int64 if w is None else w.dtype)
+    out = out.at[a].add(1 if w is None else w)
+    return Tensor(out)
+
+
+import builtins
+builtins_max = builtins.max
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m))
+        for i in range(n):
+            v = a[..., :, i]
+            v = jnp.where(jnp.arange(m) < i, 0.0, v)
+            v = v.at[..., i].set(1.0)
+            ti = t[..., i:i+1, None]
+            q = q - ti * jnp.einsum("...ij,...j,...k->...ik", q, v, v)
+        return q[..., :, :n]
+    return apply_op("householder_product", f, x, tau)

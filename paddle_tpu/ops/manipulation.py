@@ -1,0 +1,428 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dtype as dtypes
+from ..core.dispatch import apply_op, unwrap
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    out = []
+    for s in (shape if isinstance(shape, (list, tuple)) else [shape]):
+        out.append(int(unwrap(s)) if not isinstance(s, (int, np.integer)) else int(s))
+    return tuple(out)
+
+
+def cast(x, dtype):
+    dt = dtypes.convert_dtype(dtype)
+    return apply_op("cast", lambda a: a.astype(dt), x)
+
+
+def reshape(x, shape, name=None):
+    sh = _shape_arg(shape)
+    return apply_op("reshape", lambda a: jnp.reshape(a, sh), x)
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._data, x._grad_node, x._out_slot = out._data, out._grad_node, out._out_slot
+    x.stop_gradient = out.stop_gradient if not x.stop_gradient else x.stop_gradient
+    return x
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return cast(x, shape_or_dtype)
+
+
+def transpose(x, perm, name=None):
+    p = tuple(int(i) for i in perm)
+    return apply_op("transpose", lambda a: jnp.transpose(a, p), x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op("moveaxis", lambda a: jnp.moveaxis(a, source, destination), x)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_op("swapaxes", lambda a: jnp.swapaxes(a, axis0, axis1), x)
+
+transpose_ = swapaxes
+
+
+def t(x, name=None):
+    def f(a):
+        return a if a.ndim < 2 else a.T
+    return apply_op("t", f, x)
+
+
+def squeeze(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(ax % a.ndim for ax in axes)
+        axes = tuple(ax for ax in axes if a.shape[ax] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+    return apply_op("squeeze", f, x)
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [int(unwrap(a)) for a in axes]
+    def f(a):
+        out = a
+        for ax in sorted(ax if ax >= 0 else ax + out.ndim + 1 for ax in axes):
+            out = jnp.expand_dims(out, ax)
+        return out
+    return apply_op("unsqueeze", f, x)
+
+
+def concat(x, axis=0, name=None):
+    ax = int(unwrap(axis))
+    return apply_op("concat", lambda *arrs: jnp.concatenate(arrs, axis=ax), *x)
+
+
+def stack(x, axis=0, name=None):
+    return apply_op("stack", lambda *arrs: jnp.stack(arrs, axis=axis), *x)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(unwrap(axis))
+    def f(a):
+        n = a.shape[ax]
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(a, num_or_sections, axis=ax))
+        secs = [int(s) for s in num_or_sections]
+        neg = [i for i, s in enumerate(secs) if s < 0]
+        if neg:
+            secs[neg[0]] = n - builtins_sum(s for s in secs if s >= 0)
+        points = np.cumsum(secs)[:-1].tolist()
+        return tuple(jnp.split(a, points, axis=ax))
+    out = apply_op("split", f, x)
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+import builtins
+builtins_sum = builtins.sum
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(input, axis=0, name=None):
+    n = input.shape[axis]
+    outs = split(input, n, axis)
+    return [squeeze(o, axis) for o in outs]
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(a):
+        nd = a.ndim
+        if nd == 0:
+            return a.reshape(1)
+        s0 = start_axis % nd
+        s1 = stop_axis % nd
+        new_shape = a.shape[:s0] + (-1,) + a.shape[s1 + 1:]
+        return a.reshape(new_shape)
+    return apply_op("flatten", f, x)
+
+
+def tile(x, repeat_times, name=None):
+    reps = _shape_arg(repeat_times)
+    return apply_op("tile", lambda a: jnp.tile(a, reps), x)
+
+
+def expand(x, shape, name=None):
+    sh = list(_shape_arg(shape))
+    def f(a):
+        full = list(sh)
+        # -1 means keep original dim (paddle semantics)
+        offset = len(full) - a.ndim
+        for i in range(len(full)):
+            if full[i] == -1:
+                full[i] = a.shape[i - offset]
+        return jnp.broadcast_to(a, tuple(full))
+    return apply_op("expand", f, x)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_tensors(input, name=None):
+    shapes = [tuple(t.shape) for t in input]
+    target = np.broadcast_shapes(*shapes)
+    return [expand(t, list(target)) for t in input]
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply_op("flip", lambda a: jnp.flip(a, axis=tuple(axes)), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply_op("roll", lambda a: jnp.roll(a, shifts, axis=axis), x)
+
+
+def gather(x, index, axis=0, name=None):
+    idx = unwrap(index)
+    ax = int(unwrap(axis))
+    def f(a):
+        i = idx.reshape(-1) if idx.ndim > 1 else idx
+        return jnp.take(a, i, axis=ax)
+    return apply_op("gather", f, x)
+
+
+def gather_nd(x, index, name=None):
+    idx = unwrap(index)
+    def f(a):
+        ix = tuple(jnp.moveaxis(idx, -1, 0))
+        return a[ix]
+    return apply_op("gather_nd", f, x)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    idx = unwrap(indices)
+    def f(a):
+        i = idx
+        if broadcast:
+            tgt = list(np.broadcast_shapes(tuple(a.shape[:axis] + (1,) + a.shape[axis+1:]),
+                                           tuple(i.shape)))
+            tgt[axis] = i.shape[axis]
+            i = jnp.broadcast_to(i, tgt)
+        return jnp.take_along_axis(a, i, axis=axis)
+    return apply_op("take_along_axis", f, arr)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True,
+                   broadcast=True, name=None):
+    idx = unwrap(indices)
+    def f(a, v):
+        v = jnp.broadcast_to(jnp.asarray(v, a.dtype), idx.shape)
+        if reduce == "assign":
+            return jnp.put_along_axis(a, idx, v, axis=axis, inplace=False)
+        ax_idx = [jnp.broadcast_to(
+            jnp.arange(idx.shape[d]).reshape([-1 if i == d else 1 for i in range(idx.ndim)]),
+            idx.shape) for d in range(idx.ndim)]
+        ax_idx[axis] = idx
+        ix = tuple(ax_idx)
+        if reduce in ("add", "sum"):
+            return a.at[ix].add(v)
+        if reduce in ("mul", "multiply"):
+            return a.at[ix].multiply(v)
+        if reduce == "amax":
+            return a.at[ix].max(v)
+        if reduce == "amin":
+            return a.at[ix].min(v)
+        raise ValueError(f"unknown reduce {reduce}")
+    if isinstance(values, (int, float)):
+        return apply_op("put_along_axis", lambda a: f(a, values), arr)
+    return apply_op("put_along_axis", f, arr, values)
+
+
+def index_select(x, index, axis=0, name=None):
+    idx = unwrap(index)
+    return apply_op("index_select", lambda a: jnp.take(a, idx, axis=axis), x)
+
+
+def index_add(x, index, axis, value, name=None):
+    idx = unwrap(index)
+    def f(a, v):
+        sl = [slice(None)] * a.ndim
+        sl[axis] = idx
+        return a.at[tuple(sl)].add(v)
+    return apply_op("index_add", f, x, value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    ix = tuple(unwrap(i) for i in indices)
+    def f(a, v):
+        if accumulate:
+            return a.at[ix].add(v)
+        return a.at[ix].set(jnp.asarray(v, a.dtype))
+    return apply_op("index_put", f, x, value)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    idx = unwrap(index)
+    def f(a, u):
+        if overwrite:
+            return a.at[idx].set(u)
+        base = a.at[idx].set(jnp.zeros_like(u))
+        return base.at[idx].add(u)
+    return apply_op("scatter", f, x, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    idx = unwrap(index)
+    def f(a, u):
+        ix = tuple(jnp.moveaxis(idx, -1, 0))
+        return a.at[ix].add(u)
+    return apply_op("scatter_nd_add", f, x, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    idx = unwrap(index)
+    sh = _shape_arg(shape)
+    def f(u):
+        a = jnp.zeros(sh, u.dtype)
+        ix = tuple(jnp.moveaxis(idx, -1, 0))
+        return a.at[ix].add(u)
+    return apply_op("scatter_nd", f, updates)
+
+
+def slice(input, axes, starts, ends, name=None):
+    starts = [int(unwrap(s)) for s in starts]
+    ends = [int(unwrap(e)) for e in ends]
+    def f(a):
+        sl = [slice_builtin(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            n = a.shape[ax]
+            s2 = np.clip(s + n if s < 0 else s, 0, n)
+            e2 = np.clip(e + n if e < 0 else e, 0, n)
+            sl[ax] = slice_builtin(int(s2), int(e2))
+        return a[tuple(sl)]
+    return apply_op("slice", f, input)
+
+
+slice_builtin = builtins.slice
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def f(a):
+        sl = [slice_builtin(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            sl[ax] = slice_builtin(int(unwrap(s)), int(unwrap(e)), int(unwrap(st)))
+        return a[tuple(sl)]
+    return apply_op("strided_slice", f, x)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = unwrap(repeats)
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.repeat(a, r, axis=0, total_repeat_length=None if np.ndim(r) == 0 else int(np.sum(np.asarray(r))))
+        return jnp.repeat(a, r, axis=axis)
+    return apply_op("repeat_interleave", f, x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ..nn.functional.common import pad as _pad
+    return _pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def f(a):
+        size = index_num // nshards
+        shard = a // size
+        return jnp.where(shard == shard_id, a % size, ignore_value)
+    return Tensor(f(unwrap(input)))
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    arr = np.asarray(unwrap(x))
+    if axis is None:
+        arr = arr.reshape(-1)
+    keep = np.concatenate([[True], arr[1:] != arr[:-1]]) if arr.ndim == 1 else None
+    out = arr[keep]
+    res = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        res.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        counts = np.diff(np.append(idx, arr.size))
+        res.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None,
+           dtype="int64", name=None):
+    """Dynamic-shape op: eager only (host round-trip), like the reference's CPU sync."""
+    arr = np.asarray(unwrap(x))
+    out = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(out, tuple):
+        return Tensor(jnp.asarray(out))
+    res = [Tensor(jnp.asarray(o if i == 0 else o.astype(np.int64))) for i, o in enumerate(out)]
+    return tuple(res)
+
+
+def as_complex(x, name=None):
+    return apply_op("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x)
+
+
+def as_real(x, name=None):
+    return apply_op("as_real", lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x)
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(axes, (list, tuple)):
+        ax = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in axes)
+    return apply_op("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax), x, y)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply_op("atleast_1d", jnp.atleast_1d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply_op("atleast_2d", jnp.atleast_2d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply_op("atleast_3d", jnp.atleast_3d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def unfold(x, axis, size, step, name=None):
+    def f(a):
+        n = a.shape[axis]
+        num = (n - size) // step + 1
+        starts = jnp.arange(num) * step
+        idx = starts[:, None] + jnp.arange(size)[None, :]
+        out = jnp.take(a, idx.reshape(-1), axis=axis)
+        new_shape = list(a.shape)
+        new_shape[axis:axis+1] = [num, size]
+        out = out.reshape(new_shape)
+        return jnp.moveaxis(out, axis + 1, -1)
+    return apply_op("unfold", f, x)
+
+
+def masked_fill(x, mask, value, name=None):
+    m = unwrap(mask)
+    if isinstance(value, (int, float)):
+        return apply_op("masked_fill", lambda a: jnp.where(m, jnp.asarray(value, a.dtype), a), x)
+    return apply_op("masked_fill", lambda a, v: jnp.where(m, v.astype(a.dtype), a), x, value)
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    def f(a):
+        n = builtins.min(a.shape[-2], a.shape[-1])
+        i = jnp.arange(n - builtins.abs(offset) if offset else n)
+        if offset >= 0:
+            return a.at[..., i, i + offset].set(value)
+        return a.at[..., i - offset, i].set(value)
+    return apply_op("fill_diagonal", f, x)

@@ -1,0 +1,392 @@
+"""Math ops (reference: python/paddle/tensor/math.py; kernels phi/kernels/...).
+
+Each op is the jax array-level computation routed through dispatch (autograd +
+AMP + capture come for free). Paddle argument names (axis/keepdim/...) preserved.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dtype as dtypes
+from ..core.dispatch import apply_op, defop, unwrap
+
+
+# ---- elementwise unary -------------------------------------------------------
+def _unary(name, jfn):
+    def op(x, name_=None, **kw):
+        return apply_op(name, (lambda a: jfn(a, **kw)) if kw else jfn, x)
+    op.__name__ = name
+    return op
+
+
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+abs = _unary("abs", jnp.abs)
+absolute = abs
+sign = _unary("sign", jnp.sign)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)
+trunc = _unary("trunc", jnp.trunc)
+frac = _unary("frac", lambda a: a - jnp.trunc(a))
+square = _unary("square", jnp.square)
+reciprocal = _unary("reciprocal", lambda a: 1.0 / a)
+neg = _unary("neg", jnp.negative)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+i0 = _unary("i0", jax.scipy.special.i0)
+i0e = _unary("i0e", jax.scipy.special.i0e)
+i1 = _unary("i1", jax.scipy.special.i1)
+i1e = _unary("i1e", jax.scipy.special.i1e)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+logit = _unary("logit", lambda a: jnp.log(a / (1 - a)))
+conj = _unary("conj", jnp.conj)
+angle = _unary("angle", jnp.angle)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+exponent = None  # placeholder removed below
+
+
+def rsqrt_(x):  # common inplace variants are installed in __init__
+    return rsqrt(x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_op("nan_to_num",
+                    lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = unwrap(min) if min is not None else None
+    hi = unwrap(max) if max is not None else None
+    return apply_op("clip", lambda a: jnp.clip(a, lo, hi), x)
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, (int, float)):
+        return apply_op("lerp", lambda a, b: a + weight * (b - a), x, y)
+    return apply_op("lerp", lambda a, b, w: a + w * (b - a), x, y, weight)
+
+
+def isnan(x, name=None):
+    return Tensor(jnp.isnan(unwrap(x)))
+
+
+def isinf(x, name=None):
+    return Tensor(jnp.isinf(unwrap(x)))
+
+
+def isfinite(x, name=None):
+    return Tensor(jnp.isfinite(unwrap(x)))
+
+
+def isneginf(x, name=None):
+    return Tensor(jnp.isneginf(unwrap(x)))
+
+
+def isposinf(x, name=None):
+    return Tensor(jnp.isposinf(unwrap(x)))
+
+
+def isreal(x, name=None):
+    return Tensor(jnp.isreal(unwrap(x)))
+
+
+# ---- elementwise binary ------------------------------------------------------
+def _binary(name, jfn):
+    def op(x, y, name_=None):
+        return apply_op(name, jfn, x, y)
+    op.__name__ = name
+    return op
+
+
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", jnp.divide)
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+mod = _binary("mod", jnp.mod)
+remainder = mod
+floor_mod = mod
+fmod = _binary("fmod", jnp.fmod)
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+hypot = _binary("hypot", jnp.hypot)
+logaddexp = _binary("logaddexp", jnp.logaddexp)
+nextafter = _binary("nextafter", jnp.nextafter)
+copysign = _binary("copysign", jnp.copysign)
+heaviside = _binary("heaviside", jnp.heaviside)
+ldexp = _binary("ldexp", jnp.ldexp)
+gcd = _binary("gcd", jnp.gcd)
+lcm = _binary("lcm", jnp.lcm)
+inner = _binary("inner", jnp.inner)
+
+
+def pow(x, y, name=None):
+    if isinstance(y, (int, float)):
+        return apply_op("pow", lambda a: jnp.power(a, y), x)
+    return apply_op("pow", jnp.power, x, y)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = unwrap(scale), unwrap(bias)
+    def f(a):
+        out = a * jnp.asarray(s, a.dtype) + jnp.asarray(b, a.dtype) if bias_after_scale \
+            else (a + jnp.asarray(b, a.dtype)) * jnp.asarray(s, a.dtype)
+        return out
+    return apply_op("scale", f, x)
+
+
+def multiplex(inputs, index, name=None):
+    def f(idx, *ins):
+        stacked = jnp.stack(ins, axis=0)
+        return jnp.take_along_axis(stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))), axis=0)[0]
+    return apply_op("multiplex", lambda *ins: f(unwrap(index).reshape(-1), *ins), *inputs)
+
+
+# ---- reductions --------------------------------------------------------------
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        ax = np.asarray(axis._data)
+        return tuple(int(a) for a in np.atleast_1d(ax))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    dt = dtypes.convert_dtype(dtype)
+    def f(a):
+        if dt is None and np.dtype(a.dtype) in (np.dtype(np.int32), np.dtype(np.bool_)):
+            return jnp.sum(a, axis=ax, keepdims=keepdim,
+                           dtype=dtypes.convert_dtype(np.int64))
+        return jnp.sum(a, axis=ax, keepdims=keepdim, dtype=dt)
+    return apply_op("sum", f, x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op("mean", lambda a: jnp.mean(a, axis=ax, keepdims=keepdim), x)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    ax = _norm_axis(axis)
+    dt = dtypes.convert_dtype(dtype)
+    return apply_op("prod", lambda a: jnp.prod(a, axis=ax, keepdims=keepdim, dtype=dt), x)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op("max", lambda a: jnp.max(a, axis=ax, keepdims=keepdim), x)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op("min", lambda a: jnp.min(a, axis=ax, keepdims=keepdim), x)
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op("logsumexp", lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim), x)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    dt = dtypes.convert_dtype(dtype)
+    def f(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1), dtype=dt)
+        return jnp.cumsum(a, axis=int(axis), dtype=dt)
+    return apply_op("cumsum", f, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    dt = dtypes.convert_dtype(dtype)
+    return apply_op("cumprod", lambda a: jnp.cumprod(a, axis=dim, dtype=dt), x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        arr = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else int(axis)
+        vals = jax.lax.associative_scan(jnp.maximum, arr, axis=ax)
+        n = arr.shape[ax]
+        ind_shape = [1] * arr.ndim
+        ind_shape[ax] = n
+        idx = jnp.arange(n).reshape(ind_shape)
+        idx = jnp.broadcast_to(idx, arr.shape)
+        def mx(c, x_):
+            cv, ci = c
+            xv, xi = x_
+            take_x = xv >= cv
+            return jnp.where(take_x, xv, cv), jnp.where(take_x, xi, ci)
+        _, inds = jax.lax.associative_scan(lambda c, x_: mx(c, x_), (arr, idx), axis=ax)
+        return vals, inds.astype(dtypes.convert_dtype(dtype))
+    out = apply_op("cummax", f, x)
+    return out
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        arr = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else int(axis)
+        vals = jax.lax.associative_scan(jnp.minimum, arr, axis=ax)
+        n = arr.shape[ax]
+        ind_shape = [1] * arr.ndim
+        ind_shape[ax] = n
+        idx = jnp.broadcast_to(jnp.arange(n).reshape(ind_shape), arr.shape)
+        def mn(c, x_):
+            cv, ci = c
+            xv, xi = x_
+            take_x = xv <= cv
+            return jnp.where(take_x, xv, cv), jnp.where(take_x, xi, ci)
+        _, inds = jax.lax.associative_scan(mn, (arr, idx), axis=ax)
+        return vals, inds.astype(dtypes.convert_dtype(dtype))
+    return apply_op("cummin", f, x)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def f(a):
+        arr = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else int(axis)
+        return jax.lax.associative_scan(jnp.logaddexp, arr, axis=ax)
+    return apply_op("logcumsumexp", f, x)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    dt = dtypes.convert_dtype(dtype)
+    return apply_op("nansum", lambda a: jnp.nansum(a, axis=ax, keepdims=keepdim, dtype=dt), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op("nanmean", lambda a: jnp.nanmean(a, axis=ax, keepdims=keepdim), x)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return Tensor(jnp.count_nonzero(unwrap(x), axis=ax, keepdims=keepdim))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def add_n(inputs, name=None):
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    def f(*arrs):
+        out = arrs[0]
+        for a in arrs[1:]:
+            out = out + a
+        return out
+    return apply_op("add_n", f, *ins)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op("addmm", lambda i, a, b: beta * i + alpha * (a @ b), input, x, y)
+
+
+def outer(x, y, name=None):
+    return apply_op("outer", lambda a, b: jnp.outer(a, b), x, y)
+
+
+def kron(x, y, name=None):
+    return apply_op("kron", jnp.kron, x, y)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op("trace", lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op("diagonal", lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def dot(x, y, name=None):
+    def f(a, b):
+        if a.ndim == 1:
+            return jnp.dot(a, b)
+        return jnp.sum(a * b, axis=-1)
+    return apply_op("dot", f, x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+    return apply_op("matmul", f, x, y)
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return apply_op("bmm", jnp.matmul, x, y)
+
+
+def mv(x, vec, name=None):
+    return apply_op("mv", jnp.matmul, x, vec)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), x)
+
+
+def softplus_op(x, beta=1, threshold=20):
+    return apply_op("softplus", lambda a: jax.nn.softplus(a * beta) / beta, x)
+
+
+def increment(x, value=1.0, name=None):
+    x._data = unwrap(x) + jnp.asarray(value, x.dtype)
+    return x
+
+
+def all_finite(tensors):
+    arrs = [unwrap(t).astype(jnp.float32) for t in tensors]
+    ok = jnp.asarray(True)
+    for a in arrs:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(a)))
+    return Tensor(ok)

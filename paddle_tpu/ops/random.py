@@ -1,0 +1,141 @@
+"""Random ops over the stateful Generator (reference: python/paddle/tensor/random.py).
+
+Keys are split from the global generator whose state lives in a Tensor, so these ops
+are capture-safe (fresh randomness per jitted step — see core/rng.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dtype as dtypes
+from ..core.rng import next_key
+from ..core.dispatch import unwrap
+from .creation import _norm_shape
+
+
+def _dt(dtype):
+    d = dtypes.convert_dtype(dtype)
+    return d if d is not None else dtypes.get_default_dtype()
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else next_key()
+    dt = _dt(dtype)
+    out = jax.random.uniform(key, _norm_shape(shape), dtype=jnp.float32,
+                             minval=unwrap(min), maxval=unwrap(max))
+    return Tensor(out.astype(dt))
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    return standard_normal(shape, dtype)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    out = jax.random.normal(next_key(), _norm_shape(shape), dtype=jnp.float32)
+    return Tensor(out.astype(_dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m, s = unwrap(mean), unwrap(std)
+        sh = np.broadcast_shapes(np.shape(m), np.shape(s))
+        out = jax.random.normal(next_key(), sh, dtype=jnp.float32) * s + m
+        return Tensor(out)
+    out = jax.random.normal(next_key(), _norm_shape(shape), dtype=jnp.float32)
+    return Tensor((out * std + mean).astype(dtypes.get_default_dtype()))
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    key = jax.random.PRNGKey(seed) if seed else next_key()
+    out = jax.random.normal(key, _norm_shape(shape), dtype=jnp.float32) * std + mean
+    return Tensor(out.astype(_dt(dtype)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    out = jax.random.randint(next_key(), _norm_shape(shape), int(unwrap(low)), int(unwrap(high)))
+    return Tensor(out.astype(dtypes.convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    dt = dtype if dtype is not None else x.dtype
+    return randint(low, high, tuple(x.shape), dt)
+
+
+def randperm(n, dtype="int64", name=None):
+    out = jax.random.permutation(next_key(), int(n))
+    return Tensor(out.astype(dtypes.convert_dtype(dtype)))
+
+
+def shuffle(x, name=None):
+    a = unwrap(x)
+    return Tensor(jax.random.permutation(next_key(), a, axis=0))
+
+
+def bernoulli(x, name=None):
+    p = unwrap(x)
+    out = jax.random.bernoulli(next_key(), p.astype(jnp.float32))
+    return Tensor(out.astype(p.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    out = jax.random.bernoulli(next_key(), p, shape=tuple(x.shape))
+    x._data = out.astype(x._data.dtype)
+    return x
+
+
+def poisson(x, name=None):
+    lam = unwrap(x)
+    out = jax.random.poisson(next_key(), lam.astype(jnp.float32))
+    return Tensor(out.astype(lam.dtype))
+
+
+def binomial(count, prob, name=None):
+    n, p = unwrap(count), unwrap(prob)
+    out = jax.random.binomial(next_key(), n.astype(jnp.float32), p.astype(jnp.float32))
+    return Tensor(out.astype(jnp.int64))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    probs = unwrap(x)
+    logits = jnp.log(jnp.maximum(probs, 1e-30))
+    if replacement:
+        out = jax.random.categorical(next_key(), logits, axis=-1,
+                                     shape=(num_samples,) + probs.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1)
+    else:
+        g = -jnp.log(-jnp.log(jax.random.uniform(next_key(), probs.shape) + 1e-20) + 1e-20)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else next_key()
+    x._data = jax.random.uniform(key, tuple(x.shape), dtype=jnp.float32,
+                                 minval=min, maxval=max).astype(x._data.dtype)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._data = (jax.random.normal(next_key(), tuple(x.shape), dtype=jnp.float32)
+               * std + mean).astype(x._data.dtype)
+    return x
+
+
+def exponential_(x, lam=1.0, name=None):
+    u = jax.random.uniform(next_key(), tuple(x.shape), dtype=jnp.float32)
+    x._data = (-jnp.log1p(-u) / lam).astype(x._data.dtype)
+    return x
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    out = jax.random.normal(next_key(), _norm_shape(shape), dtype=jnp.float32) * std + mean
+    return Tensor(jnp.exp(out).astype(dtypes.get_default_dtype()))

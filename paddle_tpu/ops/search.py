@@ -1,0 +1,120 @@
+"""Search/sort ops (reference: python/paddle/tensor/search.py).
+
+Dynamic-output-shape ops (nonzero, masked_select) run host-side in eager and raise
+under program capture — same bucketing policy SURVEY §7 prescribes.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply_op, unwrap
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtype import convert_dtype
+    def f(a):
+        if axis is None:
+            out = jnp.argmax(a.reshape(-1))
+            return out.reshape((1,) * a.ndim) if keepdim else out
+        out = jnp.argmax(a, axis=axis, keepdims=keepdim)
+        return out
+    return Tensor(f(unwrap(x)).astype(convert_dtype(dtype)))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtype import convert_dtype
+    def f(a):
+        if axis is None:
+            out = jnp.argmin(a.reshape(-1))
+            return out.reshape((1,) * a.ndim) if keepdim else out
+        return jnp.argmin(a, axis=axis, keepdims=keepdim)
+    return Tensor(f(unwrap(x)).astype(convert_dtype(dtype)))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    a = unwrap(x)
+    out = jnp.argsort(-a if descending else a, axis=axis, stable=stable or descending)
+    return Tensor(out.astype(jnp.int64))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        out = jnp.sort(a, axis=axis, stable=stable)
+        return jnp.flip(out, axis=axis) if descending else out
+    return apply_op("sort", f, x)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    kk = int(unwrap(k))
+    def f(a):
+        ax = axis if axis is not None else a.ndim - 1
+        ax = ax % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        vals, idx = jax.lax.top_k(moved if largest else -moved, kk)
+        if not largest:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax)
+    out_v, out_i = apply_op("topk", f, x)
+    out_i.stop_gradient = True
+    return out_v, Tensor(out_i._data.astype(jnp.int64))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        sorted_v = jnp.sort(a, axis=ax)
+        sorted_i = jnp.argsort(a, axis=ax)
+        v = jnp.take(sorted_v, k - 1, axis=ax)
+        i = jnp.take(sorted_i, k - 1, axis=ax)
+        if keepdim:
+            v, i = jnp.expand_dims(v, ax), jnp.expand_dims(i, ax)
+        return v, i
+    v, i = apply_op("kthvalue", f, x)
+    i.stop_gradient = True
+    return v, Tensor(i._data.astype(jnp.int64))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    a = np.asarray(unwrap(x))
+    from scipy import stats as _stats  # available via numpy ecosystem
+    m = _stats.mode(a, axis=axis, keepdims=keepdim)
+    return Tensor(jnp.asarray(m.mode)), Tensor(jnp.asarray(m.count.astype(np.int64)))
+
+
+def nonzero(x, as_tuple=False):
+    arr = np.asarray(unwrap(x))
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.astype(np.int64)).reshape(-1)) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def masked_select(x, mask, name=None):
+    arr = np.asarray(unwrap(x))
+    m = np.asarray(unwrap(mask))
+    return Tensor(jnp.asarray(arr[np.broadcast_to(m, arr.shape)]))
+
+
+def index_sample(x, index):
+    idx = unwrap(index)
+    return apply_op("index_sample", lambda a: jnp.take_along_axis(a, idx, axis=1), x)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    seq, v = unwrap(sorted_sequence), unwrap(values)
+    side = "right" if right else "left"
+    if seq.ndim == 1:
+        out = jnp.searchsorted(seq, v, side=side)
+    else:
+        flat_seq = seq.reshape(-1, seq.shape[-1])
+        flat_v = jnp.broadcast_to(v, v.shape).reshape(-1, v.shape[-1])
+        out = jax.vmap(lambda s, q: jnp.searchsorted(s, q, side=side))(flat_seq, flat_v)
+        out = out.reshape(v.shape)
+    return Tensor(out.astype(jnp.int32 if out_int32 else jnp.int64))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)

@@ -1,0 +1,59 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply_op, unwrap
+
+
+def _ax(axis):
+    return tuple(axis) if isinstance(axis, (list, tuple)) else axis
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op("var", lambda a: jnp.var(a, axis=_ax(axis), ddof=1 if unbiased else 0,
+                                             keepdims=keepdim), x)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op("std", lambda a: jnp.std(a, axis=_ax(axis), ddof=1 if unbiased else 0,
+                                             keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def f(a):
+        if mode == "avg":
+            return jnp.median(a, axis=_ax(axis), keepdims=keepdim)
+        # mode == 'min': lower median
+        ax = axis if axis is not None else None
+        if ax is None:
+            flat = jnp.sort(a.reshape(-1))
+            return flat[(flat.shape[0] - 1) // 2]
+        srt = jnp.sort(a, axis=ax)
+        idx = (a.shape[ax] - 1) // 2
+        out = jnp.take(srt, idx, axis=ax)
+        return jnp.expand_dims(out, ax) if keepdim else out
+    return apply_op("median", f, x)
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return apply_op("nanmedian", lambda a: jnp.nanmedian(a, axis=_ax(axis), keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qq = unwrap(q)
+    def f(a):
+        out = jnp.quantile(a.astype(jnp.float32), jnp.asarray(qq, jnp.float32), axis=_ax(axis),
+                           keepdims=keepdim, method=interpolation)
+        return out
+    return apply_op("quantile", f, x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qq = unwrap(q)
+    return apply_op("nanquantile",
+                    lambda a: jnp.nanquantile(a.astype(jnp.float32), jnp.asarray(qq, jnp.float32),
+                                              axis=_ax(axis), keepdims=keepdim,
+                                              method=interpolation), x)

@@ -1,0 +1,7 @@
+__version__ = "0.1.0"
+full_version = __version__
+major, minor, patch = 0, 1, 0
+
+
+def show():
+    print(f"paddle_tpu {__version__}")

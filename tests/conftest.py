@@ -1,0 +1,9 @@
+"""Test env: CPU XLA with 8 virtual devices (SURVEY §4 — the reference simulates
+multi-node as multi-process on one host; we simulate a TPU mesh as 8 CPU devices)."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,69 @@
+"""OpTest-style golden harness (reference: test/legacy_test/op_test.py:418).
+
+check_output: run the paddle_tpu op, compare against a numpy reference.
+check_grad: analytic grads (tape backward) vs central finite differences
+(reference: get_numeric_gradient, op_test.py:148).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as pt
+
+
+def check_output(op, np_ref, inputs, atol=1e-5, rtol=1e-5, kwargs=None):
+    kwargs = kwargs or {}
+    tensors = [pt.to_tensor(i) for i in inputs]
+    out = op(*tensors, **kwargs)
+    ref = np_ref(*inputs)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    refs = ref if isinstance(ref, (list, tuple)) else [ref]
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(np.asarray(o.numpy(), np.float64),
+                                   np.asarray(r, np.float64), atol=atol, rtol=rtol)
+    return out
+
+
+def numeric_grad(op, inputs, idx, out_grad, delta=1e-3, kwargs=None):
+    """Central-difference dL/dx[idx] where L = sum(op(x) * out_grad)."""
+    kwargs = kwargs or {}
+    x = inputs[idx].astype(np.float64)
+    grad = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gflat = grad.reshape(-1)
+
+    def run(xv):
+        args = [a.copy() for a in inputs]
+        args[idx] = xv.astype(inputs[idx].dtype)
+        out = op(*[pt.to_tensor(a) for a in args], **kwargs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        ogs = out_grad if isinstance(out_grad, (list, tuple)) else [out_grad]
+        return sum(float((o.numpy().astype(np.float64) * g).sum()) for o, g in zip(outs, ogs))
+
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        hi = run(x)
+        flat[i] = orig - delta
+        lo = run(x)
+        flat[i] = orig
+        gflat[i] = (hi - lo) / (2 * delta)
+    return grad
+
+
+def check_grad(op, inputs, grad_idx=None, atol=5e-3, rtol=5e-3, delta=1e-3, kwargs=None):
+    """Compare tape gradients against finite differences for float64 inputs."""
+    kwargs = kwargs or {}
+    grad_idx = grad_idx if grad_idx is not None else range(len(inputs))
+    tensors = [pt.to_tensor(i, stop_gradient=False) for i in inputs]
+    out = op(*tensors, **kwargs)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    rng = np.random.RandomState(7)
+    out_grads = [rng.uniform(0.1, 1.0, o.shape).astype(np.float32) for o in outs]
+    pt.autograd.backward(list(outs), [pt.to_tensor(g) for g in out_grads])
+    for i in grad_idx:
+        analytic = tensors[i].grad.numpy().astype(np.float64)
+        numeric = numeric_grad(op, inputs, i, out_grads if len(outs) > 1 else out_grads[0],
+                               delta=delta, kwargs=kwargs)
+        np.testing.assert_allclose(analytic, numeric, atol=atol, rtol=rtol,
+                                   err_msg=f"grad mismatch for input {i}")

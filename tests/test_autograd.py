@@ -1,0 +1,171 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.autograd import PyLayer
+
+
+def test_simple_backward():
+    x = pt.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain_and_accumulate():
+    x = pt.to_tensor(2.0, stop_gradient=False)
+    y = x * x + x * 3  # dy/dx = 2x + 3 = 7
+    y.backward()
+    assert x.grad.item() == pytest.approx(7.0)
+    # second backward accumulates into .grad
+    z = x * 5
+    z.backward()
+    assert x.grad.item() == pytest.approx(12.0)
+
+
+def test_clear_grad():
+    x = pt.to_tensor(1.0, stop_gradient=False)
+    (x * 2).backward()
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_branching_graph():
+    x = pt.to_tensor(3.0, stop_gradient=False)
+    a = x * 2
+    b = x * 4
+    c = a + b  # dc/dx = 6
+    c.backward()
+    assert x.grad.item() == pytest.approx(6.0)
+
+
+def test_shared_intermediate():
+    x = pt.to_tensor(2.0, stop_gradient=False)
+    h = x * x       # used twice
+    y = h * 3 + h * 5  # y = 8x^2, dy/dx = 16x = 32
+    y.backward()
+    assert x.grad.item() == pytest.approx(32.0)
+
+
+def test_stop_gradient_blocks():
+    x = pt.to_tensor(1.0, stop_gradient=False)
+    y = pt.to_tensor(2.0)  # stop_gradient=True
+    z = x * y
+    z.backward()
+    assert x.grad.item() == pytest.approx(2.0)
+    assert y.grad is None
+
+
+def test_no_grad_context():
+    x = pt.to_tensor(1.0, stop_gradient=False)
+    with pt.no_grad():
+        y = x * 2
+    assert y.stop_gradient and y._grad_node is None
+
+
+def test_no_grad_decorator():
+    @pt.no_grad()
+    def f(t):
+        return t * 2
+    out = f(pt.to_tensor(1.0, stop_gradient=False))
+    assert out.stop_gradient
+
+
+def test_paddle_grad_api():
+    x = pt.to_tensor(2.0, stop_gradient=False)
+    y = x * x * x
+    (g,) = pt.grad(y, x)
+    assert g.item() == pytest.approx(12.0)
+    assert x.grad is None  # pt.grad must not pollute .grad
+
+
+def test_grad_allow_unused():
+    x = pt.to_tensor(1.0, stop_gradient=False)
+    u = pt.to_tensor(1.0, stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        pt.grad(y, [x, u])
+    y = x * 2  # rebuild: the failed sweep freed the graph (paddle semantics)
+    g = pt.grad(y, [x, u], allow_unused=True)
+    assert g[1] is None
+
+
+def test_backward_nonscalar_default_ones():
+    x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+
+
+def test_backward_with_grad_tensor():
+    x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * x
+    y.backward(pt.to_tensor([1.0, 0.5]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_retain_graph():
+    x = pt.to_tensor(2.0, stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    assert x.grad.item() == pytest.approx(8.0)
+    with pytest.raises(RuntimeError):
+        y.backward()  # graph freed now
+
+
+def test_hooks_modify_grad():
+    x = pt.to_tensor(1.0, stop_gradient=False)
+    h = x.register_hook(lambda g: g * 10)
+    (x * 2).backward()
+    assert x.grad.item() == pytest.approx(20.0)
+    h.remove()
+    x.clear_grad()
+    (x * 2).backward()
+    assert x.grad.item() == pytest.approx(2.0)
+
+
+def test_retain_grads_intermediate():
+    x = pt.to_tensor(2.0, stop_gradient=False)
+    h = x * 3
+    h.retain_grads()
+    y = h * h
+    y.backward()
+    assert h.grad.item() == pytest.approx(12.0)
+
+
+def test_multi_output_op_grad():
+    x = pt.to_tensor(np.arange(6, dtype=np.float32), stop_gradient=False)
+    a, b, c = pt.split(x, 3)
+    (a.sum() * 1 + b.sum() * 2 + c.sum() * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1, 1, 2, 2, 3, 3])
+
+
+def test_pylayer():
+    class Cube(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x * x
+
+        @staticmethod
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensor
+            return dy * 3 * x * x
+
+    x = pt.to_tensor(2.0, stop_gradient=False)
+    y = Cube.apply(x)
+    assert y.item() == pytest.approx(8.0)
+    y.backward()
+    assert x.grad.item() == pytest.approx(12.0)
+
+
+def test_matmul_grad_matches_reference():
+    rng = np.random.RandomState(0)
+    a = rng.rand(3, 4).astype(np.float32)
+    b = rng.rand(4, 2).astype(np.float32)
+    x = pt.to_tensor(a, stop_gradient=False)
+    w = pt.to_tensor(b, stop_gradient=False)
+    (x @ w).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones((3, 2)) @ b.T, rtol=1e-5)
+    np.testing.assert_allclose(w.grad.numpy(), a.T @ np.ones((3, 2)), rtol=1e-5)

@@ -1,0 +1,272 @@
+"""Op coverage tests via the OpTest-style golden harness (SURVEY §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from op_test import check_output, check_grad
+
+rng = np.random.RandomState(42)
+
+
+class TestUnaryOps:
+    x = rng.uniform(0.1, 0.9, (3, 4)).astype(np.float32)
+
+    @pytest.mark.parametrize("name,ref", [
+        ("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt), ("tanh", np.tanh),
+        ("sin", np.sin), ("cos", np.cos), ("abs", np.abs), ("square", np.square),
+        ("floor", np.floor), ("ceil", np.ceil), ("sigmoid", lambda a: 1 / (1 + np.exp(-a))),
+        ("rsqrt", lambda a: 1 / np.sqrt(a)), ("log1p", np.log1p),
+        ("reciprocal", lambda a: 1 / a), ("erf", None),
+    ])
+    def test_forward(self, name, ref):
+        if ref is None:
+            from scipy.special import erf as ref
+        check_output(getattr(pt, name), ref, [self.x])
+
+    @pytest.mark.parametrize("name", ["exp", "log", "sqrt", "tanh", "sigmoid", "square"])
+    def test_grad(self, name):
+        check_grad(getattr(pt, name), [self.x])
+
+
+class TestBinaryOps:
+    a = rng.uniform(0.5, 1.5, (3, 4)).astype(np.float32)
+    b = rng.uniform(0.5, 1.5, (3, 4)).astype(np.float32)
+
+    @pytest.mark.parametrize("name,ref", [
+        ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+        ("divide", np.divide), ("maximum", np.maximum), ("minimum", np.minimum),
+        ("pow", np.power), ("atan2", np.arctan2),
+    ])
+    def test_forward(self, name, ref):
+        check_output(getattr(pt, name), ref, [self.a, self.b])
+
+    @pytest.mark.parametrize("name", ["add", "multiply", "divide"])
+    def test_grad(self, name):
+        check_grad(getattr(pt, name), [self.a, self.b])
+
+    def test_broadcast(self):
+        a = rng.rand(3, 1, 4).astype(np.float32)
+        b = rng.rand(5, 1).astype(np.float32)
+        check_output(pt.add, np.add, [a, b])
+        check_grad(pt.add, [a, b])
+
+
+class TestReductions:
+    x = rng.rand(2, 3, 4).astype(np.float32)
+
+    def test_sum(self):
+        check_output(pt.sum, lambda a: a.sum(), [self.x])
+        check_output(pt.sum, lambda a: a.sum(1), [self.x], kwargs={"axis": 1})
+        check_output(pt.sum, lambda a: a.sum((0, 2), keepdims=True), [self.x],
+                     kwargs={"axis": [0, 2], "keepdim": True})
+
+    def test_mean_grad(self):
+        check_grad(pt.mean, [self.x], kwargs={"axis": 1})
+
+    def test_max_min(self):
+        check_output(pt.max, lambda a: a.max(2), [self.x], kwargs={"axis": 2})
+        check_output(pt.min, lambda a: a.min(), [self.x])
+
+    def test_prod_logsumexp(self):
+        check_output(pt.prod, lambda a: a.prod(1), [self.x], kwargs={"axis": 1})
+        from scipy.special import logsumexp as np_lse
+        check_output(pt.logsumexp, lambda a: np_lse(a, axis=1), [self.x], kwargs={"axis": 1})
+
+    def test_cumsum(self):
+        check_output(pt.cumsum, lambda a: a.cumsum(1), [self.x], kwargs={"axis": 1})
+
+    def test_var_std(self):
+        check_output(pt.var, lambda a: a.var(ddof=1), [self.x])
+        check_output(pt.std, lambda a: a.std(axis=1, ddof=1), [self.x], kwargs={"axis": 1})
+
+
+class TestManipulation:
+    x = rng.rand(2, 3, 4).astype(np.float32)
+
+    def test_reshape_transpose(self):
+        check_output(pt.reshape, lambda a: a.reshape(6, 4), [self.x], kwargs={"shape": [6, 4]})
+        check_output(pt.reshape, lambda a: a.reshape(2, -1), [self.x], kwargs={"shape": [2, -1]})
+        check_output(pt.transpose, lambda a: a.transpose(2, 0, 1), [self.x],
+                     kwargs={"perm": [2, 0, 1]})
+        check_grad(pt.transpose, [self.x], kwargs={"perm": [2, 0, 1]})
+
+    def test_squeeze_unsqueeze(self):
+        y = rng.rand(2, 1, 3).astype(np.float32)
+        check_output(pt.squeeze, lambda a: a.squeeze(1), [y], kwargs={"axis": 1})
+        check_output(pt.unsqueeze, lambda a: a[:, None], [self.x], kwargs={"axis": 1})
+
+    def test_concat_stack_split(self):
+        a = rng.rand(2, 3).astype(np.float32)
+        b = rng.rand(2, 3).astype(np.float32)
+        out = pt.concat([pt.to_tensor(a), pt.to_tensor(b)], axis=0)
+        np.testing.assert_allclose(out.numpy(), np.concatenate([a, b], 0))
+        out = pt.stack([pt.to_tensor(a), pt.to_tensor(b)], axis=1)
+        np.testing.assert_allclose(out.numpy(), np.stack([a, b], 1))
+        parts = pt.split(pt.to_tensor(a), [1, 2], axis=1)
+        assert [p.shape for p in parts] == [[2, 1], [2, 2]]
+
+    def test_gather_ops(self):
+        x = pt.to_tensor(self.x)
+        idx = pt.to_tensor(np.array([0, 1], np.int64))
+        assert pt.gather(x, idx, axis=2).shape == [2, 3, 2]
+        nd_idx = pt.to_tensor(np.array([[0, 1], [1, 2]], np.int64))
+        assert pt.gather_nd(x, nd_idx).shape == [2, 4]
+
+    def test_tile_expand(self):
+        a = rng.rand(1, 3).astype(np.float32)
+        check_output(pt.tile, lambda v: np.tile(v, (2, 2)), [a], kwargs={"repeat_times": [2, 2]})
+        check_output(pt.expand, lambda v: np.broadcast_to(v, (4, 3)), [a], kwargs={"shape": [4, 3]})
+
+    def test_flatten_flip_roll(self):
+        check_output(pt.flatten, lambda a: a.reshape(2, 12), [self.x],
+                     kwargs={"start_axis": 1, "stop_axis": 2})
+        check_output(pt.flip, lambda a: np.flip(a, 1), [self.x], kwargs={"axis": [1]})
+        check_output(pt.roll, lambda a: np.roll(a, 2, 1), [self.x],
+                     kwargs={"shifts": 2, "axis": 1})
+
+    def test_scatter(self):
+        x = np.zeros((4, 2), np.float32)
+        idx = np.array([1, 3], np.int64)
+        upd = np.ones((2, 2), np.float32)
+        out = pt.scatter(pt.to_tensor(x), pt.to_tensor(idx), pt.to_tensor(upd))
+        ref = x.copy(); ref[idx] = upd
+        np.testing.assert_allclose(out.numpy(), ref)
+
+    def test_take_along_put_along(self):
+        a = rng.rand(3, 4).astype(np.float32)
+        i = rng.randint(0, 4, (3, 2)).astype(np.int64)
+        out = pt.take_along_axis(pt.to_tensor(a), pt.to_tensor(i), axis=1)
+        np.testing.assert_allclose(out.numpy(), np.take_along_axis(a, i, 1))
+
+
+class TestLinalg:
+    def test_matmul_variants(self):
+        a = rng.rand(3, 4).astype(np.float32)
+        b = rng.rand(4, 5).astype(np.float32)
+        check_output(pt.matmul, np.matmul, [a, b])
+        check_grad(pt.matmul, [a, b])
+        check_output(pt.matmul, lambda x, y: x.T @ y, [a.T.copy(), b],
+                     kwargs={"transpose_x": True})
+        c = rng.rand(2, 3, 4).astype(np.float32)
+        d = rng.rand(2, 4, 5).astype(np.float32)
+        check_output(pt.bmm, np.matmul, [c, d])
+
+    def test_einsum(self):
+        a = rng.rand(3, 4).astype(np.float32)
+        b = rng.rand(4, 5).astype(np.float32)
+        out = pt.einsum("ij,jk->ik", pt.to_tensor(a), pt.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+    def test_norm(self):
+        a = rng.rand(3, 4).astype(np.float32)
+        check_output(pt.norm, lambda x: np.linalg.norm(x), [a], rtol=1e-4)
+        check_output(pt.norm, lambda x: np.linalg.norm(x, axis=1), [a],
+                     kwargs={"p": 2, "axis": 1}, rtol=1e-4)
+
+    def test_solve_inverse(self):
+        a = (rng.rand(3, 3) + 3 * np.eye(3)).astype(np.float32)
+        b = rng.rand(3, 2).astype(np.float32)
+        check_output(pt.solve, lambda x, y: np.linalg.solve(x, y), [a, b], rtol=1e-3, atol=1e-4)
+        check_output(pt.inverse, np.linalg.inv, [a], rtol=1e-3, atol=1e-4)
+
+    def test_svd_qr_cholesky(self):
+        a = rng.rand(4, 3).astype(np.float32)
+        u, s, v = pt.svd(pt.to_tensor(a))
+        np.testing.assert_allclose((u.numpy() * s.numpy()) @ v.numpy().T, a, atol=1e-4)
+        q, r = pt.qr(pt.to_tensor(a))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, atol=1e-4)
+        spd = (a.T @ a + 3 * np.eye(3)).astype(np.float32)
+        L = pt.cholesky(pt.to_tensor(spd))
+        np.testing.assert_allclose(L.numpy() @ L.numpy().T, spd, atol=1e-3)
+
+
+class TestSearchSort:
+    def test_argmax_sort_topk(self):
+        a = rng.rand(3, 5).astype(np.float32)
+        assert pt.argmax(pt.to_tensor(a)).item() == a.argmax()
+        check_output(pt.sort, lambda x: np.sort(x, 1), [a], kwargs={"axis": 1})
+        v, i = pt.topk(pt.to_tensor(a), 2, axis=1)
+        ref = np.sort(a, 1)[:, ::-1][:, :2]
+        np.testing.assert_allclose(v.numpy(), ref)
+        np.testing.assert_array_equal(
+            np.take_along_axis(a, i.numpy(), 1), ref)
+
+    def test_nonzero_masked_select_unique(self):
+        a = np.array([[0, 1], [2, 0]], np.float32)
+        nz = pt.nonzero(pt.to_tensor(a))
+        np.testing.assert_array_equal(nz.numpy(), [[0, 1], [1, 0]])
+        ms = pt.masked_select(pt.to_tensor(a), pt.to_tensor(a > 0))
+        np.testing.assert_allclose(np.sort(ms.numpy()), [1, 2])
+        u = pt.unique(pt.to_tensor(np.array([3, 1, 1, 2])))
+        np.testing.assert_array_equal(u.numpy(), [1, 2, 3])
+
+    def test_where(self):
+        c = np.array([True, False, True])
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        b = np.array([9.0, 8.0, 7.0], np.float32)
+        out = pt.where(pt.to_tensor(c), pt.to_tensor(a), pt.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), [1, 8, 3])
+
+
+class TestCreation:
+    def test_basics(self):
+        assert pt.zeros([2, 3]).shape == [2, 3]
+        assert pt.ones([2]).numpy().tolist() == [1, 1]
+        assert pt.full([2], 7).numpy().tolist() == [7, 7]
+        np.testing.assert_array_equal(pt.arange(5).numpy(), np.arange(5))
+        np.testing.assert_array_equal(pt.eye(3).numpy(), np.eye(3, dtype=np.float32))
+        a = pt.to_tensor(np.ones((2, 2), np.float32))
+        assert pt.zeros_like(a).numpy().sum() == 0
+        np.testing.assert_array_equal(pt.linspace(0, 1, 5).numpy(),
+                                      np.linspace(0, 1, 5, dtype=np.float32))
+
+    def test_tril_triu(self):
+        a = rng.rand(3, 3).astype(np.float32)
+        check_output(pt.tril, np.tril, [a])
+        check_output(pt.triu, np.triu, [a])
+
+
+class TestRandom:
+    def test_seed_reproducible(self):
+        pt.seed(123)
+        a = pt.randn([4, 4]).numpy()
+        pt.seed(123)
+        b = pt.randn([4, 4]).numpy()
+        np.testing.assert_array_equal(a, b)
+        c = pt.randn([4, 4]).numpy()
+        assert not np.array_equal(b, c)
+
+    def test_distributions(self):
+        pt.seed(0)
+        u = pt.uniform([1000], min=0.0, max=1.0).numpy()
+        assert 0 <= u.min() and u.max() <= 1 and abs(u.mean() - 0.5) < 0.05
+        n = pt.normal(0.0, 1.0, [2000]).numpy()
+        assert abs(n.mean()) < 0.1 and abs(n.std() - 1.0) < 0.1
+        r = pt.randint(0, 10, [100]).numpy()
+        assert r.min() >= 0 and r.max() < 10
+        p = pt.randperm(10).numpy()
+        np.testing.assert_array_equal(np.sort(p), np.arange(10))
+
+    def test_multinomial_bernoulli(self):
+        pt.seed(0)
+        probs = pt.to_tensor(np.array([0.0, 0.0, 1.0], np.float32))
+        s = pt.multinomial(probs, 5, replacement=True)
+        assert (s.numpy() == 2).all()
+        b = pt.bernoulli(pt.to_tensor(np.full((100,), 0.99, np.float32)))
+        assert b.numpy().mean() > 0.9
+
+
+class TestLogic:
+    def test_logical(self):
+        a = pt.to_tensor([True, False])
+        b = pt.to_tensor([True, True])
+        assert pt.logical_and(a, b).numpy().tolist() == [True, False]
+        assert pt.logical_or(a, b).numpy().tolist() == [True, True]
+        assert pt.logical_not(a).numpy().tolist() == [False, True]
+        assert pt.all(b).item() and pt.any(a).item()
+
+    def test_close(self):
+        a = pt.to_tensor([1.0, 2.0])
+        b = pt.to_tensor([1.0 + 1e-7, 2.0])
+        assert pt.allclose(a, b).item()
+        assert pt.equal_all(a, a).item()

@@ -1,0 +1,109 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def test_to_tensor_basics():
+    t = pt.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == np.float32
+    assert t.stop_gradient
+    assert t.ndim == 2
+    assert t.size == 4
+    np.testing.assert_array_equal(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_dtypes():
+    assert pt.to_tensor([1, 2]).dtype == np.int64 or pt.to_tensor([1, 2]).dtype == np.int32
+    t = pt.to_tensor([1.0], dtype="bfloat16")
+    assert t.dtype == pt.bfloat16
+    t32 = t.astype("float32")
+    assert t32.dtype == np.float32
+
+
+def test_arithmetic_overloads():
+    a = pt.to_tensor([1.0, 2.0, 3.0])
+    b = pt.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((a - b).numpy(), [-3, -3, -3])
+    np.testing.assert_allclose((a * 2).numpy(), [2, 4, 6])
+    np.testing.assert_allclose((2 * a).numpy(), [2, 4, 6])
+    np.testing.assert_allclose((a / 2).numpy(), [0.5, 1.0, 1.5])
+    np.testing.assert_allclose((2 ** a).numpy(), [2, 4, 8])
+    np.testing.assert_allclose((1 - a).numpy(), [0, -1, -2])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2, -3])
+    np.testing.assert_allclose(abs(pt.to_tensor([-1.0, 2.0])).numpy(), [1, 2])
+
+
+def test_matmul_overload():
+    a = pt.to_tensor(np.eye(3, dtype=np.float32))
+    b = pt.to_tensor(np.arange(9, dtype=np.float32).reshape(3, 3))
+    np.testing.assert_allclose((a @ b).numpy(), b.numpy())
+
+
+def test_comparisons():
+    a = pt.to_tensor([1.0, 2.0, 3.0])
+    assert (a > 1.5).numpy().tolist() == [False, True, True]
+    assert (a == 2.0).numpy().tolist() == [False, True, False]
+
+
+def test_indexing():
+    a = pt.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert a[0, 0].item() == 0.0
+    assert a[-1].shape == [4]
+    assert a[:, 1:3].shape == [3, 2]
+    assert a[pt.to_tensor([0, 2])].shape == [2, 4]
+    b = a[a > 5.0]  # boolean mask (eager host path)
+    assert b.shape == [6]
+
+
+def test_setitem():
+    a = pt.to_tensor(np.zeros((3, 3), np.float32))
+    a[1, 1] = 5.0
+    assert a[1, 1].item() == 5.0
+    a[0] = np.ones(3, np.float32)
+    np.testing.assert_allclose(a[0].numpy(), [1, 1, 1])
+
+
+def test_inplace_methods():
+    a = pt.to_tensor([1.0, 2.0])
+    a.add_(pt.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(a.numpy(), [2, 3])
+    a.scale_(scale=2.0)
+    np.testing.assert_allclose(a.numpy(), [4, 6])
+    a.zero_()
+    np.testing.assert_allclose(a.numpy(), [0, 0])
+    a.fill_(7.0)
+    np.testing.assert_allclose(a.numpy(), [7, 7])
+
+
+def test_detach_and_clone():
+    a = pt.to_tensor([1.0], stop_gradient=False)
+    b = a * 2
+    c = b.detach()
+    assert c.stop_gradient and b._grad_node is not None and c._grad_node is None
+    d = a.clone()
+    assert not d.stop_gradient
+
+
+def test_item_and_scalar():
+    t = pt.to_tensor(3.5)
+    assert t.item() == pytest.approx(3.5)
+    assert float(t) == pytest.approx(3.5)
+    assert t.ndim == 0
+
+
+def test_parameter():
+    p = pt.Parameter(np.ones((2, 2), np.float32))
+    assert not p.stop_gradient
+    assert p.trainable
+    assert p.persistable
+
+
+def test_cast_preserves_grad():
+    a = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    b = a.astype("bfloat16")
+    assert not b.stop_gradient
+    b.sum().backward()
+    assert a.grad is not None
