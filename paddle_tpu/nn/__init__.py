@@ -1,1 +1,9 @@
-"""placeholder — filled in during round 1 build."""
+"""paddle.nn surface (reference: python/paddle/nn/__init__.py)."""
+from .layer import *  # noqa: F401,F403
+from .layer.layers import Layer  # noqa: F401
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .initializer import ParamAttr  # noqa: F401
+from .clip import (ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,  # noqa: F401
+                   clip_grad_norm_, clip_grad_value_)
+from . import utils  # noqa: F401

@@ -1,0 +1,167 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+
+
+def _act(name, jfn):
+    def op(x, name_=None, **kw):
+        return apply_op(name, (lambda a: jfn(a, **kw)) if kw else jfn, x)
+    op.__name__ = name
+    return op
+
+
+relu = _act("relu", jax.nn.relu)
+relu6 = _act("relu6", jax.nn.relu6)
+relu_ = relu
+sigmoid = _act("sigmoid", jax.nn.sigmoid)
+tanh = _act("tanh", jnp.tanh)
+silu = _act("silu", jax.nn.silu)
+swish = silu
+mish = _act("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)))
+softsign = _act("softsign", jax.nn.soft_sign)
+tanhshrink = _act("tanhshrink", lambda a: a - jnp.tanh(a))
+log_sigmoid = _act("log_sigmoid", jax.nn.log_sigmoid)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op("leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op("elu", lambda a: jax.nn.elu(a, alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op("selu", lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op("celu", lambda a: jax.nn.celu(a, alpha), x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op("hardtanh", lambda a: jnp.clip(a, min, max), x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply_op("hardsigmoid", lambda a: jnp.clip(a * slope + offset, 0.0, 1.0), x)
+
+
+def hardswish(x, name=None):
+    return apply_op("hardswish", lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op("hardshrink", lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op("softshrink",
+                    lambda a: jnp.where(a > threshold, a - threshold,
+                                        jnp.where(a < -threshold, a + threshold, 0.0)), x)
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    return apply_op("softplus",
+                    lambda a: jnp.where(a * beta > threshold, a,
+                                        jax.nn.softplus(a * beta) / beta), x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtype import convert_dtype
+    dt = convert_dtype(dtype)
+    def f(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.softmax(a, axis=axis)
+    return apply_op("softmax", f, x)
+
+
+softmax_ = softmax
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtype import convert_dtype
+    dt = convert_dtype(dtype)
+    def f(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.log_softmax(a, axis=axis)
+    return apply_op("log_softmax", f, x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core.rng import next_key
+    key = next_key()
+    def f(a):
+        g = -jnp.log(-jnp.log(jax.random.uniform(key, a.shape) + 1e-20) + 1e-20)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            y = y_hard - jax.lax.stop_gradient(y) + y
+        return y
+    return apply_op("gumbel_softmax", f, x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        ch_axis = 1 if data_format == "NCHW" else a.ndim - 1
+        shape = [1] * a.ndim
+        shape[ch_axis] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+    return apply_op("prelu", f, x, weight)
+
+
+def rrelu(x, lower=0.125, upper=0.3333333, training=True, name=None):
+    from ...core.rng import next_key
+    if training:
+        key = next_key()
+        def f(a):
+            slope = jax.random.uniform(key, a.shape, jnp.float32, lower, upper).astype(a.dtype)
+            return jnp.where(a >= 0, a, slope * a)
+        return apply_op("rrelu", f, x)
+    mid = (lower + upper) / 2
+    return apply_op("rrelu", lambda a: jnp.where(a >= 0, a, mid * a), x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply_op("thresholded_relu", lambda a: jnp.where(a > threshold, a, value), x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return apply_op("maxout", f, x)
+
+
+def glu(x, axis=-1, name=None):
+    def f(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+    return apply_op("glu", f, x)
+
+
+def swiglu(x, y=None, name=None):
+    """LLM gate activation — first-class yaml op in the reference
+    (phi/kernels/swiglu_kernel.h)."""
+    if y is None:
+        def f(a):
+            a1, a2 = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(a1) * a2
+        return apply_op("swiglu", f, x)
+    return apply_op("swiglu", lambda a, b: jax.nn.silu(a) * b, x, y)

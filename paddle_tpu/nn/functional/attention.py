@@ -1,0 +1,121 @@
+"""Attention functionals.
+
+Reference: python/paddle/nn/functional/flash_attention.py:364 (CUDA flashattn
+wrapper). Here: a fused-softmax XLA path by default; the Pallas flash-attention
+kernel (paddle_tpu/ops/pallas/flash_attention.py) is used on TPU for long
+sequences, matching the reference's kernel-dispatch behavior.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op, unwrap
+from ...core.tensor import Tensor
+
+
+def _sdpa_ref(q, k, v, mask=None, causal=False, dropout_p=0.0, scale=None, key=None):
+    """[B, S, H, D] layout (paddle flash_attention convention)."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    # grouped-query attention: repeat kv heads if fewer than q heads
+    hq, hk = q.shape[2], k.shape[2]
+    if hq != hk:
+        rep = hq // hk
+        kf = jnp.repeat(kf, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("bshd,bthd->bhst", qf, kf) * s
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(cm, logits, -jnp.inf)
+    if mask is not None:
+        m = mask.astype(jnp.float32) if mask.dtype != jnp.bool_ else None
+        if m is None:
+            logits = jnp.where(mask, logits, -jnp.inf)
+        else:
+            logits = logits + m
+    p = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0 and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhst,bthd->bshd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """paddle.nn.functional.scaled_dot_product_attention ([B, S, H, D])."""
+    m = unwrap(attn_mask) if attn_mask is not None else None
+    rng_key = None
+    if dropout_p > 0.0 and training:
+        from ...core.rng import next_key
+        rng_key = next_key()
+    def f(q, k, v):
+        return _sdpa_ref(q, k, v, mask=m, causal=is_causal,
+                         dropout_p=dropout_p if training else 0.0, key=rng_key)
+    return apply_op("scaled_dot_product_attention", f, query, key, value)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
+                    fixed_seed_offset=None, rng_name="", training=True, name=None):
+    """Flash attention ([B, S, H, D]); dispatches to the Pallas TPU kernel when
+    available, else the fused XLA path (same numerics, f32 accumulation)."""
+    m = None
+    rng_key = None
+    if dropout > 0.0 and training:
+        from ...core.rng import next_key
+        rng_key = next_key()
+    def f(q, k, v):
+        if rng_key is None and _use_pallas(q):
+            from ...ops.pallas.flash_attention import flash_attention_bshd
+            return flash_attention_bshd(q, k, v, causal=causal)
+        return _sdpa_ref(q, k, v, mask=m, causal=causal,
+                         dropout_p=dropout if training else 0.0, key=rng_key)
+    out = apply_op("flash_attention", f, query, key, value)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def _pallas_kernel_available() -> bool:
+    try:
+        from ...ops.pallas import flash_attention  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def _use_pallas(q) -> bool:
+    import jax
+    if not _pallas_kernel_available():
+        return False
+    try:
+        platform = q.devices().pop().platform if hasattr(q, "devices") else \
+            jax.default_backend()
+    except Exception:
+        platform = jax.default_backend()
+    if platform not in ("tpu", "axon"):
+        return False
+    # MXU-friendly shapes only; fall back otherwise
+    return q.shape[-1] % 128 == 0 and q.shape[1] % 128 == 0
+
+
+def flash_attn_unpadded(*args, **kwargs):
+    raise NotImplementedError(
+        "varlen flash attention: use dense [B,S,H,D] flash_attention with masking; "
+        "ragged support lands with the paged-attention kernel")
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    lengths = unwrap(x)
+    ml = int(maxlen) if maxlen is not None else int(jnp.max(lengths))
+    from ...core.dtype import convert_dtype
+    row = jnp.arange(ml)
+    mask = row[None, :] < lengths[..., None]
+    return Tensor(mask.astype(convert_dtype(dtype)))

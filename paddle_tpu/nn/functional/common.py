@@ -1,0 +1,268 @@
+"""Common functionals: linear, dropout, embedding, pad, one_hot, interpolate
+(reference: python/paddle/nn/functional/common.py, input.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...core.dispatch import apply_op, unwrap
+from ...core.rng import next_key
+from ...core import dtype as dtypes
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b; W is [in, out] (paddle convention)."""
+    if bias is None:
+        return apply_op("linear", lambda a, w: jnp.matmul(a, w), x, weight)
+    return apply_op("linear", lambda a, w, b: jnp.matmul(a, w) + b, x, weight, bias)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        if p != 0.0 and mode == "downscale_in_infer":
+            # downscale_in_infer contract: train masks unscaled, infer scales by (1-p)
+            return apply_op("dropout", lambda a: a * jnp.asarray(1.0 - p, a.dtype), x)
+        return x if isinstance(x, Tensor) else Tensor(unwrap(x))
+    key = next_key()
+    def f(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in [ax % a.ndim for ax in axes] else 1 for i, s in enumerate(a.shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), jnp.zeros((), a.dtype))
+        return jnp.where(keep, a, jnp.zeros((), a.dtype))
+    return apply_op("dropout", f, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    def f(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        A = (1.0 / np.sqrt((1 - p) * (1 + p * alpha_p ** 2)))
+        B = -A * p * alpha_p
+        return A * jnp.where(keep, a, jnp.asarray(alpha_p, a.dtype)) + B
+    return apply_op("alpha_dropout", f, x)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    idx = unwrap(x)
+    def f(w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+        return out
+    return apply_op("embedding", f, weight)
+
+
+def one_hot(x, num_classes, name=None):
+    return Tensor(jax.nn.one_hot(unwrap(x), num_classes, dtype=jnp.float32))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    pad_list = [int(unwrap(p)) for p in (pad if isinstance(pad, (list, tuple))
+                                         else np.asarray(unwrap(pad)).tolist())]
+    def f(a):
+        nd = a.ndim
+        if len(pad_list) == 2 * nd:
+            widths = [(pad_list[2 * i], pad_list[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle NCHW convention: pad applies to last k spatial dims,
+            # ordered [left, right, top, bottom, front, back] (innermost first)
+            k = len(pad_list) // 2
+            widths = [(0, 0)] * nd
+            if data_format.endswith("C") and nd > 2:  # NHWC/NDHWC: spatial dims are 1..nd-2
+                spatial = list(range(1, nd - 1))
+            else:
+                spatial = list(range(nd - k, nd))
+            for i in range(k):
+                widths[spatial[-(i + 1)]] = (pad_list[2 * i], pad_list[2 * i + 1])
+        if mode == "constant":
+            return jnp.pad(a, widths, constant_values=np.asarray(value, a.dtype))
+        jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        return jnp.pad(a, widths, mode=jmode)
+    return apply_op("pad", f, x)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def f(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        d1 = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        d2 = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return num / jnp.maximum(d1 * d2, eps)
+    return apply_op("cosine_similarity", f, x1, x2)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *bb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bb:
+            out = out + bb[0]
+        return out
+    args = (x1, x2, weight) + ((bias,) if bias is not None else ())
+    return apply_op("bilinear", f, *args)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    pd = unwrap(prior_dist) if prior_dist is not None else None
+    def f(l):
+        k = l.shape[-1]
+        uniform = pd if pd is not None else 1.0 / k
+        return (1 - epsilon) * l + epsilon * uniform
+    return apply_op("label_smooth", f, label)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    mode = mode.lower()
+    def f(a):
+        chan_last = data_format in ("NHWC", "NDHWC", "NWC")
+        spatial_idx = list(range(1, a.ndim - 1)) if chan_last else list(range(2, a.ndim))
+        in_spatial = [a.shape[i] for i in spatial_idx]
+        if size is not None:
+            out_spatial = [int(unwrap(s)) for s in (size if isinstance(size, (list, tuple))
+                                                    else np.asarray(unwrap(size)).tolist())]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(in_spatial)
+            out_spatial = [int(s * float(fs)) for s, fs in zip(in_spatial, sf)]
+        new_shape = list(a.shape)
+        for i, s in zip(spatial_idx, out_spatial):
+            new_shape[i] = s
+        jmode = {"nearest": "nearest", "bilinear": "linear", "trilinear": "linear",
+                 "linear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+        if mode == "nearest":
+            return jax.image.resize(a, new_shape, method="nearest")
+        if align_corners and jmode == "linear":
+            # jax.image.resize uses half-pixel centers; emulate align_corners with explicit gather
+            out = a
+            for ax, (n_in, n_out) in zip(spatial_idx, zip(in_spatial, out_spatial)):
+                if n_out == 1:
+                    idx = jnp.zeros((1,), jnp.float32)
+                else:
+                    idx = jnp.linspace(0.0, n_in - 1, n_out)
+                lo = jnp.floor(idx).astype(jnp.int32)
+                hi = jnp.minimum(lo + 1, n_in - 1)
+                w = (idx - lo).astype(a.dtype)
+                shape = [1] * out.ndim
+                shape[ax] = n_out
+                w = w.reshape(shape)
+                out = jnp.take(out, lo, axis=ax) * (1 - w) + jnp.take(out, hi, axis=ax) * w
+            return out
+        return jax.image.resize(a, new_shape, method=jmode)
+    return apply_op("interpolate", f, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: phi/kernels/funcs/im2col) — NCHW input -> [N, C*kh*kw, L]."""
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    def f(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, [(0, 0), (0, 0), (pd[0], pd[2]), (pd[1], pd[3])])
+        oh = (a.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (a.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                sl = a[:, :, i * dl[0]: i * dl[0] + oh * st[0]: st[0],
+                       j * dl[1]: j * dl[1] + ow * st[1]: st[1]]
+                patches.append(sl)
+        out = jnp.stack(patches, axis=2)  # [N, C, kh*kw, oh, ow]
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+    return apply_op("unfold", f, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    os_ = output_sizes if isinstance(output_sizes, (list, tuple)) else [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    def f(a):
+        n = a.shape[0]
+        c = a.shape[1] // (ks[0] * ks[1])
+        ph, pw = os_[0] + pd[0] + pd[2], os_[1] + pd[1] + pd[3]
+        oh = (ph - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (pw - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        a2 = a.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, ph, pw), a.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                out = out.at[:, :, i * dl[0]: i * dl[0] + oh * st[0]: st[0],
+                             j * dl[1]: j * dl[1] + ow * st[1]: st[1]].add(a2[:, :, i, j])
+        return out[:, :, pd[0]: ph - pd[2], pd[1]: pw - pd[3]]
+    return apply_op("fold", f, x)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            out = a.reshape(n, c // (r * r), r, r, h, w)
+            out = out.transpose(0, 1, 4, 2, 5, 3)
+            return out.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        out = a.reshape(n, h, w, r, r, c // (r * r))
+        out = out.transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(n, h * r, w * r, c // (r * r))
+    return apply_op("pixel_shuffle", f, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            out = a.reshape(n, c, h // r, r, w // r, r)
+            out = out.transpose(0, 1, 3, 5, 2, 4)
+            return out.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        out = a.reshape(n, h // r, r, w // r, r, c)
+        out = out.transpose(0, 2, 4, 1, 3, 5).reshape(n, h // r, w // r, c * r * r)
+        return out
+    return apply_op("pixel_unshuffle", f, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            return a.reshape(n, groups, c // groups, h, w).swapaxes(1, 2).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        return a.reshape(n, h, w, groups, c // groups).swapaxes(3, 4).reshape(n, h, w, c)
+    return apply_op("channel_shuffle", f, x)

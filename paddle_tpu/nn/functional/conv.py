@@ -1,0 +1,150 @@
+"""Convolution functionals via lax.conv_general_dilated (XLA conv → MXU).
+
+Reference: python/paddle/nn/functional/conv.py; kernels phi/kernels/gpudnn/conv_*.
+Paddle weight layout: [out_ch, in_ch/groups, *kernel_spatial] (OIHW-style).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op, unwrap
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        return tuple(int(x) for x in v)
+    return tuple(int(v) for _ in range(n))
+
+
+def _padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        if isinstance(padding[0], (list, tuple)):
+            # could be per-dim pairs
+            return [tuple(p) for p in padding]
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _dn(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format, name):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    st = _tuple(stride, n)
+    dl = _tuple(dilation, n)
+    pad = _padding(padding, n)
+    lhs_spec, rhs_spec, out_spec = _dn(n, channel_last)
+    def f(a, w, *b):
+        # paddle weight is OI<spatial>; convert to rhs_spec
+        if channel_last:
+            w = jnp.moveaxis(w, (0, 1), (-1, -2))  # OIHW -> HWIO
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=st, padding=pad,
+            lhs_dilation=None, rhs_dilation=dl,
+            dimension_numbers=(lhs_spec, rhs_spec, out_spec),
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32 if a.dtype == jnp.bfloat16 else None)
+        out = out.astype(a.dtype)
+        if b:
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = b[0].shape[0]
+            out = out + b[0].reshape(shape).astype(out.dtype)
+        return out
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply_op(name, f, *args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, df, "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format, "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups,
+                    n, data_format, output_size, name):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    st = _tuple(stride, n)
+    dl = _tuple(dilation, n)
+    opad = _tuple(output_padding, n) if output_padding is not None else (0,) * n
+    pad = _padding(padding, n)
+    lhs_spec, rhs_spec, out_spec = _dn(n, channel_last)
+    def f(a, w, *b):
+        # paddle transpose-conv weight: [in_ch, out_ch/groups, *spatial]
+        if isinstance(pad, str):
+            pads = pad
+        else:
+            # convert forward-conv padding to transposed padding:
+            # pt = dilation*(k-1) - p
+            ks = w.shape[2:]
+            pads = [(dl[i] * (ks[i] - 1) - pad[i][0],
+                     dl[i] * (ks[i] - 1) - pad[i][1] + opad[i]) for i in range(n)]
+        # grouped transposed conv: split IO<sp> weight into groups on axis 0
+        wt = jnp.swapaxes(w, 0, 1)  # -> [out/g, in, *sp]
+        wt = jnp.flip(wt, axis=tuple(range(2, 2 + n)))
+        if groups > 1:
+            # rearrange to feature_group layout: [out, in/g, *sp]
+            wgs = jnp.split(w, groups, axis=0)  # each [in/g, out/g, sp]
+            wt = jnp.concatenate([jnp.flip(jnp.swapaxes(g, 0, 1), axis=tuple(range(2, 2 + n)))
+                                  for g in wgs], axis=0)
+        if channel_last:
+            wt = jnp.moveaxis(wt, (0, 1), (-1, -2))
+        out = jax.lax.conv_general_dilated(
+            a, wt, window_strides=(1,) * n, padding=pads,
+            lhs_dilation=st, rhs_dilation=dl,
+            dimension_numbers=(lhs_spec, rhs_spec, out_spec),
+            feature_group_count=groups)
+        out = out.astype(a.dtype)
+        if b:
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = b[0].shape[0]
+            out = out + b[0].reshape(shape).astype(out.dtype)
+        return out
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply_op(name, f, *args)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 1, df, output_size, "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 2, data_format, output_size, "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 3, data_format, output_size, "conv3d_transpose")

@@ -1,0 +1,217 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py).
+
+cross_entropy computes log-softmax in float32 (the reference's
+softmax_with_cross_entropy kernel contract) — critical for bf16 training.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op, unwrap
+from ...core.tensor import Tensor
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    lbl = unwrap(label)
+    w = unwrap(weight) if weight is not None else None
+    def f(logits):
+        lg = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(lg, axis=axis) if use_softmax else jnp.log(jnp.maximum(lg, 1e-30))
+        n_cls = logits.shape[axis]
+        if soft_label:
+            soft = lbl.astype(jnp.float32)
+            if label_smoothing > 0.0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_cls
+            loss = -jnp.sum(soft * logp, axis=axis)
+        else:
+            li = lbl
+            if li.ndim == logp.ndim:
+                li = jnp.squeeze(li, axis=axis)
+            li_ = jnp.where(li == ignore_index, 0, li).astype(jnp.int32)
+            picked = jnp.take_along_axis(logp, li_[..., None] if axis in (-1, logp.ndim - 1)
+                                         else jnp.expand_dims(li_, axis), axis=axis)
+            picked = jnp.squeeze(picked, axis=axis)
+            if label_smoothing > 0.0:
+                smooth = jnp.mean(logp, axis=axis)
+                loss = -((1 - label_smoothing) * picked + label_smoothing * smooth)
+            else:
+                loss = -picked
+            mask = (li != ignore_index)
+            loss = jnp.where(mask, loss, 0.0)
+            if w is not None:
+                loss = loss * jnp.take(w.astype(jnp.float32), li_)
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(jnp.where(mask, 1.0, 0.0)
+                                            if w is None else
+                                            jnp.where(mask, jnp.take(w.astype(jnp.float32), li_), 0.0)),
+                                    1e-12)
+                return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+    return apply_op("cross_entropy", f, input)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index,
+                         reduction="none", axis=axis)
+    from .activation import softmax as _softmax
+    loss = loss.unsqueeze(axis)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    return _nll(input, label, weight, ignore_index, reduction)
+
+
+def _nll(input, label, weight, ignore_index, reduction):
+    lbl = unwrap(label)
+    w = unwrap(weight) if weight is not None else None
+    def f(logp):
+        lg = logp.astype(jnp.float32)
+        li = jnp.where(lbl == ignore_index, 0, lbl).astype(jnp.int32)
+        picked = jnp.take_along_axis(lg, li[..., None], axis=-1) if lg.ndim == li.ndim + 1 \
+            else jnp.take_along_axis(lg, jnp.expand_dims(li, 1), axis=1)
+        picked = jnp.squeeze(picked, axis=-1 if lg.ndim == li.ndim + 1 else 1)
+        loss = -picked
+        mask = lbl != ignore_index
+        loss = jnp.where(mask, loss, 0.0)
+        if w is not None:
+            wv = jnp.take(w.astype(jnp.float32), li)
+            loss = loss * wv
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(jnp.where(mask, wv, 0.0)), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1e-12)
+        return _reduce(loss, reduction)
+    return apply_op("nll_loss", f, input)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_op("mse_loss", lambda a, b: _reduce(jnp.square(a - b), reduction),
+                    input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_op("l1_loss", lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                    input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss, reduction)
+    return apply_op("smooth_l1_loss", f, input, label)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, t, *w):
+        pf = jnp.clip(p.astype(jnp.float32), 1e-12, 1 - 1e-7)
+        loss = -(t * jnp.log(pf) + (1 - t) * jnp.log1p(-pf))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply_op("binary_cross_entropy", f, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    pw = unwrap(pos_weight) if pos_weight is not None else None
+    def f(z, t, *w):
+        zf = z.astype(jnp.float32)
+        tf_ = t.astype(jnp.float32)
+        if pw is not None:
+            logw = 1.0 + (pw - 1.0) * tf_
+            loss = (1 - tf_) * zf + logw * (jax.nn.softplus(-jnp.abs(zf))
+                                            + jnp.maximum(-zf, 0.0))
+        else:
+            loss = jnp.maximum(zf, 0) - zf * tf_ + jax.nn.softplus(-jnp.abs(zf))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = (logit, label) + ((weight,) if weight is not None else ())
+    return apply_op("bce_with_logits", f, *args)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(logp, t):
+        tf_ = t.astype(jnp.float32)
+        lp = logp.astype(jnp.float32)
+        if log_target:
+            loss = jnp.exp(tf_) * (tf_ - lp)
+        else:
+            loss = jnp.where(tf_ > 0, tf_ * (jnp.log(jnp.maximum(tf_, 1e-30)) - lp), 0.0)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return apply_op("kl_div", f, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def f(a, b, t):
+        loss = jnp.maximum(-t * (a - b) + margin, 0.0)
+        return _reduce(loss, reduction)
+    return apply_op("margin_ranking_loss", f, input, other, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def f(a, t):
+        loss = jnp.where(t == 1, a, jnp.maximum(margin - a, 0.0))
+        return _reduce(loss, reduction)
+    return apply_op("hinge_embedding_loss", f, input, label)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def f(a, b, t):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(t == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce(loss, reduction)
+    return apply_op("cosine_embedding_loss", f, input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.power(jnp.sum(jnp.power(jnp.abs(a - pos) + epsilon, p), -1), 1 / p)
+        dn = jnp.power(jnp.sum(jnp.power(jnp.abs(a - neg) + epsilon, p), -1), 1 / p)
+        if swap:
+            dn2 = jnp.power(jnp.sum(jnp.power(jnp.abs(pos - neg) + epsilon, p), -1), 1 / p)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+    return apply_op("triplet_margin_loss", f, input, positive, negative)
+
+
+def square_error_cost(input, label):
+    return apply_op("square_error_cost", lambda a, b: jnp.square(a - b), input, label)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    nz = unwrap(normalizer) if normalizer is not None else None
+    def f(z, t):
+        zf, tf_ = z.astype(jnp.float32), t.astype(jnp.float32)
+        p = jax.nn.sigmoid(zf)
+        ce = jnp.maximum(zf, 0) - zf * tf_ + jax.nn.softplus(-jnp.abs(zf))
+        p_t = p * tf_ + (1 - p) * (1 - tf_)
+        a_t = alpha * tf_ + (1 - alpha) * (1 - tf_)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if nz is not None:
+            loss = loss / nz
+        return _reduce(loss, reduction)
+    return apply_op("sigmoid_focal_loss", f, logit, label)

@@ -1,0 +1,168 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py;
+rms_norm is a first-class yaml op in the reference: phi/kernels/rms_norm_kernel.h).
+
+All stats accumulate in float32 regardless of input dtype (bf16-first contract)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op, unwrap
+from ...core.tensor import Tensor
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    ns = normalized_shape if isinstance(normalized_shape, (list, tuple)) else [normalized_shape]
+    n_axes = len(ns)
+    def f(a, *wb):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        af = a.astype(jnp.float32)
+        mean = jnp.mean(af, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(af - mean), axis=axes, keepdims=True)
+        out = (af - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32)
+        return out.astype(a.dtype)
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return apply_op("layer_norm", f, *args)
+
+
+def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1, name=None):
+    def f(a, *wb):
+        af = a.astype(jnp.float32)
+        ax = begin_norm_axis if begin_norm_axis >= 0 else a.ndim + begin_norm_axis
+        axes = tuple(range(ax, a.ndim))
+        ms = jnp.mean(jnp.square(af), axis=axes, keepdims=True)
+        out = af * jax.lax.rsqrt(ms + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32)
+        return out.astype(a.dtype)
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return apply_op("rms_norm", f, *args)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None,
+               name=None):
+    use_stats = (not training) if use_global_stats is None else use_global_stats
+    ch_axis = 1 if data_format.startswith("NC") else -1
+    def f(a, *wb):
+        nd = a.ndim
+        cax = ch_axis % nd
+        red_axes = tuple(i for i in range(nd) if i != cax)
+        af = a.astype(jnp.float32)
+        if use_stats:
+            mean = unwrap(running_mean).astype(jnp.float32)
+            var = unwrap(running_var).astype(jnp.float32)
+        else:
+            mean = jnp.mean(af, axis=red_axes)
+            var = jnp.var(af, axis=red_axes)
+        shape = [1] * nd
+        shape[cax] = a.shape[cax]
+        out = (af - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32).reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32).reshape(shape)
+        # return batch stats alongside so the running update reuses this reduction
+        return out.astype(a.dtype), mean, var
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    out, bmean, bvar = apply_op("batch_norm", f, *args)
+    if training and not use_stats:
+        rm, rv = running_mean, running_var
+        rm._data = (momentum * unwrap(rm).astype(jnp.float32)
+                    + (1 - momentum) * unwrap(bmean)).astype(rm._data.dtype)
+        rv._data = (momentum * unwrap(rv).astype(jnp.float32)
+                    + (1 - momentum) * unwrap(bvar)).astype(rv._data.dtype)
+    return out
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW",
+               name=None):
+    def f(a, *wb):
+        chan_last = not data_format.startswith("NC")
+        if chan_last:
+            a_ = jnp.moveaxis(a, -1, 1)
+        else:
+            a_ = a
+        n, c = a_.shape[0], a_.shape[1]
+        spatial = a_.shape[2:]
+        af = a_.astype(jnp.float32).reshape(n, num_groups, c // num_groups, *spatial)
+        axes = tuple(range(2, af.ndim))
+        mean = jnp.mean(af, axis=axes, keepdims=True)
+        var = jnp.var(af, axis=axes, keepdims=True)
+        out = ((af - mean) * jax.lax.rsqrt(var + epsilon)).reshape(n, c, *spatial)
+        shape = [1] * out.ndim
+        shape[1] = c
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32).reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32).reshape(shape)
+        out = out.astype(a.dtype)
+        return jnp.moveaxis(out, 1, -1) if chan_last else out
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return apply_op("group_norm", f, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW",
+                  name=None):
+    def f(a, *wb):
+        nd = a.ndim
+        cax = 1 if data_format.startswith("NC") else nd - 1
+        red_axes = tuple(i for i in range(2, nd)) if cax == 1 else tuple(range(1, nd - 1))
+        af = a.astype(jnp.float32)
+        mean = jnp.mean(af, axis=red_axes, keepdims=True)
+        var = jnp.var(af, axis=red_axes, keepdims=True)
+        out = (af - mean) * jax.lax.rsqrt(var + eps)
+        shape = [1] * nd
+        shape[cax] = a.shape[cax]
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32).reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32).reshape(shape)
+        return out.astype(a.dtype)
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return apply_op("instance_norm", f, *args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW",
+                        name=None):
+    def f(a):
+        cax = 1 if data_format.startswith("NC") else a.ndim - 1
+        sq = jnp.square(a.astype(jnp.float32))
+        c = a.shape[cax]
+        half = size // 2
+        pads = [(0, 0)] * a.ndim
+        pads[cax] = (half, size - half - 1)
+        sq_p = jnp.pad(sq, pads)
+        acc = jnp.zeros_like(sq)
+        for i in range(size):
+            sl = [slice(None)] * a.ndim
+            sl[cax] = slice(i, i + c)
+            acc = acc + sq_p[tuple(sl)]
+        return (a.astype(jnp.float32) / jnp.power(k + alpha * acc, beta)).astype(a.dtype)
+    return apply_op("local_response_norm", f, x)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        nrm = jnp.power(jnp.sum(jnp.power(jnp.abs(a.astype(jnp.float32)), p),
+                                axis=axis, keepdims=True), 1.0 / p)
+        return (a.astype(jnp.float32) / jnp.maximum(nrm, epsilon)).astype(a.dtype)
+    return apply_op("normalize", f, x)

@@ -1,0 +1,136 @@
+"""Pooling functionals via lax.reduce_window (reference: nn/functional/pooling.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from .conv import _tuple, _padding
+
+
+def _pool(x, kernel, stride, padding, n, data_format, reducer, init, name,
+          ceil_mode=False, exclusive=True):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    ks = _tuple(kernel, n)
+    st = _tuple(stride if stride is not None else kernel, n)
+    pad = _padding(padding, n)
+    def f(a):
+        nd = a.ndim
+        if channel_last:
+            window = (1,) + ks + (1,)
+            strides = (1,) + st + (1,)
+            pads = [(0, 0)] + (pad if not isinstance(pad, str) else pad) + [(0, 0)] \
+                if not isinstance(pad, str) else pad
+        else:
+            window = (1, 1) + ks
+            strides = (1, 1) + st
+            pads = [(0, 0), (0, 0)] + pad if not isinstance(pad, str) else pad
+        if isinstance(pads, str):
+            pads = jax.lax.padtype_to_pads(a.shape, window, strides, pads)
+        if ceil_mode:
+            spatial = range(nd - n, nd) if not channel_last else range(1, nd - 1)
+            pads = list(pads)
+            for i, ax in enumerate(spatial):
+                size = a.shape[ax] + pads[ax][0] + pads[ax][1]
+                rem = (size - ks[i]) % st[i]
+                if rem:
+                    pads[ax] = (pads[ax][0], pads[ax][1] + st[i] - rem)
+        if reducer == "max":
+            return jax.lax.reduce_window(a, -jnp.inf if np.dtype(a.dtype).kind == "f" else
+                                         jnp.iinfo(a.dtype).min,
+                                         jax.lax.max, window, strides, pads)
+        s = jax.lax.reduce_window(a.astype(jnp.float32), 0.0, jax.lax.add, window, strides, pads)
+        if exclusive:
+            ones = jnp.ones(a.shape, jnp.float32)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+            return (s / cnt).astype(a.dtype)
+        return (s / float(np.prod(ks))).astype(a.dtype)
+    return apply_op(name, f, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _pool(x, kernel_size, stride, padding, 1, df, "max", None, "max_pool1d",
+                 ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "max", None,
+                 "max_pool2d", ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "max", None,
+                 "max_pool3d", ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _pool(x, kernel_size, stride, padding, 1, df, "avg", None, "avg_pool1d",
+                 ceil_mode, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "avg", None,
+                 "avg_pool2d", ceil_mode, exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "avg", None,
+                 "avg_pool3d", ceil_mode, exclusive)
+
+
+def _adaptive(x, output_size, n, data_format, kind, name):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    os_ = _tuple(output_size, n)
+    def f(a):
+        nd = a.ndim
+        spatial = list(range(1, nd - 1)) if channel_last else list(range(nd - n, nd))
+        out = a.astype(jnp.float32) if kind == "avg" else a
+        for ax, o in zip(spatial, os_):
+            n_in = out.shape[ax]
+            if o is None or o == n_in:
+                continue
+            # split into o regions like paddle/torch adaptive pooling
+            starts = (np.arange(o) * n_in) // o
+            ends = ((np.arange(o) + 1) * n_in + o - 1) // o
+            slices = []
+            for s, e in zip(starts, ends):
+                seg = jax.lax.slice_in_dim(out, int(s), int(e), axis=ax)
+                red = jnp.mean(seg, axis=ax, keepdims=True) if kind == "avg" \
+                    else jnp.max(seg, axis=ax, keepdims=True)
+                slices.append(red)
+            out = jnp.concatenate(slices, axis=ax)
+        return out.astype(a.dtype)
+    return apply_op(name, f, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "NCW", "avg", "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, data_format, "avg", "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, data_format, "avg", "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, "NCW", "max", "adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, "NCHW", "max", "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, "NCDHW", "max", "adaptive_max_pool3d")

@@ -1,0 +1,73 @@
+"""Rotary position embedding — first-class op in the reference
+(phi/ops/yaml fused_rope; spmd rule phi/infermeta/spmd_rules/fused_rope.cc)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op, unwrap
+
+
+def _rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0):
+    """Apply RoPE to q/k/v ([B, S, H, D]). Returns (q', k', v') like the reference."""
+    sin_a, cos_a = unwrap(sin), unwrap(cos)
+    pos = unwrap(position_ids) if position_ids is not None else None
+
+    def build(a_dtype, seq_len, head_dim):
+        if sin_a is not None:
+            s, c = sin_a, cos_a
+        else:
+            inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+            t = jnp.arange(seq_len, dtype=jnp.float32)
+            freqs = jnp.outer(t, inv)
+            emb = jnp.concatenate([freqs, freqs], axis=-1)
+            s, c = jnp.sin(emb), jnp.cos(emb)
+        s = s.reshape(-1, s.shape[-1])
+        c = c.reshape(-1, c.shape[-1])
+        if pos is not None:
+            s = jnp.take(s, pos.reshape(-1), axis=0).reshape(pos.shape + (s.shape[-1],))
+            c = jnp.take(c, pos.reshape(-1), axis=0).reshape(pos.shape + (c.shape[-1],))
+            s, c = s[:, :, None, :], c[:, :, None, :]
+        else:
+            s, c = s[None, :, None, :], c[None, :, None, :]
+        return s.astype(jnp.float32), c.astype(jnp.float32)
+
+    def rope_one(a, s, c):
+        af = a.astype(jnp.float32)
+        if use_neox_rotary_style:
+            out = af * c + _rotate_half(af) * s
+        else:
+            # interleaved (GPT-J) style
+            a1 = af[..., 0::2]
+            a2 = af[..., 1::2]
+            half = a.shape[-1] // 2
+            ch, sh = c[..., :half], s[..., :half]
+            o1 = a1 * ch - a2 * sh
+            o2 = a2 * ch + a1 * sh
+            out = jnp.stack([o1, o2], axis=-1).reshape(af.shape)
+        return out.astype(a.dtype)
+
+    outs = []
+    for t in (q, k, v):
+        if t is None:
+            outs.append(None)
+            continue
+        def f(a):
+            s, c = build(a.dtype, a.shape[1], a.shape[-1])
+            return rope_one(a, s, c)
+        outs.append(apply_op("fused_rope", f, t))
+    return tuple(outs)
+
+
+def rotary_embedding_sin_cos(seq_len, head_dim, base=10000.0, dtype=jnp.float32):
+    inv = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.sin(emb).astype(dtype), jnp.cos(emb).astype(dtype)

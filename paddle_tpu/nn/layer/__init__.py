@@ -1,1 +1,11 @@
-"""placeholder — filled in during round 1 build."""
+from .layers import Layer  # noqa: F401
+from .common import *  # noqa: F401,F403
+from .container import Sequential, LayerList, ParameterList, LayerDict  # noqa: F401
+from .activation import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .transformer import (MultiHeadAttention, Transformer, TransformerEncoder,  # noqa: F401
+                          TransformerEncoderLayer, TransformerDecoder,
+                          TransformerDecoderLayer)

@@ -1,0 +1,15 @@
+"""nn.utils (reference: python/paddle/nn/utils/)."""
+from ...core.tensor import Tensor
+import jax.numpy as jnp
+
+
+def parameters_to_vector(parameters, name=None):
+    return Tensor(jnp.concatenate([p._data.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        p._data = vec._data[offset:offset + n].reshape(p._data.shape).astype(p._data.dtype)
+        offset += n

@@ -1,1 +1,5 @@
-"""placeholder — filled in during round 1 build."""
+"""paddle.optimizer surface (reference: python/paddle/optimizer/__init__.py)."""
+from .optimizer import Optimizer, L1Decay, L2Decay  # noqa: F401
+from .optimizers import (SGD, Momentum, Adam, AdamW, Adamax, Adagrad, RMSProp,  # noqa: F401
+                         Adadelta, Lamb, LBFGS)
+from . import lr  # noqa: F401

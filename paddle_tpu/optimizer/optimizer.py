@@ -1,0 +1,161 @@
+"""Optimizer base (reference: python/paddle/optimizer/optimizer.py:127).
+
+State accumulators live in Tensors (persistable), so a jitted train step captures
+them as donated inputs/outputs automatically. Updates compute in float32 master
+precision when parameters are bf16/f16 and multi_precision is set.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core.dispatch import unwrap
+from ..nn.clip import ClipGradBase
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        from .lr import LRScheduler
+        self._lr = learning_rate
+        self._lr_scheduler = learning_rate if isinstance(learning_rate, LRScheduler) else None
+        if parameters is None:
+            raise ValueError("parameters must be provided (dygraph-style optimizer)")
+        self._param_groups = self._build_groups(parameters)
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._accumulators: dict[str, dict[int, Tensor]] = defaultdict(dict)
+        self._global_step = Tensor(jnp.zeros((), jnp.int32), persistable=True)
+        self._multi_precision = False
+
+    def _build_groups(self, parameters):
+        params = list(parameters)
+        if params and isinstance(params[0], dict):
+            groups = []
+            for g in params:
+                g = dict(g)
+                g["params"] = list(g["params"])
+                groups.append(g)
+            return groups
+        return [{"params": params}]
+
+    @property
+    def _parameter_list(self):
+        return [p for g in self._param_groups for p in g["params"]]
+
+    # ---- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if self._lr_scheduler is not None:
+            return float(self._lr_scheduler())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if self._lr_scheduler is not None:
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = value
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr_scheduler = scheduler
+
+    # ---- accumulators --------------------------------------------------------
+    def _acc(self, name, p, init=None, dtype=None):
+        store = self._accumulators[name]
+        key = id(p)
+        if key not in store:
+            dt = dtype or (jnp.float32 if self._multi_precision else p._data.dtype)
+            arr = jnp.zeros(p._data.shape, dt) if init is None else init
+            t = Tensor(arr, persistable=True)
+            t.name = f"{name}_{p.name or key}"
+            store[key] = t
+        return store[key]
+
+    # ---- step ----------------------------------------------------------------
+    def step(self):
+        lr = self.get_lr()
+        # clip over ALL groups at once so ClipGradByGlobalNorm sees the true
+        # global norm (reference: Optimizer._create_optimization_pass clips the
+        # concatenated params_grads)
+        all_pg = [(p, p.grad) for g in self._param_groups for p in g["params"]
+                  if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            all_pg = self._grad_clip(all_pg)
+        clipped = {id(p): g for p, g in all_pg}
+        for group in self._param_groups:
+            glr = lr * group.get("learning_rate", 1.0)
+            wd = group.get("weight_decay", self._weight_decay)
+            for p in group["params"]:
+                g = clipped.get(id(p))
+                if g is None:
+                    continue
+                plr = glr * p.optimize_attr.get("learning_rate", 1.0) \
+                    if isinstance(p, Parameter) else glr
+                self._update_param(p, unwrap(g), plr, wd)
+        self._global_step._data = unwrap(self._global_step) + 1
+
+    def _update_param(self, p, g, lr, weight_decay):
+        raise NotImplementedError
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # ---- state dict ----------------------------------------------------------
+    def state_dict(self):
+        sd = {}
+        plist = self._parameter_list
+        for name, store in self._accumulators.items():
+            for i, p in enumerate(plist):
+                if id(p) in store:
+                    sd[f"{name}_{i}"] = store[id(p)]
+        sd["global_step"] = self._global_step
+        if self._lr_scheduler is not None:
+            sd["LR_Scheduler"] = self._lr_scheduler.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        plist = self._parameter_list
+        for key, value in state_dict.items():
+            if key == "LR_Scheduler":
+                if self._lr_scheduler is not None:
+                    self._lr_scheduler.set_state_dict(value)
+                continue
+            if key == "global_step":
+                self._global_step._data = unwrap(value) if isinstance(value, Tensor) \
+                    else jnp.asarray(value)
+                continue
+            name, _, idx = key.rpartition("_")
+            p = plist[int(idx)]
+            t = self._acc(name, p)
+            v = value._data if isinstance(value, Tensor) else jnp.asarray(np.asarray(value))
+            t._data = v.astype(t._data.dtype)
+
+    def _apply_weight_decay_l2(self, p, g, wd):
+        """Fold regularizer into grad (SGD/Momentum/Adam style): L2 adds coeff*p,
+        L1 adds coeff*sign(p) (reference: python/paddle/regularizer.py)."""
+        if wd is None:
+            return g
+        coeff = wd.coeff if hasattr(wd, "coeff") else float(wd)
+        pw = unwrap(p).astype(g.dtype)
+        if isinstance(wd, L1Decay):
+            return g + coeff * jnp.sign(pw)
+        return g + coeff * pw
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = coeff
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = coeff

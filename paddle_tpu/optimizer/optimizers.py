@@ -1,0 +1,281 @@
+"""Concrete optimizers (reference: python/paddle/optimizer/{sgd,momentum,adam,adamw,...}.py).
+
+All moment math in float32 (bf16-first training contract); parameter updates cast
+back to the parameter dtype at the end (master-weights behavior when
+multi_precision=True keeps an f32 copy as the source of truth).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import unwrap
+from .optimizer import Optimizer
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._multi_precision = multi_precision
+
+    def _update_param(self, p, g, lr, wd):
+        g = self._apply_weight_decay_l2(p, g.astype(jnp.float32), wd)
+        p._data = (unwrap(p).astype(jnp.float32) - lr * g).astype(p._data.dtype)
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+        self._multi_precision = multi_precision
+
+    def _update_param(self, p, g, lr, wd):
+        g = self._apply_weight_decay_l2(p, g.astype(jnp.float32), wd)
+        vel = self._acc("velocity", p, dtype=jnp.float32)
+        v = self._momentum * unwrap(vel) + g
+        vel._data = v
+        if self._nesterov:
+            update = g + self._momentum * v
+        else:
+            update = v
+        p._data = (unwrap(p).astype(jnp.float32) - lr * update).astype(p._data.dtype)
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._multi_precision = multi_precision
+        self._amsgrad = amsgrad
+
+    def _decay_is_decoupled(self):
+        return False
+
+    def _update_param(self, p, g, lr, wd):
+        gf = g.astype(jnp.float32)
+        if not self._decay_is_decoupled():
+            gf = self._apply_weight_decay_l2(p, gf, wd)
+        m = self._acc("moment1", p, dtype=jnp.float32)
+        v = self._acc("moment2", p, dtype=jnp.float32)
+        b1p = self._acc("beta1_pow", p, init=jnp.ones((), jnp.float32))
+        b2p = self._acc("beta2_pow", p, init=jnp.ones((), jnp.float32))
+        b1t = unwrap(b1p) * self._beta1
+        b2t = unwrap(b2p) * self._beta2
+        b1p._data, b2p._data = b1t, b2t
+        mv = self._beta1 * unwrap(m) + (1 - self._beta1) * gf
+        vv = self._beta2 * unwrap(v) + (1 - self._beta2) * jnp.square(gf)
+        m._data, v._data = mv, vv
+        if self._amsgrad:
+            vmax = self._acc("moment2_max", p, dtype=jnp.float32)
+            vv = jnp.maximum(unwrap(vmax), vv)
+            vmax._data = vv
+        mhat = mv / (1 - b1t)
+        vhat = vv / (1 - b2t)
+        pw = unwrap(p).astype(jnp.float32)
+        if self._decay_is_decoupled() and wd is not None:
+            coeff = wd if isinstance(wd, float) else getattr(wd, "coeff", 0.0)
+            if self._should_decay(p):
+                pw = pw * (1.0 - lr * coeff)
+        pw = pw - lr * mhat / (jnp.sqrt(vhat) + self._eps)
+        p._data = pw.astype(p._data.dtype)
+
+    def _should_decay(self, p):
+        return True
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision, amsgrad)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decay_is_decoupled(self):
+        return True
+
+    def _should_decay(self, p):
+        if self._apply_decay_param_fun is not None:
+            return self._apply_decay_param_fun(p.name)
+        return True
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _update_param(self, p, g, lr, wd):
+        gf = self._apply_weight_decay_l2(p, g.astype(jnp.float32), wd)
+        m = self._acc("moment", p, dtype=jnp.float32)
+        u = self._acc("inf_norm", p, dtype=jnp.float32)
+        b1p = self._acc("beta1_pow", p, init=jnp.ones((), jnp.float32))
+        b1t = unwrap(b1p) * self._beta1
+        b1p._data = b1t
+        mv = self._beta1 * unwrap(m) + (1 - self._beta1) * gf
+        uv = jnp.maximum(self._beta2 * unwrap(u), jnp.abs(gf))
+        m._data, u._data = mv, uv
+        pw = unwrap(p).astype(jnp.float32) - lr / (1 - b1t) * mv / (uv + self._eps)
+        p._data = pw.astype(p._data.dtype)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None,
+                 grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _update_param(self, p, g, lr, wd):
+        gf = self._apply_weight_decay_l2(p, g.astype(jnp.float32), wd)
+        acc = self._acc("moment", p,
+                        init=jnp.full(p._data.shape, self._init_acc, jnp.float32))
+        av = unwrap(acc) + jnp.square(gf)
+        acc._data = av
+        pw = unwrap(p).astype(jnp.float32) - lr * gf / (jnp.sqrt(av) + self._eps)
+        p._data = pw.astype(p._data.dtype)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _update_param(self, p, g, lr, wd):
+        gf = self._apply_weight_decay_l2(p, g.astype(jnp.float32), wd)
+        ms = self._acc("mean_square", p, dtype=jnp.float32)
+        mom = self._acc("momentum", p, dtype=jnp.float32)
+        msv = self._rho * unwrap(ms) + (1 - self._rho) * jnp.square(gf)
+        ms._data = msv
+        if self._centered:
+            mg = self._acc("mean_grad", p, dtype=jnp.float32)
+            mgv = self._rho * unwrap(mg) + (1 - self._rho) * gf
+            mg._data = mgv
+            denom = jnp.sqrt(msv - jnp.square(mgv) + self._eps)
+        else:
+            denom = jnp.sqrt(msv + self._eps)
+        mv = self._momentum * unwrap(mom) + lr * gf / denom
+        mom._data = mv
+        p._data = (unwrap(p).astype(jnp.float32) - mv).astype(p._data.dtype)
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps, self._rho = epsilon, rho
+
+    def _update_param(self, p, g, lr, wd):
+        gf = self._apply_weight_decay_l2(p, g.astype(jnp.float32), wd)
+        avg_sq = self._acc("avg_squared_grad", p, dtype=jnp.float32)
+        avg_up = self._acc("avg_squared_update", p, dtype=jnp.float32)
+        asv = self._rho * unwrap(avg_sq) + (1 - self._rho) * jnp.square(gf)
+        update = jnp.sqrt(unwrap(avg_up) + self._eps) / jnp.sqrt(asv + self._eps) * gf
+        auv = self._rho * unwrap(avg_up) + (1 - self._rho) * jnp.square(update)
+        avg_sq._data, avg_up._data = asv, auv
+        p._data = (unwrap(p).astype(jnp.float32) - lr * update).astype(p._data.dtype)
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments (reference: python/paddle/optimizer/lamb.py)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update_param(self, p, g, lr, wd):
+        gf = g.astype(jnp.float32)
+        m = self._acc("moment1", p, dtype=jnp.float32)
+        v = self._acc("moment2", p, dtype=jnp.float32)
+        b1p = self._acc("beta1_pow", p, init=jnp.ones((), jnp.float32))
+        b2p = self._acc("beta2_pow", p, init=jnp.ones((), jnp.float32))
+        b1t, b2t = unwrap(b1p) * self._beta1, unwrap(b2p) * self._beta2
+        b1p._data, b2p._data = b1t, b2t
+        mv = self._beta1 * unwrap(m) + (1 - self._beta1) * gf
+        vv = self._beta2 * unwrap(v) + (1 - self._beta2) * jnp.square(gf)
+        m._data, v._data = mv, vv
+        mhat = mv / (1 - b1t)
+        vhat = vv / (1 - b2t)
+        pw = unwrap(p).astype(jnp.float32)
+        r = mhat / (jnp.sqrt(vhat) + self._eps)
+        if self._exclude_fn is None or not self._exclude_fn(p):
+            r = r + self._wd * pw
+        w_norm = jnp.linalg.norm(pw)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        p._data = (pw - lr * trust * r).astype(p._data.dtype)
+
+
+class LBFGS(Optimizer):
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._max_iter = max_iter
+        self._history_size = history_size
+        self._old_dirs: list = []
+        self._old_stps: list = []
+        self._prev_flat_grad = None
+
+    def step(self, closure=None):
+        """Simplified two-loop-recursion L-BFGS (eager-only; host control flow)."""
+        if closure is not None:
+            loss = closure()
+        params = [p for p in self._parameter_list if p.grad is not None]
+        if not params:
+            return
+        flat_g = jnp.concatenate([unwrap(p.grad).astype(jnp.float32).reshape(-1)
+                                  for p in params])
+        if self._prev_flat_grad is not None:
+            y = flat_g - self._prev_flat_grad
+            s = self._last_step
+            ys = jnp.dot(y, s)
+            if float(ys) > 1e-10:
+                self._old_dirs.append(y)
+                self._old_stps.append(s)
+                if len(self._old_dirs) > self._history_size:
+                    self._old_dirs.pop(0)
+                    self._old_stps.pop(0)
+        q = flat_g
+        alphas = []
+        for y, s in zip(reversed(self._old_dirs), reversed(self._old_stps)):
+            rho = 1.0 / jnp.dot(y, s)
+            alpha = rho * jnp.dot(s, q)
+            q = q - alpha * y
+            alphas.append((alpha, rho))
+        if self._old_dirs:
+            y, s = self._old_dirs[-1], self._old_stps[-1]
+            q = q * (jnp.dot(y, s) / jnp.dot(y, y))
+        for (alpha, rho), (y, s) in zip(reversed(alphas),
+                                        zip(self._old_dirs, self._old_stps)):
+            beta = rho * jnp.dot(y, q)
+            q = q + (alpha - beta) * s
+        direction = -q
+        lr = self.get_lr()
+        self._last_step = lr * direction
+        self._prev_flat_grad = flat_g
+        offset = 0
+        for p in params:
+            n = p.size
+            upd = self._last_step[offset:offset + n].reshape(p._data.shape)
+            p._data = (unwrap(p).astype(jnp.float32) + upd).astype(p._data.dtype)
+            offset += n

@@ -1,0 +1,289 @@
+"""nn.Layer / layers / functional tests (reference pattern: test/legacy_test API tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+rng = np.random.RandomState(0)
+
+
+class TestLayerBase:
+    def test_registration_and_traversal(self):
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(2, 3)
+                self.sub = nn.Sequential(nn.Linear(3, 3), nn.ReLU())
+                self.register_buffer("buf", pt.ones([3]))
+
+            def forward(self, x):
+                return self.sub(self.fc(x)) + self.buf
+
+        m = M()
+        names = [n for n, _ in m.named_parameters()]
+        assert "fc.weight" in names and "sub.0.bias" in names
+        assert len(m.parameters()) == 4
+        assert len(list(m.named_buffers())) == 1
+        assert any(isinstance(l, nn.ReLU) for l in m.sublayers())
+        out = m(pt.ones([1, 2]))
+        assert out.shape == [1, 3]
+
+    def test_train_eval_propagates(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        m.eval()
+        assert not m[1].training
+        m.train()
+        assert m[1].training
+
+    def test_state_dict_roundtrip(self):
+        m1 = nn.Linear(3, 4)
+        m2 = nn.Linear(3, 4)
+        m2.set_state_dict(m1.state_dict())
+        x = pt.randn([2, 3])
+        np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy())
+
+    def test_state_dict_shape_mismatch_raises(self):
+        m1, m2 = nn.Linear(3, 4), nn.Linear(3, 5)
+        with pytest.raises(ValueError):
+            m2.set_state_dict(m1.state_dict())
+
+    def test_forward_hooks(self):
+        m = nn.Linear(2, 2)
+        calls = []
+        h1 = m.register_forward_pre_hook(lambda layer, inp: calls.append("pre"))
+        h2 = m.register_forward_post_hook(lambda layer, inp, out: calls.append("post"))
+        m(pt.ones([1, 2]))
+        assert calls == ["pre", "post"]
+        h1.remove(); h2.remove()
+        m(pt.ones([1, 2]))
+        assert calls == ["pre", "post"]
+
+    def test_to_dtype(self):
+        m = nn.Linear(2, 2)
+        m.to(dtype="bfloat16")
+        assert m.weight.dtype == pt.bfloat16
+
+    def test_apply(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Linear(2, 2))
+        seen = []
+        m.apply(lambda l: seen.append(type(l).__name__))
+        assert seen.count("Linear") == 2
+
+
+class TestLayers:
+    def test_linear_numerics(self):
+        m = nn.Linear(3, 4)
+        x = rng.rand(5, 3).astype(np.float32)
+        ref = x @ m.weight.numpy() + m.bias.numpy()
+        np.testing.assert_allclose(m(pt.to_tensor(x)).numpy(), ref, rtol=1e-5)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        idx = pt.to_tensor(np.array([[1, 0, 3]], np.int64))
+        out = emb(idx)
+        assert out.shape == [1, 3, 4]
+        np.testing.assert_allclose(out.numpy()[0, 1], np.zeros(4))
+        out.sum().backward()
+        assert emb.weight.grad is not None
+
+    def test_layernorm_grad(self):
+        ln = nn.LayerNorm(8)
+        x = pt.randn([4, 8])
+        x.stop_gradient = False
+        ln(x).sum().backward()
+        assert x.grad is not None and ln.weight.grad is not None
+
+    def test_rmsnorm_matches_ref(self):
+        m = nn.RMSNorm(8, epsilon=1e-6)
+        x = rng.rand(2, 8).astype(np.float32)
+        ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(m(pt.to_tensor(x)).numpy(), ref, atol=1e-5)
+
+    def test_batchnorm_train_eval(self):
+        bn = nn.BatchNorm1D(4)
+        x = pt.to_tensor(rng.rand(16, 4).astype(np.float32) * 3 + 1)
+        bn.train()
+        y = bn(x).numpy()
+        assert abs(y.mean()) < 1e-4 and abs(y.std() - 1) < 0.1
+        # running stats moved toward batch stats
+        assert not np.allclose(bn._mean.numpy(), 0)
+        bn.eval()
+        y2 = bn(x)
+        assert y2.shape == [16, 4]
+
+    def test_groupnorm(self):
+        gn = nn.GroupNorm(2, 4)
+        x = pt.randn([2, 4, 5, 5])
+        assert gn(x).shape == [2, 4, 5, 5]
+
+    def test_conv2d_matches_manual(self):
+        conv = nn.Conv2D(1, 1, 3, padding=0, bias_attr=False)
+        w = conv.weight.numpy()[0, 0]
+        img = rng.rand(1, 1, 5, 5).astype(np.float32)
+        out = conv(pt.to_tensor(img)).numpy()[0, 0]
+        ref = np.zeros((3, 3), np.float32)
+        for i in range(3):
+            for j in range(3):
+                ref[i, j] = (img[0, 0, i:i+3, j:j+3] * w).sum()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_conv_groups_dilation_stride(self):
+        conv = nn.Conv2D(4, 8, 3, stride=2, padding=1, dilation=2, groups=2)
+        out = conv(pt.randn([2, 4, 16, 16]))
+        assert out.shape[0] == 2 and out.shape[1] == 8
+
+    def test_conv_transpose_shape(self):
+        deconv = nn.Conv2DTranspose(4, 3, 4, stride=2, padding=1)
+        out = deconv(pt.randn([1, 4, 8, 8]))
+        assert out.shape == [1, 3, 16, 16]
+
+    def test_pools(self):
+        x = pt.to_tensor(rng.rand(1, 2, 8, 8).astype(np.float32))
+        assert nn.MaxPool2D(2)(x).shape == [1, 2, 4, 4]
+        assert nn.AvgPool2D(2)(x).shape == [1, 2, 4, 4]
+        assert nn.AdaptiveAvgPool2D(1)(x).shape == [1, 2, 1, 1]
+        np.testing.assert_allclose(nn.AdaptiveAvgPool2D(1)(x).numpy().reshape(1, 2),
+                                   x.numpy().mean((2, 3)), rtol=1e-5)
+
+    def test_activations(self):
+        x = pt.to_tensor(np.array([-2.0, 0.0, 2.0], np.float32))
+        np.testing.assert_allclose(nn.ReLU()(x).numpy(), [0, 0, 2])
+        assert nn.GELU()(x).shape == [3]
+        np.testing.assert_allclose(nn.LeakyReLU(0.1)(x).numpy(), [-0.2, 0, 2], rtol=1e-5)
+        np.testing.assert_allclose(nn.Softmax()(x).numpy().sum(), 1.0, rtol=1e-5)
+
+    def test_sequential_and_layerlist(self):
+        s = nn.Sequential(nn.Linear(2, 3), nn.ReLU(), nn.Linear(3, 1))
+        assert len(s) == 3
+        assert s(pt.ones([1, 2])).shape == [1, 1]
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        ll.append(nn.Linear(2, 2))
+        assert len(ll) == 4 and len(ll.parameters()) == 8
+
+    def test_upsample(self):
+        x = pt.to_tensor(rng.rand(1, 1, 4, 4).astype(np.float32))
+        up = nn.Upsample(scale_factor=2, mode="nearest")
+        assert up(x).shape == [1, 1, 8, 8]
+        upb = nn.Upsample(scale_factor=2, mode="bilinear")
+        assert upb(x).shape == [1, 1, 8, 8]
+
+    def test_pad_layers(self):
+        x = pt.ones([1, 1, 2, 2])
+        assert nn.Pad2D([1, 1, 1, 1])(x).shape == [1, 1, 4, 4]
+
+
+class TestFunctional:
+    def test_cross_entropy_matches_ref(self):
+        logits = rng.rand(8, 5).astype(np.float32)
+        labels = rng.randint(0, 5, (8,)).astype(np.int64)
+        out = F.cross_entropy(pt.to_tensor(logits), pt.to_tensor(labels)).item()
+        e = np.exp(logits - logits.max(1, keepdims=True))
+        p = e / e.sum(1, keepdims=True)
+        ref = -np.log(p[np.arange(8), labels]).mean()
+        assert abs(out - ref) < 1e-5
+
+    def test_cross_entropy_ignore_index(self):
+        logits = rng.rand(4, 3).astype(np.float32)
+        labels = np.array([0, -100, 2, -100], np.int64)
+        out = F.cross_entropy(pt.to_tensor(logits), pt.to_tensor(labels)).item()
+        e = np.exp(logits - logits.max(1, keepdims=True))
+        p = e / e.sum(1, keepdims=True)
+        ref = -np.log(p[[0, 2], [0, 2]]).mean()
+        assert abs(out - ref) < 1e-5
+
+    def test_cross_entropy_soft_label(self):
+        logits = rng.rand(4, 3).astype(np.float32)
+        soft = np.full((4, 3), 1 / 3, np.float32)
+        out = F.cross_entropy(pt.to_tensor(logits), pt.to_tensor(soft),
+                              soft_label=True).item()
+        lse = np.log(np.exp(logits - logits.max(1, keepdims=True)).sum(1)) + logits.max(1)
+        ref = (lse - logits.mean(1)).mean()
+        assert abs(out - ref) < 1e-4
+
+    def test_mse_l1(self):
+        a, b = rng.rand(4).astype(np.float32), rng.rand(4).astype(np.float32)
+        assert abs(F.mse_loss(pt.to_tensor(a), pt.to_tensor(b)).item() -
+                   ((a - b) ** 2).mean()) < 1e-6
+        assert abs(F.l1_loss(pt.to_tensor(a), pt.to_tensor(b)).item() -
+                   np.abs(a - b).mean()) < 1e-6
+
+    def test_bce_with_logits(self):
+        z = rng.randn(6).astype(np.float32)
+        t = (rng.rand(6) > 0.5).astype(np.float32)
+        out = F.binary_cross_entropy_with_logits(pt.to_tensor(z), pt.to_tensor(t)).item()
+        p = 1 / (1 + np.exp(-z))
+        ref = -(t * np.log(p) + (1 - t) * np.log(1 - p)).mean()
+        assert abs(out - ref) < 1e-5
+
+    def test_kl_div(self):
+        logp = np.log(np.array([[0.3, 0.7]], np.float32))
+        t = np.array([[0.5, 0.5]], np.float32)
+        out = F.kl_div(pt.to_tensor(logp), pt.to_tensor(t), reduction="sum").item()
+        ref = (t * (np.log(t) - logp)).sum()
+        assert abs(out - ref) < 1e-5
+
+    def test_dropout_train_scale(self):
+        pt.seed(3)
+        x = pt.ones([1000])
+        y = F.dropout(x, p=0.5, training=True).numpy()
+        assert set(np.unique(y)).issubset({0.0, 2.0})
+        assert abs(y.mean() - 1.0) < 0.15
+        y2 = F.dropout(x, p=0.5, training=False).numpy()
+        np.testing.assert_allclose(y2, 1.0)
+
+    def test_sdpa_causal_masks_future(self):
+        # value at position 0 must not see position 1
+        q = np.zeros((1, 2, 1, 4), np.float32)
+        v = np.zeros((1, 2, 1, 4), np.float32)
+        v[0, 1] = 100.0
+        out = F.scaled_dot_product_attention(
+            pt.to_tensor(q), pt.to_tensor(q), pt.to_tensor(v), is_causal=True).numpy()
+        np.testing.assert_allclose(out[0, 0], 0.0)
+
+    def test_sdpa_matches_naive(self):
+        q = rng.rand(2, 4, 2, 8).astype(np.float32)
+        k = rng.rand(2, 4, 2, 8).astype(np.float32)
+        v = rng.rand(2, 4, 2, 8).astype(np.float32)
+        out = F.scaled_dot_product_attention(pt.to_tensor(q), pt.to_tensor(k),
+                                             pt.to_tensor(v)).numpy()
+        qh = q.transpose(0, 2, 1, 3)
+        kh = k.transpose(0, 2, 1, 3)
+        vh = v.transpose(0, 2, 1, 3)
+        logits = qh @ kh.transpose(0, 1, 3, 2) / np.sqrt(8)
+        w = np.exp(logits - logits.max(-1, keepdims=True))
+        w = w / w.sum(-1, keepdims=True)
+        ref = (w @ vh).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_one_hot_and_label_smooth(self):
+        oh = F.one_hot(pt.to_tensor(np.array([0, 2], np.int64)), 3).numpy()
+        np.testing.assert_array_equal(oh, [[1, 0, 0], [0, 0, 1]])
+        ls = F.label_smooth(pt.to_tensor(oh), epsilon=0.1).numpy()
+        np.testing.assert_allclose(ls[0], [0.9 + 0.1 / 3, 0.1 / 3, 0.1 / 3], rtol=1e-5)
+
+    def test_rope_rotation_property(self):
+        # RoPE preserves norms
+        q = rng.rand(1, 4, 2, 8).astype(np.float32)
+        qr, _, _ = F.fused_rotary_position_embedding(pt.to_tensor(q))
+        np.testing.assert_allclose(np.linalg.norm(qr.numpy(), axis=-1),
+                                   np.linalg.norm(q, axis=-1), rtol=1e-4)
+
+    def test_swiglu(self):
+        x = rng.rand(2, 8).astype(np.float32)
+        out = F.swiglu(pt.to_tensor(x)).numpy()
+        a, b = x[:, :4], x[:, 4:]
+        ref = a / (1 + np.exp(-a)) * b
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_sequence_mask(self):
+        m = F.sequence_mask(pt.to_tensor(np.array([1, 3], np.int64)), maxlen=4)
+        np.testing.assert_array_equal(m.numpy(), [[1, 0, 0, 0], [1, 1, 1, 0]])
+
+    def test_unfold_fold_roundtrip(self):
+        x = rng.rand(1, 2, 6, 6).astype(np.float32)
+        u = F.unfold(pt.to_tensor(x), 2, strides=2)
+        assert u.shape == [1, 8, 9]
+        back = F.fold(u, [6, 6], 2, strides=2)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-5)

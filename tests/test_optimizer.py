@@ -1,0 +1,159 @@
+"""Optimizer / LR scheduler / grad clip tests."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.optimizer import SGD, Momentum, Adam, AdamW, RMSProp, Lamb, Adagrad
+from paddle_tpu.optimizer import lr as lr_mod
+
+
+def _quadratic_problem():
+    # minimize ||Wx - y||^2 over W
+    rng = np.random.RandomState(0)
+    x = pt.to_tensor(rng.rand(16, 4).astype(np.float32))
+    y = pt.to_tensor(rng.rand(16, 2).astype(np.float32))
+    w = pt.Parameter(np.zeros((4, 2), np.float32))
+    return x, y, w
+
+
+@pytest.mark.parametrize("opt_cls,kw", [
+    (SGD, dict(learning_rate=0.3)),
+    (Momentum, dict(learning_rate=0.1, momentum=0.9)),
+    (Adam, dict(learning_rate=0.1)),
+    (AdamW, dict(learning_rate=0.1, weight_decay=0.0)),
+    (RMSProp, dict(learning_rate=0.05)),
+    (Adagrad, dict(learning_rate=0.5)),
+    (Lamb, dict(learning_rate=0.05, lamb_weight_decay=0.0)),
+])
+def test_optimizers_converge(opt_cls, kw):
+    x, y, w = _quadratic_problem()
+    opt = opt_cls(parameters=[w], **kw)
+    first = last = None
+    for i in range(60):
+        loss = ((x @ w - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if i == 0:
+            first = loss.item()
+        last = loss.item()
+    # the problem has ~0.27x irreducible least-squares floor
+    assert last < first * 0.35, f"{opt_cls.__name__}: {first} -> {last}"
+
+
+def test_sgd_exact_update():
+    w = pt.Parameter(np.array([1.0, 2.0], np.float32))
+    opt = SGD(learning_rate=0.1, parameters=[w])
+    (w * w).sum().backward()  # grad = 2w
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [1.0 - 0.2, 2.0 - 0.4], rtol=1e-5)
+
+
+def test_adamw_decoupled_decay():
+    w = pt.Parameter(np.array([10.0], np.float32))
+    opt = AdamW(learning_rate=0.1, weight_decay=0.5, parameters=[w])
+    w.sum().backward()
+    opt.step()
+    # decoupled: w ← w*(1 - lr*wd) - lr * update(≈1 at t=0)
+    expected = 10.0 * (1 - 0.1 * 0.5) - 0.1
+    np.testing.assert_allclose(w.numpy(), [expected], rtol=1e-3)
+
+
+def test_optimizer_state_dict_roundtrip():
+    x, y, w = _quadratic_problem()
+    opt = Adam(learning_rate=0.1, parameters=[w])
+    ((x @ w - y) ** 2).mean().backward()
+    opt.step(); opt.clear_grad()
+    sd = opt.state_dict()
+    w2 = pt.Parameter(np.zeros((4, 2), np.float32))
+    opt2 = Adam(learning_rate=0.1, parameters=[w2])
+    ((x @ w2 - y) ** 2).mean().backward()
+    opt2.step(); opt2.clear_grad()
+    opt2.set_state_dict(sd)
+    np.testing.assert_allclose(opt2._acc("moment1", w2).numpy(),
+                               opt._acc("moment1", w).numpy(), rtol=1e-6)
+
+
+def test_param_groups_with_different_lr():
+    w1 = pt.Parameter(np.array([1.0], np.float32))
+    w2 = pt.Parameter(np.array([1.0], np.float32))
+    opt = SGD(learning_rate=0.1,
+              parameters=[{"params": [w1]},
+                          {"params": [w2], "learning_rate": 0.5}])
+    (w1 + w2).backward()
+    opt.step()
+    np.testing.assert_allclose(w1.numpy(), [0.9], rtol=1e-5)
+    np.testing.assert_allclose(w2.numpy(), [0.95], rtol=1e-5)
+
+
+def test_grad_clip_global_norm():
+    w = pt.Parameter(np.array([3.0, 4.0], np.float32))  # |g|=10 after *2
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    opt = SGD(learning_rate=1.0, parameters=[w], grad_clip=clip)
+    (w * w).sum().backward()  # grad [6, 8], norm 10
+    opt.step()
+    # clipped grad = [0.6, 0.8]
+    np.testing.assert_allclose(w.numpy(), [3.0 - 0.6, 4.0 - 0.8], rtol=1e-4)
+
+
+def test_grad_clip_by_value():
+    w = pt.Parameter(np.array([3.0], np.float32))
+    opt = SGD(learning_rate=1.0, parameters=[w], grad_clip=nn.ClipGradByValue(1.0))
+    (w * 5).sum().backward()  # grad 5 -> clip to 1
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [2.0], rtol=1e-5)
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = lr_mod.StepDecay(0.1, step_size=2, gamma=0.5)
+        lrs = []
+        for _ in range(5):
+            lrs.append(s())
+            s.step()
+        np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025], rtol=1e-6)
+
+    def test_cosine(self):
+        s = lr_mod.CosineAnnealingDecay(1.0, T_max=10)
+        assert abs(s() - 1.0) < 1e-6
+        for _ in range(10):
+            s.step()
+        assert s() < 1e-6
+
+    def test_warmup_then_constant(self):
+        s = lr_mod.LinearWarmup(0.1, warmup_steps=4, start_lr=0.0, end_lr=0.1)
+        vals = []
+        for _ in range(6):
+            vals.append(s())
+            s.step()
+        np.testing.assert_allclose(vals[:4], [0.0, 0.025, 0.05, 0.075], rtol=1e-5)
+        assert vals[5] == pytest.approx(0.1)
+
+    def test_scheduler_with_optimizer(self):
+        w = pt.Parameter(np.array([1.0], np.float32))
+        sched = lr_mod.StepDecay(0.1, step_size=1, gamma=0.1)
+        opt = SGD(learning_rate=sched, parameters=[w])
+        w.sum().backward()
+        opt.step()  # lr 0.1
+        np.testing.assert_allclose(w.numpy(), [0.9], rtol=1e-5)
+        sched.step()
+        w.clear_grad(); w.sum().backward()
+        opt.step()  # lr 0.01
+        np.testing.assert_allclose(w.numpy(), [0.89], rtol=1e-4)
+
+    def test_cosine_warmup_decay_nlp(self):
+        s = lr_mod.CosineAnnealingWithWarmupDecay(max_lr=1.0, min_lr=0.1,
+                                                  warmup_step=2, decay_step=10)
+        s.step(1)
+        assert s() == pytest.approx(0.5)
+        s.step(10)
+        assert s() == pytest.approx(0.1, abs=1e-6)
+
+    def test_reduce_on_plateau(self):
+        s = lr_mod.ReduceOnPlateau(1.0, patience=1, factor=0.5)
+        for m in [1.0, 1.0, 1.0, 1.0]:
+            s.step(m)
+        assert s() < 1.0
