@@ -1,1 +1,224 @@
-"""placeholder — filled in during round 1 build."""
+"""AMP — autocast + GradScaler (reference: python/paddle/amp/auto_cast.py:1029,
+grad_scaler.py:657; C++ autocast state imperative/amp_auto_cast.h:29).
+
+On TPU bf16 is the native fast dtype: no loss scaling needed (GradScaler becomes a
+pass-through unless fp16 is requested), and autocast is a dispatch-level dtype cast
+per the O1 white/black lists.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import _state, unwrap
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+
+# O1 lists (reference: python/paddle/amp/amp_lists.py WHITE_LIST/BLACK_LIST)
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
+    "scaled_dot_product_attention", "flash_attention", "addmm", "embedding",
+}
+BLACK_LIST = {
+    "cross_entropy", "softmax_with_cross_entropy", "nll_loss", "log_softmax",
+    "exp", "log", "log2", "log10", "log1p", "expm1", "pow", "square", "sqrt",
+    "rsqrt", "p_norm", "norm", "cumsum", "cumprod", "logsumexp", "erf", "erfinv",
+    "sum", "mean_all", "softmax_grad_blk",
+    "layer_norm", "rms_norm", "batch_norm", "group_norm", "instance_norm",
+    "mse_loss", "l1_loss", "bce_with_logits", "binary_cross_entropy", "kl_div",
+}
+
+
+class AmpState:
+    __slots__ = ("enable", "dtype", "level", "white", "black")
+
+    def __init__(self, enable, dtype, level, white, black):
+        self.enable = enable
+        self.dtype = dtype
+        self.level = level
+        self.white = white
+        self.black = black
+
+
+def amp_state():
+    return _state.amp_state
+
+
+def maybe_cast_inputs(op_name, arrays):
+    """Called by dispatch: cast float arrays per autocast policy."""
+    st = _state.amp_state
+    if st is None or not st.enable:
+        return arrays
+    low = st.dtype
+    if st.level == "O2":
+        target = None if op_name in st.black else low
+    else:  # O1
+        if op_name in st.white:
+            target = low
+        elif op_name in st.black:
+            target = np.dtype(np.float32)
+        else:
+            target = None  # follow inputs
+    if target is None:
+        return arrays
+    out = []
+    for a in arrays:
+        if hasattr(a, "dtype") and dtypes.is_floating_point(a.dtype) \
+                and np.dtype(a.dtype) != np.dtype(target):
+            out.append(a.astype(target))
+        else:
+            out.append(a)
+    return tuple(out)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1",
+              dtype="bfloat16", use_promote=True):
+    """paddle.amp.auto_cast (reference: amp/auto_cast.py:1029)."""
+    white = set(WHITE_LIST)
+    black = set(BLACK_LIST)
+    if custom_white_list:
+        white |= set(custom_white_list)
+        black -= set(custom_white_list)
+    if custom_black_list:
+        black |= set(custom_black_list)
+        white -= set(custom_black_list)
+    prev = _state.amp_state
+    _state.amp_state = AmpState(enable, dtypes.convert_dtype(dtype), level, white, black)
+    try:
+        yield
+    finally:
+        _state.amp_state = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weight=None,
+             save_dtype=None):
+    """paddle.amp.decorate — O2 casts parameters to the low dtype (master weights
+    kept in f32 inside the optimizer accumulators automatically)."""
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+        for opt in ([optimizers] if optimizers is not None and
+                    not isinstance(optimizers, (list, tuple)) else (optimizers or [])):
+            opt._multi_precision = True
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """reference: python/paddle/amp/grad_scaler.py:657 (base AmpScaler:62).
+
+    Dynamic loss scaling for fp16; for bf16 (TPU default) scaling is a no-op but
+    the API surface (scale/step/update/minimize/unscale_) is preserved.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16, incr_ratio=2.0,
+                 decr_ratio=0.5, incr_every_n_steps=2000, decr_every_n_nan_or_inf=1,
+                 use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def scale(self, var):
+        if not self._enable or self._scale == 1.0:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found_inf = False
+        for p in optimizer._parameter_list:
+            if p.grad is None:
+                continue
+            g = unwrap(p.grad).astype(jnp.float32)
+            if self._scale != 1.0:
+                g = g * inv
+            finite = bool(jnp.all(jnp.isfinite(g)))
+            if not finite:
+                found_inf = True
+            p.grad = Tensor(g.astype(unwrap(p.grad).dtype))
+        self._found_inf = found_inf
+        self._unscaled = True
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if not self._unscaled:
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            self._unscaled = False
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "incr_every_n_steps": self._incr_every,
+                "decr_every_n_nan_or_inf": self._decr_every,
+                "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+def is_float16_supported(device=None):
+    return True

@@ -80,6 +80,9 @@ def apply_op(name: str, fn: Callable, *inputs, out_treedef_hint=None):
     Attrs must be closed over inside `fn`.
     """
     arrays = tuple(unwrap(a) for a in inputs)
+    if _state.amp_state is not None:
+        from ..amp import maybe_cast_inputs
+        arrays = maybe_cast_inputs(name, arrays)
     needs_grad = _requires_grad(inputs)
 
     if flags.flag("check_nan_inf"):

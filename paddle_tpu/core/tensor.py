@@ -30,7 +30,7 @@ def _is_tracer(x) -> bool:
 
 class Tensor:
     __slots__ = (
-        "_buf", "stop_gradient", "grad", "_grad_node", "_out_slot",
+        "_buf", "stop_gradient", "_grad_buf", "_grad_node", "_out_slot",
         "name", "persistable", "_retain_grad", "_hooks", "__weakref__",
     )
 
@@ -38,18 +38,27 @@ class Tensor:
                  persistable: bool = False):
         self._buf = data
         self.stop_gradient = stop_gradient
-        self.grad: Optional[Tensor] = None
+        self._grad_buf: Optional[Tensor] = None
         self._grad_node = None
         self._out_slot = 0
         self.name = name
         self.persistable = persistable
         self._retain_grad = False
         self._hooks: Optional[list] = None
+        from .dispatch import _state
+        tc = _state.trace_ctx
+        if tc is not None:
+            tc.on_create(self)
 
-    # -- data access: writes are routed through the property so program capture
-    # (paddle_tpu.jit) can observe state mutation (param updates, RNG keys).
+    # -- data access: reads/writes route through properties so program capture
+    # (paddle_tpu.jit) can lift state (params, opt moments, RNG keys) to program
+    # inputs and collect mutations as outputs without touching the real buffers.
     @property
     def _data(self):
+        from .dispatch import _state
+        tc = _state.trace_ctx
+        if tc is not None:
+            return tc.on_read(self)
         return self._buf
 
     @_data.setter
@@ -58,7 +67,25 @@ class Tensor:
         tc = _state.trace_ctx
         if tc is not None:
             tc.on_write(self, value)
+            return
         self._buf = value
+
+    @property
+    def grad(self):
+        from .dispatch import _state
+        tc = _state.trace_ctx
+        if tc is not None:
+            return tc.on_grad_read(self)
+        return self._grad_buf
+
+    @grad.setter
+    def grad(self, value):
+        from .dispatch import _state
+        tc = _state.trace_ctx
+        if tc is not None:
+            tc.on_grad_write(self, value)
+            return
+        self._grad_buf = value
 
     # ---- metadata ------------------------------------------------------------
     @property
@@ -129,16 +156,16 @@ class Tensor:
         return self._buf.shape[0]
 
     def __bool__(self) -> bool:
-        return bool(self._buf)  # raises TracerBoolConversionError under capture
+        return bool(self._data)  # raises TracerBoolConversionError under capture
 
     def __int__(self) -> int:
-        return int(self._buf)
+        return int(self._data)
 
     def __float__(self) -> float:
-        return float(self._buf)
+        return float(self._data)
 
     def __index__(self) -> int:
-        return int(self._buf)
+        return int(self._data)
 
     def __format__(self, spec):
         if self.ndim == 0 and not _is_tracer(self._buf):
@@ -176,7 +203,7 @@ class Tensor:
         self.clear_grad(set_to_zero)
 
     def detach(self) -> "Tensor":
-        t = Tensor(self._buf, stop_gradient=True, name=self.name)
+        t = Tensor(self._data, stop_gradient=True, name=self.name)
         return t
 
     def detach_(self) -> "Tensor":

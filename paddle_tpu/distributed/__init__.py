@@ -1,1 +1,46 @@
-"""placeholder — filled in during round 1 build."""
+"""paddle.distributed surface (reference: python/paddle/distributed/__init__.py).
+
+TPU-native design (SURVEY §7): one ND device mesh + GSPMD shardings replace
+process groups; explicit collectives run via shard_map; rendezvous via JAX's
+coordination service.
+"""
+from .env import (ParallelEnv, get_rank, get_world_size, init_parallel_env,  # noqa: F401
+                  is_initialized)
+from .parallel import DataParallel  # noqa: F401
+
+# filled in as the distributed stack lands this round:
+from .auto_parallel.api import (ProcessMesh, shard_tensor, reshard, shard_layer,  # noqa: F401
+                                dtensor_from_fn, unshard_dtensor)
+from .auto_parallel.placement import (Placement, Replicate, Shard, Partial)  # noqa: F401
+from .collective import (all_reduce, all_gather, all_gather_object, reduce,  # noqa: F401
+                         broadcast, scatter, all_to_all, reduce_scatter,
+                         send, recv, barrier, new_group, get_group, ReduceOp,
+                         split_group)
+from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """reference: distributed/spawn.py:463 — single-node multiprocess launch."""
+    import multiprocessing as mp
+    import os
+    if nprocs == -1:
+        import jax
+        nprocs = jax.device_count()
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env = {"PADDLE_TRAINER_ID": str(rank), "PADDLE_TRAINERS_NUM": str(nprocs)}
+        p = ctx.Process(target=_spawn_entry, args=(func, args, env), daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+    return procs
+
+
+def _spawn_entry(func, args, env):
+    import os
+    os.environ.update(env)
+    func(*args)

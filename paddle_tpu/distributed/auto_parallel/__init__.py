@@ -1,0 +1,4 @@
+from .api import (ProcessMesh, shard_tensor, reshard, shard_layer, set_mesh,  # noqa: F401
+                  get_mesh, dtensor_from_fn, unshard_dtensor, shard_optimizer,
+                  local_map, get_placements, get_process_mesh)
+from .placement import Placement, Replicate, Shard, Partial  # noqa: F401

@@ -1,0 +1,97 @@
+"""Placement types (reference: paddle/phi/core/distributed/auto_parallel/
+placement_types.h; python surface paddle.distributed.{Replicate,Shard,Partial}).
+
+Maps 1:1 onto GSPMD: Shard(d) on mesh axis a ⇒ PartitionSpec dim d = a;
+Replicate ⇒ None; Partial ⇒ unreduced pending-sum (materialized as replicated
+storage + a pending reduce op, like the reference's partial status)."""
+from __future__ import annotations
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Replicate(Placement):
+    def is_replicated(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("Shard", self.dim))
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial(reduce_type={self.reduce_type})"
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("Partial", self.reduce_type))
+
+
+def placements_to_spec(placements, ndim, dim_names):
+    """[Placement per mesh axis] -> jax PartitionSpec entries per tensor dim."""
+    from jax.sharding import PartitionSpec as P
+    entries: list = [None] * ndim
+    for axis_idx, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            d = pl.dim % ndim
+            if entries[d] is None:
+                entries[d] = dim_names[axis_idx]
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (dim_names[axis_idx],)
+            else:
+                entries[d] = (entries[d], dim_names[axis_idx])
+    return P(*entries)
+
+
+def spec_to_placements(spec, mesh_dim_names, ndim):
+    """PartitionSpec -> [Placement per mesh axis]."""
+    placements = [Replicate() for _ in mesh_dim_names]
+    for tdim, entry in enumerate(tuple(spec) + (None,) * (ndim - len(tuple(spec)))):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        for a in axes:
+            placements[mesh_dim_names.index(a)] = Shard(tdim)
+    return placements

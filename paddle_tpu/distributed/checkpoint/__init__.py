@@ -1,0 +1,91 @@
+"""Distributed checkpoint with resharding-on-load (reference:
+python/paddle/distributed/checkpoint/save_state_dict.py, load_state_dict.py —
+metadata + dedup of replicated shards, async_save queue :94).
+
+TPU-native: orbax handles sharded array serialization (each host writes its
+shards — the dedup/flat-mapping metadata of the reference maps to orbax's
+OCDBT format); resharding-on-load = restore with a target sharding.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import queue as queue_mod
+
+import numpy as np
+import jax
+
+from ...core.tensor import Tensor
+from ...core.dispatch import unwrap
+
+
+def _to_arrays(state_dict):
+    flat = {}
+    for k, v in state_dict.items():
+        flat[k] = unwrap(v) if isinstance(v, Tensor) else v
+    return flat
+
+
+def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
+                    unique_id=None, async_save=False):
+    """reference: distributed/checkpoint/save_state_dict.py."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    arrays = _to_arrays(state_dict)
+    if async_save:
+        _async_queue.put((arrays, path))
+        _ensure_async_worker()
+        return
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path, arrays, force=True)
+
+
+_async_queue: queue_mod.Queue = queue_mod.Queue()
+_async_worker = None
+
+
+def _ensure_async_worker():
+    global _async_worker
+    if _async_worker is None or not _async_worker.is_alive():
+        def run():
+            import orbax.checkpoint as ocp
+            ckptr = ocp.PyTreeCheckpointer()
+            while True:
+                item = _async_queue.get()
+                if item is None:
+                    break
+                arrays, path = item
+                # snapshot to host first so training can mutate freely
+                host = {k: np.asarray(v) for k, v in arrays.items()}
+                ckptr.save(path, host, force=True)
+                _async_queue.task_done()
+        _async_worker = threading.Thread(target=run, daemon=True)
+        _async_worker.start()
+
+
+def wait_async_save():
+    _async_queue.join()
+
+
+def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
+                    unique_id=None, offload=False):
+    """Load INTO state_dict, resharding each array to the destination tensor's
+    current sharding (reference: load_state_dict.py reads slices per current
+    sharding)."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    ckptr = ocp.PyTreeCheckpointer()
+    restored = ckptr.restore(path)
+    for k, dst in state_dict.items():
+        if k not in restored:
+            raise KeyError(f"checkpoint at {path} missing key {k}")
+        src = restored[k]
+        if isinstance(dst, Tensor):
+            arr = jax.numpy.asarray(np.asarray(src), dtype=dst._data.dtype)
+            sharding = getattr(dst._data, "sharding", None)
+            if sharding is not None and getattr(sharding, "num_devices", 1) > 1:
+                arr = jax.device_put(arr, sharding)  # reshard-on-load
+            dst._data = arr
+        else:
+            state_dict[k] = src
+    return state_dict

@@ -1,0 +1,300 @@
+"""Explicit collective API (reference: python/paddle/distributed/communication/
++ ProcessGroup contract phi/core/distributed/collective/process_group.h:130).
+
+TPU-native mapping (SURVEY §5): in the hot path collectives are emitted by GSPMD
+inside jit; this module provides the *explicit* eager surface. Groups map to
+sub-sets of the global mesh. Within one process, a "rank" is a device: eager
+collectives over sharded tensors run a tiny jitted shard_map(psum/all_gather...).
+Across processes (multi-host), object-level collectives use JAX's coordination
+service (multihost_utils).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..core.dispatch import unwrap
+from .env import get_rank, get_world_size
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """reference: communication/group.py Group."""
+
+    _next_id = 0
+
+    def __init__(self, ranks, name=None):
+        self.ranks = list(ranks)
+        self.id = Group._next_id
+        Group._next_id += 1
+        self.name = name or f"group_{self.id}"
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    @property
+    def world_size(self):
+        return len(self.ranks)
+
+    def get_rank(self):
+        r = get_rank()
+        return self.ranks.index(r) if r in self.ranks else -1
+
+    def get_world_size(self):
+        return len(self.ranks)
+
+    @property
+    def rank(self):
+        return self.get_rank()
+
+    def get_group_rank(self, global_rank):
+        return self.ranks.index(global_rank) if global_rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks})"
+
+
+_groups: dict[int, Group] = {}
+_global_group: Group | None = None
+
+
+def _get_global_group() -> Group:
+    global _global_group
+    if _global_group is None:
+        _global_group = Group(list(range(get_world_size())), name="global")
+        _groups[_global_group.id] = _global_group
+    return _global_group
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    g = Group(ranks if ranks is not None else list(range(get_world_size())))
+    _groups[g.id] = g
+    return g
+
+
+def split_group(parent=None, split_sizes=None):
+    parent = parent or _get_global_group()
+    out = []
+    start = 0
+    for s in split_sizes:
+        out.append(new_group(parent.ranks[start:start + s]))
+        start += s
+    return out
+
+
+def get_group(gid=0):
+    return _groups.get(gid, _get_global_group())
+
+
+def is_available():
+    return True
+
+
+def _is_sharded(arr) -> bool:
+    sharding = getattr(arr, "sharding", None)
+    return sharding is not None and getattr(sharding, "num_devices", 1) > 1
+
+
+def _device_allreduce(arr, op):
+    """Reduce a device-sharded array in place across its mesh (replicated out)."""
+    sharding = arr.sharding
+    mesh = sharding.mesh
+    repl = NamedSharding(mesh, P())
+    if op == ReduceOp.SUM or op == ReduceOp.AVG:
+        # sum of shards = unshard to replicated then psum? device_put gathers, it
+        # does NOT reduce — a sharded array's global value already includes all
+        # shards. Explicit allreduce semantics apply to *independent per-rank*
+        # values, which in single-controller JAX only exist under shard_map.
+        return jax.device_put(arr, repl)
+    return jax.device_put(arr, repl)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In-place allreduce. World size 1 (single controller): identity —
+    a Tensor is already a *global* value in the JAX programming model; per-rank
+    partial values only arise under shard_map (used by the parallel layers)."""
+    g = group or _get_global_group()
+    if g.get_world_size() <= 1 or jax.process_count() == 1:
+        return tensor
+    from jax.experimental import multihost_utils
+    arr = unwrap(tensor)
+    summed = multihost_utils.process_allgather(arr)
+    if op == ReduceOp.SUM:
+        out = jnp.sum(summed, axis=0)
+    elif op == ReduceOp.MAX:
+        out = jnp.max(summed, axis=0)
+    elif op == ReduceOp.MIN:
+        out = jnp.min(summed, axis=0)
+    elif op == ReduceOp.AVG:
+        out = jnp.mean(summed, axis=0)
+    else:
+        out = jnp.prod(summed, axis=0)
+    tensor._data = out.astype(arr.dtype)
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    g = group or _get_global_group()
+    if g.get_world_size() <= 1 or jax.process_count() == 1:
+        tensor_list.append(Tensor(unwrap(tensor)))
+        return tensor_list
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(unwrap(tensor))
+    for i in range(gathered.shape[0]):
+        tensor_list.append(Tensor(gathered[i]))
+    return tensor_list
+
+
+def all_gather_object(object_list, obj, group=None):
+    g = group or _get_global_group()
+    if g.get_world_size() <= 1 or jax.process_count() == 1:
+        object_list.append(obj)
+        return object_list
+    import pickle
+    from jax.experimental import multihost_utils
+    data = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    # pad to max length across processes
+    n = np.asarray([data.size], np.int64)
+    sizes = multihost_utils.process_allgather(n).reshape(-1)
+    maxlen = int(sizes.max())
+    padded = np.zeros(maxlen, np.uint8)
+    padded[:data.size] = data
+    all_data = multihost_utils.process_allgather(padded)
+    for i, s in enumerate(sizes):
+        object_list.append(pickle.loads(bytes(np.asarray(all_data[i][:int(s)]))))
+    return object_list
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    g = group or _get_global_group()
+    if g.get_world_size() <= 1 or jax.process_count() == 1:
+        return tensor
+    from jax.experimental import multihost_utils
+    out = multihost_utils.broadcast_one_to_all(unwrap(tensor),
+                                               is_source=get_rank() == src)
+    tensor._data = out
+    return tensor
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    g = group or _get_global_group()
+    if g.get_world_size() <= 1 or jax.process_count() == 1:
+        return object_list
+    import pickle
+    from jax.experimental import multihost_utils
+    if get_rank() == src:
+        data = np.frombuffer(pickle.dumps(object_list), dtype=np.uint8)
+        size = np.asarray([data.size], np.int64)
+    else:
+        data = np.zeros(1, np.uint8)
+        size = np.asarray([0], np.int64)
+    size = multihost_utils.broadcast_one_to_all(size, is_source=get_rank() == src)
+    buf = np.zeros(int(size[0]), np.uint8)
+    if get_rank() == src:
+        buf[:] = data
+    buf = multihost_utils.broadcast_one_to_all(buf, is_source=get_rank() == src)
+    if get_rank() != src:
+        object_list[:] = pickle.loads(bytes(np.asarray(buf)))
+    return object_list
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = group or _get_global_group()
+    if g.get_world_size() <= 1 or jax.process_count() == 1:
+        if tensor_list:
+            tensor._data = unwrap(tensor_list[0])
+        return tensor
+    raise NotImplementedError("cross-process scatter: use sharded arrays / shard_map")
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    g = group or _get_global_group()
+    if g.get_world_size() <= 1 or jax.process_count() == 1:
+        out_tensor_list.extend(Tensor(unwrap(t)) for t in in_tensor_list)
+        return out_tensor_list
+    raise NotImplementedError("cross-process alltoall: use shard_map (EP layers do)")
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = group or _get_global_group()
+    if g.get_world_size() <= 1 or jax.process_count() == 1:
+        acc = unwrap(tensor_list[0])
+        for t in tensor_list[1:]:
+            acc = acc + unwrap(t)
+        tensor._data = acc
+        return tensor
+    raise NotImplementedError("cross-process reduce_scatter: use shard_map")
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv across processes is not a TPU-native primitive; "
+        "pipeline parallelism uses ppermute inside shard_map (see parallel/pipeline)")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv across processes is not a TPU-native primitive; "
+        "pipeline parallelism uses ppermute inside shard_map (see parallel/pipeline)")
+
+
+def barrier(group=None):
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+
+
+def all_reduce_grads(parameters, group=None):
+    for p in parameters:
+        if p.grad is not None:
+            all_reduce(p.grad, ReduceOp.SUM, group)
+            ws = (group or _get_global_group()).get_world_size()
+            if ws > 1:
+                p.grad._data = unwrap(p.grad) / ws
+
+
+# in-mesh collective helpers used by parallel layers under shard_map ----------
+def mesh_all_reduce(x, axis_name, op="sum"):
+    if op == "sum":
+        return jax.lax.psum(x, axis_name)
+    if op == "max":
+        return jax.lax.pmax(x, axis_name)
+    if op == "min":
+        return jax.lax.pmin(x, axis_name)
+    if op == "avg":
+        return jax.lax.pmean(x, axis_name)
+    raise ValueError(op)
+
+
+def mesh_all_gather(x, axis_name, axis=0):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+def mesh_reduce_scatter(x, axis_name, axis=0):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def mesh_all_to_all(x, axis_name, split_axis, concat_axis):
+    return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+
+
+def mesh_ppermute(x, axis_name, perm):
+    return jax.lax.ppermute(x, axis_name, perm)

@@ -1,0 +1,84 @@
+"""Process-level distributed environment.
+
+Reference: python/paddle/distributed/parallel.py (ParallelEnv, env vars
+PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM). On TPU, multi-host process bring-up is
+jax.distributed.initialize; within a host, devices are addressable directly.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+class ParallelEnv:
+    def __init__(self):
+        self.rank = int(os.environ.get("PADDLE_TRAINER_ID",
+                                       os.environ.get("RANK", "0")))
+        self.world_size = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                             os.environ.get("WORLD_SIZE", "1")))
+        self.device_id = int(os.environ.get("FLAGS_selected_tpus",
+                                            os.environ.get("LOCAL_RANK", "0")))
+        self.current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+        self.trainer_endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def local_rank(self):
+        return self.rank
+
+
+_parallel_env = None
+_initialized = False
+
+
+def _env() -> ParallelEnv:
+    global _parallel_env
+    if _parallel_env is None:
+        _parallel_env = ParallelEnv()
+    return _parallel_env
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.get_rank()
+    return _env().rank
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.get_world_size()
+    return _env().world_size
+
+
+def init_parallel_env():
+    """reference: distributed/parallel.py:978 init_parallel_env.
+
+    Multi-host: jax.distributed.initialize using the launcher-provided
+    coordinator address (the TCPStore analog is JAX's coordination service).
+    Single-host multi-device needs no process bring-up on TPU.
+    """
+    global _initialized
+    env = _env()
+    if _initialized:
+        return env
+    coord = os.environ.get("PADDLE_MASTER", os.environ.get("MASTER_ADDR"))
+    if env.world_size > 1 and coord:
+        port = os.environ.get("MASTER_PORT", "8476")
+        addr = coord if ":" in coord else f"{coord}:{port}"
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=env.world_size,
+                                   process_id=env.rank)
+    _initialized = True
+    return env
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def parallel_device_count() -> int:
+    return jax.device_count()

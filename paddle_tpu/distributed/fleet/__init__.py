@@ -1,0 +1,136 @@
+"""fleet facade (reference: python/paddle/distributed/fleet/fleet.py:218 Fleet.init,
+distributed_model fleet/model.py:32, distributed_optimizer fleet.py:1427).
+
+TPU-native: fleet.init builds the hybrid ProcessMesh; distributed_model/optimizer
+return mesh-aware wrappers whose math lowers to GSPMD collectives under jit.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .topology import (CommunicateTopology, HybridCommunicateGroup,
+                       set_hybrid_communicate_group, get_hybrid_communicate_group)
+from ..env import get_rank, get_world_size, init_parallel_env
+from . import topology  # noqa: F401
+
+
+class DistributedStrategy:
+    """reference: fleet/base/distributed_strategy.py (protobuf-backed there;
+    plain attrs here)."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+        }
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+        self.find_unused_parameters = False
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+
+
+class Fleet:
+    def __init__(self):
+        self._strategy = None
+        self._hcg = None
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+        self._strategy = strategy or DistributedStrategy()
+        init_parallel_env()
+        hc = self._strategy.hybrid_configs
+        dims = [hc.get("dp_degree", 1), hc.get("pp_degree", 1),
+                hc.get("sharding_degree", 1), hc.get("sep_degree", 1),
+                hc.get("mp_degree", 1)]
+        total = int(np.prod(dims))
+        import jax
+        n_dev = jax.device_count() * max(1, get_world_size() // max(jax.process_count(), 1))
+        n_dev = max(jax.device_count(), get_world_size())
+        if total == 1 and n_dev > 1:
+            dims[0] = n_dev  # default: pure DP over all devices
+            total = n_dev
+        topo = CommunicateTopology(["dp", "pp", "sharding", "sep", "mp"], dims)
+        self._hcg = HybridCommunicateGroup(topo)
+        set_hybrid_communicate_group(self._hcg)
+        self._is_initialized = True
+        return self
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    @property
+    def worker_index(self):
+        return get_rank()
+
+    def worker_num(self):
+        return get_world_size()
+
+    def is_first_worker(self):
+        return get_rank() == 0
+
+    def barrier_worker(self):
+        from ..collective import barrier
+        barrier()
+
+    def distributed_model(self, model):
+        """reference: fleet/model.py:32 — picks the wrapper by topology."""
+        hc = self._hcg
+        if hc.get_pipe_parallel_world_size() > 1:
+            from ...parallel.pipeline_layer import PipelineParallel
+            return PipelineParallel(model, hc, self._strategy)
+        if hc.get_model_parallel_world_size() > 1 or hc.get_sep_parallel_world_size() > 1:
+            from ...parallel.tensor_parallel import TensorParallel
+            return TensorParallel(model, hc, self._strategy)
+        if hc.get_data_parallel_world_size() > 1:
+            from ..parallel import DataParallel
+            return DataParallel(model)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        from .hybrid_optimizer import HybridParallelOptimizer
+        return HybridParallelOptimizer(optimizer, self._hcg,
+                                       strategy or self._strategy)
+
+
+fleet = Fleet()
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+get_hybrid_communicate_group = get_hybrid_communicate_group
+worker_index = lambda: get_rank()  # noqa: E731
+worker_num = lambda: get_world_size()  # noqa: E731
+
+
+class UserDefinedRoleMaker:
+    def __init__(self, *a, **k):
+        pass
+
+
+class PaddleCloudRoleMaker:
+    """reference: fleet/base/role_maker.py:548 — env-derived roles."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+
+    def worker_index(self):
+        return get_rank()
+
+    def worker_num(self):
+        return get_world_size()
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False

@@ -1,0 +1,65 @@
+"""HybridParallelOptimizer + GradScaler hook (reference:
+fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:266,
+fleet/scaler.py:28).
+
+On TPU the mp/pp-aware grad-clip subtleties (partial norms per shard) are
+handled by computing the global norm over the full (sharded) arrays — GSPMD
+reduces across shards inside jit, so the reference's per-group norm allreduce
+disappears.
+"""
+from __future__ import annotations
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner_opt"], name)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero=False):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        return self._inner_opt.minimize(loss)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+
+class HybridParallelGradScaler:
+    """reference: fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_gradscaler.py."""
+
+    def __init__(self, scaler, hcg=None):
+        self._scaler = scaler
+        self._hcg = hcg
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_scaler"], name)
+
+    def scale(self, var):
+        return self._scaler.scale(var)
+
+    def step(self, optimizer):
+        inner = getattr(optimizer, "_inner_opt", optimizer)
+        return self._scaler.step(inner)
+
+    def minimize(self, optimizer, scaled_loss):
+        inner = getattr(optimizer, "_inner_opt", optimizer)
+        return self._scaler.minimize(inner, scaled_loss)
+
+
+def distributed_scaler(scaler):
+    """reference: fleet/scaler.py:28."""
+    from .topology import get_hybrid_communicate_group
+    return HybridParallelGradScaler(scaler, get_hybrid_communicate_group())

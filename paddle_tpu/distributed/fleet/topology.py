@@ -1,0 +1,166 @@
+"""Hybrid ND topology (reference: python/paddle/distributed/fleet/base/
+topology.py — CommunicateTopology:70, HybridCommunicateGroup:189).
+
+TPU-native: the topology IS a jax.sharding.Mesh with named axes
+[dp, pp, sharding, sep, mp] (reference axis order topology.py:199). Axis groups
+become submeshes; collectives ride ICI via GSPMD/shard_map instead of NCCL rings.
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import jax
+
+from ..auto_parallel.api import ProcessMesh
+
+_HYBRID_AXES = ["dp", "pp", "sharding", "sep", "mp"]
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=None, dims=None):
+        self._parallel_names = list(hybrid_group_names or _HYBRID_AXES)
+        self._dims = list(dims or [1] * len(self._parallel_names))
+        self.coordinate = list(itertools.product(*(range(d) for d in self._dims)))
+        self._world = int(np.prod(self._dims))
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[n] for n in self._parallel_names)
+        return self.coordinate.index(coord)
+
+    def get_coord(self, rank):
+        return dict(zip(self._parallel_names, self.coordinate[rank]))
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        return [r for r, c in enumerate(self.coordinate) if c[axis] == index]
+
+    def get_comm_list(self, axis_name):
+        """All groups along axis_name (each = ranks varying only in that axis)."""
+        axis = self._parallel_names.index(axis_name)
+        groups = {}
+        for r, c in enumerate(self.coordinate):
+            key = c[:axis] + c[axis + 1:]
+            groups.setdefault(key, []).append(r)
+        return list(groups.values())
+
+
+class HybridCommunicateGroup:
+    """reference: topology.py:189 — exposes per-axis rank/world-size/group plus
+    the underlying ProcessMesh for GSPMD use."""
+
+    def __init__(self, topology: CommunicateTopology, rank=None):
+        from ..env import get_rank
+        self._topo = topology
+        self.global_rank = rank if rank is not None else get_rank()
+        self.nranks = topology.world_size()
+        names = topology.get_hybrid_group_names()
+        dims = [topology.get_dim(n) for n in names]
+        ids = np.arange(self.nranks).reshape(dims)
+        self._mesh = ProcessMesh(ids, names)
+        self._coord = topology.get_coord(self.global_rank) if self.nranks > 1 else \
+            {n: 0 for n in names}
+
+    # -- mesh access (TPU-native path) --
+    def get_mesh(self) -> ProcessMesh:
+        return self._mesh
+
+    def topology(self):
+        return self._topo
+
+    # -- per-axis accessors (reference API) --
+    def _axis(self, name):
+        return self._coord.get(name, 0)
+
+    def get_data_parallel_rank(self):
+        return self._axis("dp")
+
+    def get_data_parallel_world_size(self):
+        return self._topo.get_dim("dp")
+
+    def get_model_parallel_rank(self):
+        return self._axis("mp")
+
+    def get_model_parallel_world_size(self):
+        return self._topo.get_dim("mp")
+
+    def get_stage_id(self):
+        return self._axis("pp")
+
+    def get_pipe_parallel_rank(self):
+        return self._axis("pp")
+
+    def get_pipe_parallel_world_size(self):
+        return self._topo.get_dim("pp")
+
+    def get_sharding_parallel_rank(self):
+        return self._axis("sharding")
+
+    def get_sharding_parallel_world_size(self):
+        return self._topo.get_dim("sharding")
+
+    def get_sep_parallel_rank(self):
+        return self._axis("sep")
+
+    def get_sep_parallel_world_size(self):
+        return self._topo.get_dim("sep")
+
+    # group objects (rank lists; collectives ride the mesh)
+    def _group(self, name):
+        from ..collective import new_group
+        idx_axes = {n: self._axis(n) for n in self._topo.get_hybrid_group_names()
+                    if n != name}
+        ranks = [r for r in range(self.nranks)
+                 if all(self._topo.get_coord(r)[k] == v for k, v in idx_axes.items())]
+        return new_group(ranks)
+
+    def get_data_parallel_group(self):
+        return self._group("dp")
+
+    def get_model_parallel_group(self):
+        return self._group("mp")
+
+    def get_pipe_parallel_group(self):
+        return self._group("pp")
+
+    def get_sharding_parallel_group(self):
+        return self._group("sharding")
+
+    def get_sep_parallel_group(self):
+        return self._group("sep")
+
+    def get_check_parallel_group(self, *a):
+        return self._group("mp")
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        coord = dict(self._coord)
+        coord["pp"] = stage_id
+        coord.update(kwargs)
+        return self._topo.get_rank(**coord)
+
+
+_hcg: HybridCommunicateGroup | None = None
+
+
+def set_hybrid_communicate_group(hcg):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    global _hcg
+    if _hcg is None:
+        topo = CommunicateTopology(dims=[1, 1, 1, 1, 1])
+        _hcg = HybridCommunicateGroup(topo)
+    return _hcg

@@ -1,0 +1,47 @@
+"""DataParallel wrapper (reference: python/paddle/distributed/parallel.py:219 +
+EagerReducer fluid/distributed/collective/reducer.h:88).
+
+TPU-native story: under jit, gradients of a batch-sharded loss are reduced by
+GSPMD automatically — no bucketed allreduce needed. Eagerly (multi-process),
+grad hooks run psum via the collective API after backward.
+"""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+from .env import get_world_size
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False, group=None):
+        super().__init__()
+        self._layers = layers
+        self.group = group
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        """Average grads across data-parallel ranks (explicit eager path)."""
+        ws = get_world_size(self.group)
+        if ws <= 1:
+            return
+        from .collective import all_reduce_grads
+        all_reduce_grads(self.parameters(), group=self.group)
+
+    # delegate attribute access to the wrapped model
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_layers"], name)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)

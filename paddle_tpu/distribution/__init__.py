@@ -1,1 +1,2 @@
-"""placeholder — filled in during round 1 build."""
+"""paddle.distribution (reference: python/paddle/distribution, 9.3k LoC).
+Normal/Uniform/Categorical etc. land later this round."""

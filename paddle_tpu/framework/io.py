@@ -1,0 +1,73 @@
+"""paddle.save / paddle.load (reference: python/paddle/framework/io.py:773,1020).
+
+Format: pickle with Tensors materialized as numpy arrays + a dtype tag so
+bfloat16 round-trips. Compatible surface: state_dicts, nested containers,
+plain Tensors, optimizer state.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes  # noqa: F401 — registers bfloat16 with numpy
+
+from ..core.tensor import Tensor, Parameter
+
+_PROTO = 4
+_MAGIC = b"PTPU1\n"
+
+
+class _TensorPayload:
+    __slots__ = ("array", "is_param", "name")
+
+    def __init__(self, array, is_param, name):
+        self.array = array
+        self.is_param = is_param
+        self.name = name
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._data), isinstance(obj, Parameter), obj.name)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        t = Parameter(jnp.asarray(obj.array), name=obj.name) if obj.is_param \
+            else Tensor(jnp.asarray(obj.array), name=obj.name)
+        if obj.is_param:
+            t.persistable = True
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=_PROTO, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    return_numpy = configs.get("return_numpy", False)
+    with open(path, "rb") as f:
+        head = f.read(len(_MAGIC))
+        if head != _MAGIC:
+            f.seek(0)  # tolerate plain-pickle files
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy)

@@ -1,1 +1,1 @@
-"""placeholder — filled in during round 1 build."""
+from .model import Model, summary, flops  # noqa: F401

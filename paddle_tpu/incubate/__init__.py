@@ -1,1 +1,3 @@
-"""placeholder — filled in during round 1 build."""
+"""paddle.incubate (reference: python/paddle/incubate) — fused layers + MoE.
+Fused transformer/MoE surfaces land with the parallel layer library."""
+from . import nn  # noqa: F401

@@ -1,0 +1,4 @@
+"""incubate.nn fused layers: on TPU, 'fused' == XLA-fused; these re-export the
+standard layers whose dispatch already fuses under jit (SURVEY §2.1 fused ops)."""
+from ...nn.layer.transformer import MultiHeadAttention as FusedMultiHeadAttention  # noqa: F401
+from ...nn.layer.transformer import TransformerEncoderLayer as FusedTransformerEncoderLayer  # noqa: F401

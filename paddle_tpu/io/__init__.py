@@ -1,1 +1,411 @@
-"""placeholder — filled in during round 1 build."""
+"""paddle.io — datasets, samplers, DataLoader (reference: python/paddle/io/).
+
+DataLoader supports num_workers>0 via multiprocessing (reference: io/dataloader/
+dataloader_iter.py _worker_loop) with prefetching; batches land as Tensors on the
+default device (host→HBM transfer overlapped by JAX's async dispatch).
+"""
+from __future__ import annotations
+
+import itertools
+import math
+import multiprocessing as mp
+import queue as queue_mod
+import threading
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.rng import default_generator
+
+
+class Dataset:
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise RuntimeError("IterableDataset has no __getitem__")
+
+    def __len__(self):
+        raise RuntimeError("IterableDataset has no __len__")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors):
+        self.tensors = tensors
+
+    def __getitem__(self, idx):
+        return tuple(t[idx] for t in self.tensors)
+
+    def __len__(self):
+        return self.tensors[0].shape[0]
+
+
+class ComposeDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __len__(self):
+        return min(len(d) for d in self.datasets)
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            sample = d[idx]
+            out.extend(sample if isinstance(sample, (list, tuple)) else [sample])
+        return tuple(out)
+
+
+class ChainDataset(IterableDataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        for d in self.datasets:
+            yield from d
+
+
+class ConcatDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        self.cumulative_sizes = list(itertools.accumulate(len(d) for d in self.datasets))
+
+    def __len__(self):
+        return self.cumulative_sizes[-1]
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        import bisect
+        di = bisect.bisect_right(self.cumulative_sizes, idx)
+        prev = self.cumulative_sizes[di - 1] if di > 0 else 0
+        return self.datasets[di][idx - prev]
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset, self.indices = dataset, list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset, lengths, generator=None):
+    n = len(dataset)
+    lengths = list(lengths)
+    if all(isinstance(l, float) for l in lengths) and abs(sum(lengths) - 1.0) < 1e-6:
+        sizes = [int(math.floor(n * l)) for l in lengths]
+        for i in range(n - sum(sizes)):
+            sizes[i % len(sizes)] += 1
+        lengths = sizes
+    if sum(lengths) != n:
+        raise ValueError("sum of lengths must equal dataset size")
+    rng = np.random.RandomState(generator.initial_seed() if generator else None)
+    perm = rng.permutation(n)
+    out, offset = [], 0
+    for l in lengths:
+        out.append(Subset(dataset, perm[offset:offset + l].tolist()))
+        offset += l
+    return out
+
+
+# ---- samplers ----------------------------------------------------------------
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None, generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+        self.generator = generator
+
+    @property
+    def num_samples(self):
+        return self._num_samples if self._num_samples is not None else len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        seed = int(np.random.randint(0, 2 ** 31 - 1)) if self.generator is None \
+            else self.generator.initial_seed()
+        rng = np.random.RandomState(seed)
+        if self.replacement:
+            yield from rng.randint(0, n, self.num_samples).tolist()
+        else:
+            yield from rng.permutation(n)[:self.num_samples].tolist()
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True):
+        self.weights = np.asarray(weights, np.float64)
+        self.num_samples = num_samples
+        self.replacement = replacement
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        idx = np.random.choice(len(self.weights), self.num_samples,
+                               replace=self.replacement, p=p)
+        yield from idx.tolist()
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    def __init__(self, dataset=None, sampler=None, shuffle=False, batch_size=1,
+                 drop_last=False):
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        if sampler is not None:
+            self.sampler = sampler
+        elif shuffle:
+            self.sampler = RandomSampler(dataset)
+        else:
+            self.sampler = SequenceSampler(dataset)
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        return n // self.batch_size if self.drop_last else (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Shards the sample space across data-parallel ranks (reference:
+    io/dataloader/batch_sampler.py DistributedBatchSampler)."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None, shuffle=False,
+                 drop_last=False):
+        from ..distributed import get_world_size, get_rank
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.nranks = num_replicas if num_replicas is not None else get_world_size()
+        self.local_rank = rank if rank is not None else get_rank()
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.num_samples = int(math.ceil(len(dataset) / self.nranks))
+        self.total_size = self.num_samples * self.nranks
+
+    def __iter__(self):
+        n = len(self.dataset)
+        indices = np.arange(n)
+        if self.shuffle:
+            rng = np.random.RandomState(self.epoch)
+            rng.shuffle(indices)
+        indices = np.concatenate([indices, indices[:self.total_size - n]])
+        indices = indices[self.local_rank::self.nranks].tolist()
+        batch = []
+        for idx in indices:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+
+# ---- collate -----------------------------------------------------------------
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(s._data) for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, np.integer)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, float):
+        return Tensor(np.asarray(batch, np.float32))
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return [default_collate_fn(list(items)) for items in transposed]
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    raise TypeError(f"cannot collate type {type(sample)}")
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id, seed):
+    """reference: io/dataloader/dataloader_iter.py:460 _worker_loop."""
+    np.random.seed(seed + worker_id)
+    while True:
+        task = index_queue.get()
+        if task is None:
+            break
+        batch_id, indices = task
+        try:
+            samples = [dataset[i] for i in indices]
+            data = collate_fn(samples)
+            data = _to_numpy_tree(data)
+            data_queue.put((batch_id, data, None))
+        except Exception as e:  # propagate worker errors to the main process
+            data_queue.put((batch_id, None, e))
+
+
+def _to_numpy_tree(data):
+    if isinstance(data, Tensor):
+        return np.asarray(data._data)
+    if isinstance(data, (list, tuple)):
+        return type(data)(_to_numpy_tree(d) for d in data)
+    if isinstance(data, dict):
+        return {k: _to_numpy_tree(v) for k, v in data.items()}
+    return data
+
+
+def _to_tensor_tree(data):
+    if isinstance(data, np.ndarray):
+        return Tensor(data)
+    if isinstance(data, (list, tuple)):
+        return type(data)(_to_tensor_tree(d) for d in data)
+    if isinstance(data, dict):
+        return {k: _to_tensor_tree(v) for k, v in data.items()}
+    return data
+
+
+class DataLoader:
+    """reference: python/paddle/io/reader.py:262."""
+
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
+                 collate_fn=None, num_workers=0, use_buffer_reader=True,
+                 prefetch_factor=2, use_shared_memory=True, timeout=60,
+                 worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self.timeout = timeout
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size or 1,
+                                              drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if self._iterable:
+            yield from self._iter_iterable()
+        elif self.num_workers == 0:
+            yield from self._iter_sync()
+        else:
+            yield from self._iter_workers()
+
+    def _iter_iterable(self):
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if self.batch_size is not None and len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self.collate_fn(batch)
+
+    def _iter_sync(self):
+        for indices in self.batch_sampler:
+            yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def _iter_workers(self):
+        ctx = mp.get_context("fork")
+        index_queues = [ctx.Queue() for _ in range(self.num_workers)]
+        data_queue = ctx.Queue()
+        seed = int(np.random.randint(0, 2 ** 31 - 1))
+        workers = []
+        for wid in range(self.num_workers):
+            w = ctx.Process(target=_worker_loop,
+                            args=(self.dataset, index_queues[wid], data_queue,
+                                  self.collate_fn, wid, seed), daemon=True)
+            w.start()
+            workers.append(w)
+        try:
+            batches = list(self.batch_sampler)
+            inflight = {}
+            next_submit = 0
+            next_yield = 0
+            max_inflight = self.num_workers * self.prefetch_factor
+            reorder = {}
+            while next_yield < len(batches):
+                while next_submit < len(batches) and len(inflight) < max_inflight:
+                    wid = next_submit % self.num_workers
+                    index_queues[wid].put((next_submit, batches[next_submit]))
+                    inflight[next_submit] = wid
+                    next_submit += 1
+                if next_yield in reorder:
+                    yield _to_tensor_tree(reorder.pop(next_yield))
+                    next_yield += 1
+                    continue
+                bid, data, err = data_queue.get(timeout=self.timeout)
+                if err is not None:
+                    raise RuntimeError(f"DataLoader worker failed on batch {bid}") from err
+                inflight.pop(bid, None)
+                if bid == next_yield:
+                    yield _to_tensor_tree(data)
+                    next_yield += 1
+                else:
+                    reorder[bid] = data
+        finally:
+            for q in index_queues:
+                q.put(None)
+            for w in workers:
+                w.join(timeout=1)
+                if w.is_alive():
+                    w.terminate()
+
+    def __call__(self):
+        return iter(self)
+
+
+def get_worker_info():
+    return None

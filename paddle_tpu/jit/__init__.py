@@ -1,1 +1,136 @@
-"""placeholder — filled in during round 1 build."""
+"""paddle.jit — to_static capture + save/load export (reference:
+python/paddle/jit/api.py — to_static:197, save:956, load:1527).
+
+Export format: jax.export serialized StableHLO (portable, version-stamped) +
+pickled params — the PIR-serialization analog (SURVEY §2.2). A loaded artifact
+is a TranslatedLayer-style predictor.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .to_static import to_static, not_to_static, StaticFunction  # noqa: F401
+from ..core.tensor import Tensor
+from ..core.dispatch import unwrap
+
+ignore_module = lambda *a, **k: None  # noqa: E731 — SOT-only concept
+
+
+def enable_to_static(flag=True):
+    pass
+
+
+class InputSpec:
+    """reference: python/paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape=None, dtype="float32", name=None, stop_gradient=True):
+        from ..core.dtype import convert_dtype
+        self.shape = list(shape) if shape is not None else None
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name or tensor.name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def _example_from_spec(spec: InputSpec):
+    shape = [1 if (s is None or s < 0) else s for s in (spec.shape or [1])]
+    return Tensor(jnp.zeros(shape, spec.dtype))
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save — export forward as serialized StableHLO + params."""
+    from ..nn.layer.layers import Layer
+    from jax import export as jexport
+
+    if isinstance(layer, Layer):
+        fwd = layer.forward
+        fn = fwd.function if isinstance(fwd, StaticFunction) else fwd
+        params = {k: np.asarray(unwrap(v)) for k, v in layer.state_dict().items()}
+        layer.eval()
+        names = list(layer.state_dict().keys())
+        tensors = [layer.state_dict()[k] for k in names]
+
+        def pure(param_arrays, *input_arrays):
+            # bind params by temporarily swapping buffers
+            saved = [t._buf for t in tensors]
+            for t, a in zip(tensors, param_arrays):
+                t._buf = a
+            try:
+                ins = [Tensor(a) for a in input_arrays]
+                out = fn(*ins)
+            finally:
+                for t, s in zip(tensors, saved):
+                    t._buf = s
+            leaves = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, Tensor))[0]
+            return tuple(l._data if isinstance(l, Tensor) else l for l in leaves)
+    else:
+        fn = layer.function if isinstance(layer, StaticFunction) else layer
+        params = {}
+        tensors, names = [], []
+
+        def pure(param_arrays, *input_arrays):
+            ins = [Tensor(a) for a in input_arrays]
+            out = fn(*ins)
+            leaves = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, Tensor))[0]
+            return tuple(l._data if isinstance(l, Tensor) else l for l in leaves)
+
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (shape/dtype of inputs)")
+    examples = [(_example_from_spec(s) if isinstance(s, InputSpec) else s)._data
+                for s in input_spec]
+    param_arrays = [np.asarray(unwrap(t)) for t in tensors]
+    exported = jexport.export(jax.jit(pure))(param_arrays, *examples)
+    blob = exported.serialize()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(blob)
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump({"params": params, "names": names,
+                     "input_spec": [(list(s.shape) if s.shape else None,
+                                     np.dtype(s.dtype).name) for s in input_spec]}, f)
+
+
+class TranslatedLayer:
+    """Loaded inference artifact (reference: jit/translated_layer.py)."""
+
+    def __init__(self, exported, params, names):
+        self._exported = exported
+        self._param_arrays = [jnp.asarray(params[n]) for n in names]
+
+    def __call__(self, *inputs):
+        arrays = [unwrap(i) if isinstance(i, Tensor) else jnp.asarray(np.asarray(i))
+                  for i in inputs]
+        outs = self._exported.call(self._param_arrays, *arrays)
+        outs = [Tensor(o) for o in outs]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("a jit.load'ed artifact is inference-only")
+
+
+def load(path, **configs):
+    """paddle.jit.load — deserialize the exported program."""
+    from jax import export as jexport
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(bytearray(f.read()))
+    with open(path + ".pdiparams", "rb") as f:
+        meta = pickle.load(f)
+    return TranslatedLayer(exported, meta["params"], meta["names"])

@@ -1,0 +1,312 @@
+"""Program capture — paddle.jit.to_static (reference: python/paddle/jit/api.py:197
++ the SOT bytecode frontend python/paddle/jit/sot/).
+
+TPU-native redesign: instead of CPython bytecode simulation, capture exploits the
+framework's trace-transparent eager core (every op goes through one dispatch
+chokepoint; Tensor state reads/writes go through properties):
+
+  call 1 (SPY)    — runs eagerly at full fidelity while recording which external
+                    tensors the function READS (params, buffers, optimizer
+                    moments, RNG key) and which it WRITES (param update, moment
+                    update, key split, .grad assignment).
+  call 2+ (REPLAY)— a pure jax function (args, mutated-state, readonly-state) ->
+                    (outputs, new-state), jit-compiled with donation of the
+                    mutated state buffers; re-runs the SAME python under tracers
+                    with shadowed writes. One fused XLA program = fwd + bwd +
+                    optimizer step.
+
+Guards: arg treedef + shapes/dtypes + static-arg values (the SOT guard analog) —
+a new signature re-traces. Graph breaks: TracerBoolConversionError /
+ConcretizationTypeError (data-dependent python control flow) or capture misses
+mark the signature eager-only — the SOT graph-break fallback analog. Shapes are
+static per signature; variable seq-len is handled by bucketing above (SURVEY §7).
+"""
+from __future__ import annotations
+
+import functools
+import logging
+
+import numpy as np
+import jax
+
+from ..core.tensor import Tensor
+from ..core.dispatch import _state
+
+logger = logging.getLogger("paddle_tpu.jit")
+
+_BREAKS = (jax.errors.TracerBoolConversionError,
+           jax.errors.ConcretizationTypeError,
+           jax.errors.TracerArrayConversionError,
+           jax.errors.TracerIntegerConversionError)
+
+
+class MissedCapture(Exception):
+    pass
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+class _SpyContext:
+    """Eager pass-through that records external reads + writes."""
+
+    mode = "spy"
+
+    def __init__(self):
+        self.reads: dict[int, Tensor] = {}
+        self.writes: dict[int, Tensor] = {}
+        self.grad_writes: dict[int, Tensor] = {}
+        self.created: set[int] = set()
+
+    def on_create(self, t):
+        self.created.add(id(t))
+
+    def on_read(self, t):
+        if id(t) not in self.created:
+            self.reads.setdefault(id(t), t)
+        return t._buf
+
+    def on_write(self, t, value):
+        if id(t) not in self.created:
+            self.writes.setdefault(id(t), t)
+        t._buf = value
+
+    def on_grad_read(self, t):
+        return t._grad_buf
+
+    def on_grad_write(self, t, value):
+        if id(t) not in self.created:
+            self.grad_writes.setdefault(id(t), t)
+        t._grad_buf = value
+
+
+class _ReplayContext:
+    """Pure traced re-execution: reads hit lifted tracers, writes go to shadows."""
+
+    mode = "replay"
+
+    def __init__(self, lifted: dict[int, object]):
+        self.values = lifted                  # id(Tensor) -> traced array
+        self.data_shadow: dict[int, object] = {}
+        self.grad_shadow: dict[int, object] = {}
+
+    def on_create(self, t):
+        pass
+
+    def on_read(self, t):
+        k = id(t)
+        if k in self.data_shadow:
+            return self.data_shadow[k]
+        if k in self.values:
+            return self.values[k]
+        buf = t._buf
+        if isinstance(buf, jax.core.Tracer):
+            return buf
+        if t.persistable:
+            raise MissedCapture(
+                f"persistable tensor {t.name or id(t)!r} read during replay was "
+                "not captured in the spy pass")
+        return buf  # non-persistable external tensor: embed as constant
+
+    def on_write(self, t, value):
+        self.data_shadow[id(t)] = value
+
+    def on_grad_read(self, t):
+        k = id(t)
+        if k in self.grad_shadow:
+            v = self.grad_shadow[k]
+            if v is None or isinstance(v, Tensor):
+                return v
+            return Tensor(v)
+        return t._grad_buf
+
+    def on_grad_write(self, t, value):
+        self.grad_shadow[id(t)] = value
+
+    def resolve_tensor(self, t):
+        """Current traced value of a Tensor inside this replay."""
+        return self.on_read(t)
+
+
+class _CacheEntry:
+    __slots__ = ("compiled", "mut_list", "ro_list", "write_list", "grad_list",
+                 "out_treedef", "out_mask", "eager_only", "treedef")
+
+    def __init__(self):
+        self.compiled = None
+        self.eager_only = False
+
+
+def _sig_key(leaves, treedef):
+    parts = [str(treedef)]
+    for l in leaves:
+        if isinstance(l, Tensor):
+            parts.append(
+                f"T{tuple(l._buf.shape)}:{np.dtype(l._buf.dtype).name}:{l.stop_gradient}")
+        else:
+            try:
+                parts.append(f"S{hash(l)}")
+            except TypeError:
+                parts.append(f"S{repr(l)}")
+    return "|".join(parts)
+
+
+class StaticFunction:
+    def __init__(self, function, input_spec=None, build_strategy=None, backend=None,
+                 full_graph=False, donate_state=True):
+        self._fn = function
+        self._cache: dict[str, _CacheEntry] = {}
+        self._donate = donate_state
+        try:
+            functools.update_wrapper(self, function)
+        except AttributeError:
+            pass
+
+    @property
+    def function(self):
+        return self._fn
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        return functools.partial(self.__call__, instance)
+
+    def __call__(self, *args, **kwargs):
+        if _state.trace_ctx is not None:
+            return self._fn(*args, **kwargs)  # nested capture: inline
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+        key = _sig_key(leaves, treedef)
+        entry = self._cache.get(key)
+        if entry is None:
+            return self._spy(key, leaves, treedef)
+        if entry.eager_only:
+            return self._fn(*args, **kwargs)
+        try:
+            return self._run(entry, leaves)
+        except MissedCapture:
+            logger.warning("to_static: capture miss; re-tracing")
+            del self._cache[key]
+            return self._spy(key, leaves, treedef)
+
+    # ---- pass 1: eager spy ---------------------------------------------------
+    def _spy(self, key, leaves, treedef):
+        ctx = _SpyContext()
+        prev = _state.trace_ctx
+        _state.trace_ctx = ctx
+        try:
+            args, kwargs = jax.tree_util.tree_unflatten(treedef, leaves)
+            result = self._fn(*args, **kwargs)
+        finally:
+            _state.trace_ctx = prev
+        entry = _CacheEntry()
+        entry.treedef = treedef
+        arg_ids = {id(l) for l in leaves if isinstance(l, Tensor)}
+        write_ids = set(ctx.writes)
+        reads = [t for k, t in ctx.reads.items()
+                 if k not in arg_ids and hasattr(t._buf, "dtype")]
+        entry.mut_list = [t for t in reads if id(t) in write_ids]
+        entry.ro_list = [t for t in reads if id(t) not in write_ids]
+        entry.write_list = [t for k, t in ctx.writes.items() if k not in arg_ids]
+        entry.grad_list = list(ctx.grad_writes.values())
+        self._cache[key] = entry
+        try:
+            self._compile(entry, leaves)
+        except _BREAKS as e:
+            logger.info("to_static: graph break (%s); signature stays eager",
+                        type(e).__name__)
+            entry.eager_only = True
+        except MissedCapture as e:
+            logger.info("to_static: %s; signature stays eager", e)
+            entry.eager_only = True
+        return result
+
+    # ---- build + jit the pure function --------------------------------------
+    def _compile(self, entry, leaves):
+        fn = self._fn
+        treedef = entry.treedef
+        tensor_pos = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+        arg_meta = [(leaves[i].stop_gradient, leaves[i].name) for i in tensor_pos]
+
+        def pure_fn(arg_arrays, mut_arrays, ro_arrays):
+            new_leaves = list(leaves)
+            lifted: dict[int, object] = {}
+            for j, i in enumerate(tensor_pos):
+                sg, nm = arg_meta[j]
+                t = Tensor(arg_arrays[j], stop_gradient=sg, name=nm)
+                new_leaves[i] = t
+                lifted[id(leaves[i])] = arg_arrays[j]  # closure reads of arg objs
+            for t, arr in zip(entry.mut_list, mut_arrays):
+                lifted[id(t)] = arr
+            for t, arr in zip(entry.ro_list, ro_arrays):
+                lifted[id(t)] = arr
+            ctx = _ReplayContext(lifted)
+            prev = _state.trace_ctx
+            _state.trace_ctx = ctx
+            try:
+                args, kwargs = jax.tree_util.tree_unflatten(treedef, new_leaves)
+                result = fn(*args, **kwargs)
+                out_leaves, out_treedef = jax.tree_util.tree_flatten(
+                    result, is_leaf=_is_tensor)
+                out_mask = [isinstance(l, Tensor) for l in out_leaves]
+                out_vals = [ctx.resolve_tensor(l) if isinstance(l, Tensor) else l
+                            for l in out_leaves]
+                write_out = [ctx.data_shadow.get(id(t), t._buf)
+                             for t in entry.write_list]
+                grad_out = []
+                for t in entry.grad_list:
+                    g = ctx.grad_shadow.get(id(t), t._grad_buf)
+                    if isinstance(g, Tensor):
+                        g = ctx.resolve_tensor(g)
+                    grad_out.append(g)
+            finally:
+                _state.trace_ctx = prev
+            entry.out_treedef = out_treedef
+            entry.out_mask = out_mask
+            return out_vals, write_out, grad_out
+
+        donate = (1,) if self._donate and entry.mut_list else ()
+        arg_arrays = [leaves[i]._buf for i in tensor_pos]
+        mut_arrays = [t._buf for t in entry.mut_list]
+        ro_arrays = [t._buf for t in entry.ro_list]
+        # abstract trace now: surfaces graph breaks + fills out_treedef/out_mask
+        jax.eval_shape(pure_fn, arg_arrays, mut_arrays, ro_arrays)
+        entry.compiled = jax.jit(pure_fn, donate_argnums=donate)
+
+    def _run(self, entry, leaves):
+        tensor_pos = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+        arg_arrays = [leaves[i]._buf for i in tensor_pos]
+        mut_arrays = [t._buf for t in entry.mut_list]
+        ro_arrays = [t._buf for t in entry.ro_list]
+        out_vals, write_out, grad_out = entry.compiled(arg_arrays, mut_arrays, ro_arrays)
+        for t, arr in zip(entry.write_list, write_out):
+            t._buf = arr
+        for t, g in zip(entry.grad_list, grad_out):
+            t._grad_buf = Tensor(g) if g is not None and not isinstance(g, Tensor) else g
+        out_leaves = [Tensor(v) if m else v
+                      for v, m in zip(out_vals, entry.out_mask)]
+        return jax.tree_util.tree_unflatten(entry.out_treedef, out_leaves)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              full_graph=False, **kwargs):
+    """paddle.jit.to_static decorator/wrapper."""
+    def wrap(f):
+        if isinstance(f, StaticFunction):
+            return f
+        from ..nn.layer.layers import Layer
+        if isinstance(f, Layer):
+            layer = f
+            sf = StaticFunction(layer.forward, input_spec)
+            layer.forward = sf
+            layer._static_function = sf
+            return layer
+        return StaticFunction(f, input_spec)
+    if function is not None:
+        return wrap(function)
+    return wrap
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn

@@ -1,1 +1,1 @@
-"""placeholder — filled in during round 1 build."""
+"""Model zoo: flagship configs from BASELINE.md (GPT-2, Llama-3, MoE)."""

@@ -24,6 +24,9 @@ class LRScheduler:
         else:
             self.last_epoch = epoch
         self.last_lr = self.get_lr()
+        for opt in getattr(self, "_bound_opts", ()):
+            import jax.numpy as jnp
+            opt._lr_t._data = jnp.asarray(float(self.last_lr), jnp.float32)
         if self.verbose:
             print(f"Epoch {self.last_epoch}: lr set to {self.last_lr}")
 
@@ -211,6 +214,9 @@ class ReduceOnPlateau(LRScheduler):
                 self.last_lr = new_lr
             self.cooldown_counter = self.cooldown
             self.num_bad_epochs = 0
+        for opt in getattr(self, "_bound_opts", ()):
+            import jax.numpy as jnp
+            opt._lr_t._data = jnp.asarray(float(self.last_lr), jnp.float32)
 
     def get_lr(self):
         return self.last_lr

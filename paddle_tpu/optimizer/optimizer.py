@@ -22,6 +22,16 @@ class Optimizer:
         from .lr import LRScheduler
         self._lr = learning_rate
         self._lr_scheduler = learning_rate if isinstance(learning_rate, LRScheduler) else None
+        # LR lives in a persistable Tensor so a captured train step reads it as a
+        # program input (scheduler.step() outside the capture updates it) instead
+        # of baking the first step's float as a constant.
+        lr0 = float(self._lr_scheduler()) if self._lr_scheduler is not None else float(learning_rate)
+        self._lr_t = Tensor(jnp.asarray(lr0, jnp.float32), persistable=True)
+        self._lr_t.name = "learning_rate"
+        if self._lr_scheduler is not None:
+            if not hasattr(self._lr_scheduler, "_bound_opts"):
+                self._lr_scheduler._bound_opts = []
+            self._lr_scheduler._bound_opts.append(self)
         if parameters is None:
             raise ValueError("parameters must be provided (dygraph-style optimizer)")
         self._param_groups = self._build_groups(parameters)
@@ -56,6 +66,7 @@ class Optimizer:
         if self._lr_scheduler is not None:
             raise RuntimeError("cannot set_lr when using an LRScheduler")
         self._lr = value
+        self._lr_t._data = jnp.asarray(float(value), jnp.float32)
 
     def set_lr_scheduler(self, scheduler):
         self._lr_scheduler = scheduler
@@ -74,7 +85,7 @@ class Optimizer:
 
     # ---- step ----------------------------------------------------------------
     def step(self):
-        lr = self.get_lr()
+        lr = unwrap(self._lr_t)  # 0-d array (tracer under capture)
         # clip over ALL groups at once so ClipGradByGlobalNorm sees the true
         # global norm (reference: Optimizer._create_optimization_pass clips the
         # concatenated params_grads)

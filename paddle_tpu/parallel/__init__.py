@@ -1,1 +1,2 @@
-"""placeholder — filled in during round 1 build."""
+"""Hybrid-parallel layer library (TP/SP/PP/EP) — SURVEY §2.4 parallelism
+strategies, redesigned as GSPMD shardings + shard_map collectives."""

@@ -1,1 +1,183 @@
-"""placeholder — filled in during round 1 build."""
+"""paddle.profiler (reference: python/paddle/profiler/profiler.py:358).
+
+TPU-native: wraps the JAX/XLA profiler (XPlane protocol → TensorBoard /
+Perfetto; the reference's chrome-trace export maps to jax.profiler traces).
+RecordEvent maps to jax.profiler.TraceAnnotation; host-side timeline events are
+collected in-process for summary() tables.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from collections import defaultdict
+from enum import Enum
+
+import jax
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class SummaryView(Enum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+
+
+def make_scheduler(*, closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    def scheduler(step):
+        s = step - skip_first
+        if s < 0:
+            return ProfilerState.CLOSED
+        cycle = closed + ready + record
+        if repeat and s >= cycle * repeat:
+            return ProfilerState.CLOSED
+        pos = s % cycle if cycle else 0
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        prof._export_dir = dir_name
+    return handler
+
+
+def export_protobuf(dir_name, worker_name=None):
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+class RecordEvent:
+    """reference: profiler/utils.py:47 — user-level trace annotation."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ann = None
+        self._begin = None
+
+    def begin(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        self._begin = time.perf_counter()
+        _host_events[self.name].append(0.0)
+
+    def end(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            _host_events[self.name][-1] = time.perf_counter() - self._begin
+            self._ann = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+_host_events: dict = defaultdict(list)
+
+
+class Profiler:
+    """reference: profiler/profiler.py:358."""
+
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 record_shapes=False, profile_memory=False, timer_only=False,
+                 emit_nvtx=False, custom_device_types=None, with_flops=False):
+        self._scheduler = scheduler if callable(scheduler) else (
+            make_scheduler(record=scheduler[1] - scheduler[0], skip_first=scheduler[0])
+            if isinstance(scheduler, (tuple, list)) else None)
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._step = 0
+        self._dir = None
+        self._export_dir = None
+        self._active = False
+        self._step_times = []
+        self._last_step_t = None
+
+    def start(self):
+        self._dir = self._export_dir or os.path.join("/tmp", "paddle_tpu_profile")
+        if not self._timer_only:
+            jax.profiler.start_trace(self._dir)
+            self._active = True
+        self._last_step_t = time.perf_counter()
+        return self
+
+    def stop(self):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+        if self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append(now - self._last_step_t)
+        self._last_step_t = now
+        self._step += 1
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return ""
+        import numpy as np
+        t = np.asarray(self._step_times[-10:])
+        return (f"avg step {t.mean()*1000:.2f} ms (last {len(t)}), "
+                f"ips {1.0/t.mean():.2f} steps/s")
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms", views=None):
+        import numpy as np
+        lines = ["--------- profiler summary (host events) ---------"]
+        for name, times in sorted(_host_events.items(),
+                                  key=lambda kv: -sum(kv[1])):
+            arr = np.asarray(times)
+            lines.append(f"{name:40s} calls={len(arr):6d} total={arr.sum()*1000:10.3f}ms "
+                         f"avg={arr.mean()*1000:8.3f}ms")
+        if self._step_times:
+            arr = np.asarray(self._step_times)
+            lines.append(f"{'[step]':40s} calls={len(arr):6d} "
+                         f"total={arr.sum()*1000:10.3f}ms avg={arr.mean()*1000:8.3f}ms")
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+    def export(self, path=None, format="json"):
+        return self._dir
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def load_profiler_result(filename):
+    raise NotImplementedError("open the XPlane trace in TensorBoard/Perfetto")

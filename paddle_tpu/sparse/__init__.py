@@ -1,1 +1,14 @@
-"""placeholder — filled in during round 1 build."""
+"""paddle.sparse (reference: python/paddle/sparse) — COO/CSR tensors.
+JAX BCOO-backed implementation lands later this round; importable stubs now."""
+
+
+def sparse_coo_tensor(indices, values, shape=None, **kw):
+    from jax.experimental import sparse as jsparse
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    from ..core.dispatch import unwrap
+    idx = unwrap(indices)
+    v = unwrap(values)
+    mat = jsparse.BCOO((v, jnp.asarray(idx).T), shape=tuple(shape))
+    t = Tensor(mat.todense())
+    return t

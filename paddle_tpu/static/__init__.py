@@ -1,1 +1,62 @@
-"""placeholder — filled in during round 1 build."""
+"""paddle.static compat surface (reference: python/paddle/static/).
+
+The reference's Program/Executor static graph collapses into to_static capture
+(jaxpr/StableHLO is the program IR). These shims keep static-style user code
+importable; InputSpec is the real, shared spec type.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..jit import InputSpec  # noqa: F401
+from ..jit.to_static import StaticFunction  # noqa: F401
+
+
+class Program:
+    """Placeholder Program: captured programs are jaxprs inside StaticFunction."""
+
+    def __init__(self):
+        self._sf = None
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+def default_main_program():
+    return Program()
+
+
+def default_startup_program():
+    return Program()
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    yield
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return InputSpec(shape, dtype, name)
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        raise NotImplementedError(
+            "static Executor.run: use paddle_tpu.jit.to_static capture instead "
+            "(the PIR/StandaloneExecutor path is subsumed by XLA)")
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..autograd import grad
+    return grad(targets, inputs, grad_outputs=target_gradients, allow_unused=True)

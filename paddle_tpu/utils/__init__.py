@@ -1,1 +1,41 @@
-"""placeholder — filled in during round 1 build."""
+"""paddle.utils (reference: python/paddle/utils/)."""
+from __future__ import annotations
+
+import importlib
+import warnings
+
+from . import unique_name  # noqa: F401
+
+
+def try_import(module_name, err_msg=None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or f"module {module_name} is required") from None
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    def decorator(func):
+        def wrapper(*args, **kwargs):
+            warnings.warn(f"{func.__name__} is deprecated since {since}. {reason} "
+                          f"Use {update_to} instead.", DeprecationWarning)
+            return func(*args, **kwargs)
+        return wrapper
+    return decorator
+
+
+def run_check():
+    """paddle.utils.run_check — sanity-check install + device."""
+    import jax
+    import numpy as np
+    from .. import ops
+    a = ops.ones([2, 2])
+    b = (a @ a).numpy()
+    assert np.allclose(b, 2 * np.ones((2, 2)))
+    devs = jax.devices()
+    print(f"paddle_tpu is installed successfully! devices: {devs}")
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    from ..hapi.model import flops as _flops
+    return _flops(net, input_size, custom_ops, print_detail)
