@@ -1,0 +1,103 @@
+"""Benchmark: GPT-2 124M causal-LM pretraining throughput, single chip.
+
+BASELINE config #1. Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+vs_baseline = MFU / 0.40 (the north-star target from BASELINE.json; the
+reference publishes no in-tree numbers).
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.models.gpt2 import GPT2Config, GPT2ForCausalLM
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform in ("tpu", "axon")
+    # sized so the one-time eager spy pass fits HBM until the Pallas
+    # flash-attention kernel removes the S^2 residuals
+    batch, seqlen = (4, 512) if on_tpu else (2, 128)
+    steps = 10 if on_tpu else 3
+
+    paddle.seed(0)
+    cfg = GPT2Config.gpt2_small(hidden_dropout_prob=0.0, attention_dropout_prob=0.0) \
+        if on_tpu else GPT2Config.tiny(hidden_dropout_prob=0.0,
+                                       attention_dropout_prob=0.0)
+    model = GPT2ForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    opt = paddle.optimizer.AdamW(learning_rate=3e-4, weight_decay=0.01,
+                                 parameters=model.parameters(),
+                                 grad_clip=nn.ClipGradByGlobalNorm(1.0))
+
+    n_params = sum(p.size for p in model.parameters())
+
+    def train_step(x, y):
+        _, loss = model(x, labels=y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    static_step = paddle.jit.to_static(train_step)
+    rng = np.random.RandomState(0)
+
+    def batch_data():
+        ids = rng.randint(0, cfg.vocab_size, (batch, seqlen + 1)).astype(np.int32)
+        return paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:])
+
+    # warmup: spy pass + compile + one compiled step
+    x, y = batch_data()
+    static_step(x, y)
+    static_step(*batch_data()).block_until_ready()
+    static_step(*batch_data()).block_until_ready()
+
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(steps):
+        loss = static_step(*batch_data())
+    loss.block_until_ready()
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens_per_sec = batch * seqlen / dt
+    # PaLM-appendix model flops per token: 6N + 12·L·h·s
+    flops_per_token = 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * seqlen
+    achieved = tokens_per_sec * flops_per_token
+    peak = 197e12 if on_tpu else 1e12  # v5e bf16 plate spec; CPU number is nominal
+    mfu = achieved / peak
+    # measured achievable ceiling on THIS chip (tunneled chips can be slices):
+    import jax.numpy as jnp
+    ka = jnp.ones((4096, 4096), jnp.bfloat16)
+
+    def chain(a):
+        x = a
+        for _ in range(8):
+            x = x @ a
+        return x
+    cj = jax.jit(chain)
+    cj(ka).block_until_ready()
+    t0 = time.perf_counter()
+    np.asarray(cj(ka)[:1, :1])
+    meas_peak = 8 * 2 * 4096 ** 3 / (time.perf_counter() - t0)
+
+    print(json.dumps({
+        "metric": "gpt2_124m_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {"mfu": round(mfu, 4), "step_ms": round(dt * 1000, 2),
+                  "batch": batch, "seqlen": seqlen, "params": n_params,
+                  "device": str(dev),
+                  "measured_chip_peak_tflops": round(meas_peak / 1e12, 2),
+                  "mfu_vs_measured_peak": round(achieved / meas_peak, 4),
+                  "final_loss": float(np.asarray(loss._data, np.float32))},
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

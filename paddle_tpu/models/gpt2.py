@@ -1,0 +1,168 @@
+"""GPT-2 (BASELINE config #1: 124M single-chip LM pretraining).
+
+Architecture parity target: PaddleNLP GPT-2 (the reference repo hosts the
+framework; the model recipe lives downstream). Built purely from paddle_tpu.nn
+so it exercises the user-facing stack end to end.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .. import ops
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..nn.layer.common import Linear, Embedding, Dropout
+from ..nn.layer.norm import LayerNorm
+from ..nn.layer.container import LayerList
+from ..nn import functional as F
+from ..nn.initializer import Normal
+
+
+class GPT2Config:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=None, max_position_embeddings=1024,
+                 hidden_dropout_prob=0.1, attention_dropout_prob=0.1,
+                 layer_norm_epsilon=1e-5, initializer_range=0.02):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.max_position_embeddings = max_position_embeddings
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_dropout_prob = attention_dropout_prob
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.initializer_range = initializer_range
+
+    @classmethod
+    def gpt2_small(cls, **kw):  # 124M
+        return cls(hidden_size=768, num_layers=12, num_heads=12, **kw)
+
+    @classmethod
+    def tiny(cls, **kw):  # test config
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("max_position_embeddings", 64)
+        return cls(hidden_size=64, num_layers=2, num_heads=2, **kw)
+
+
+class GPT2Attention(Layer):
+    def __init__(self, config: GPT2Config):
+        super().__init__()
+        self.num_heads = config.num_heads
+        self.head_dim = config.hidden_size // config.num_heads
+        init = Normal(std=config.initializer_range)
+        self.qkv = Linear(config.hidden_size, 3 * config.hidden_size,
+                          weight_attr=init)
+        self.proj = Linear(config.hidden_size, config.hidden_size,
+                           weight_attr=Normal(std=config.initializer_range /
+                                              math.sqrt(2 * config.num_layers)))
+        self.attn_drop = config.attention_dropout_prob
+        self.resid_drop = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x):
+        b, s, h = x.shape
+        qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv.unbind(axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=self.attn_drop,
+            training=self.training)
+        out = out.reshape([b, s, h])
+        return self.resid_drop(self.proj(out))
+
+
+class GPT2MLP(Layer):
+    def __init__(self, config: GPT2Config):
+        super().__init__()
+        init = Normal(std=config.initializer_range)
+        self.fc = Linear(config.hidden_size, config.intermediate_size, weight_attr=init)
+        self.proj = Linear(config.intermediate_size, config.hidden_size,
+                           weight_attr=Normal(std=config.initializer_range /
+                                              math.sqrt(2 * config.num_layers)))
+        self.drop = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x):
+        return self.drop(self.proj(F.gelu(self.fc(x), approximate=True)))
+
+
+class GPT2Block(Layer):
+    def __init__(self, config: GPT2Config):
+        super().__init__()
+        self.ln1 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.attn = GPT2Attention(config)
+        self.ln2 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.mlp = GPT2MLP(config)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln1(x))
+        x = x + self.mlp(self.ln2(x))
+        return x
+
+
+class GPT2Model(Layer):
+    def __init__(self, config: GPT2Config):
+        super().__init__()
+        self.config = config
+        init = Normal(std=config.initializer_range)
+        self.wte = Embedding(config.vocab_size, config.hidden_size, weight_attr=init)
+        self.wpe = Embedding(config.max_position_embeddings, config.hidden_size,
+                             weight_attr=Normal(std=config.initializer_range))
+        self.drop = Dropout(config.hidden_dropout_prob)
+        self.blocks = LayerList([GPT2Block(config) for _ in range(config.num_layers)])
+        self.ln_f = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids, position_ids=None):
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = ops.arange(s, dtype="int64").unsqueeze(0)
+        x = self.wte(input_ids) + self.wpe(position_ids)
+        x = self.drop(x)
+        for block in self.blocks:
+            x = block(x)
+        return self.ln_f(x)
+
+
+class GPT2ForCausalLM(Layer):
+    """LM head ties wte weights (standard GPT-2)."""
+
+    def __init__(self, config: GPT2Config):
+        super().__init__()
+        self.gpt2 = GPT2Model(config)
+        self.config = config
+
+    def forward(self, input_ids, labels=None, position_ids=None):
+        hidden = self.gpt2(input_ids, position_ids)
+        logits = ops.matmul(hidden, self.gpt2.wte.weight, transpose_y=True)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.config.vocab_size]),
+                labels.reshape([-1]))
+            return logits, loss
+        return logits
+
+    def generate(self, input_ids, max_new_tokens=20, temperature=1.0, top_k=None):
+        from .. import no_grad
+        out = input_ids
+        with no_grad():
+            self.eval()
+            for _ in range(max_new_tokens):
+                ctx = out if out.shape[1] <= self.config.max_position_embeddings \
+                    else out[:, -self.config.max_position_embeddings:]
+                logits = self.forward(ctx)
+                nxt = logits[:, -1, :] / temperature
+                if top_k is not None:
+                    v, _ = ops.topk(nxt, top_k)
+                    nxt = ops.where(nxt < v[:, -1:], ops.full_like(nxt, -1e30), nxt)
+                probs = F.softmax(nxt, axis=-1)
+                token = ops.multinomial(probs, 1)
+                out = ops.concat([out, token], axis=1)
+        return out
+
+
+def gpt2_small():
+    return GPT2ForCausalLM(GPT2Config.gpt2_small())
+
+
+def gpt2_tiny():
+    return GPT2ForCausalLM(GPT2Config.tiny())
