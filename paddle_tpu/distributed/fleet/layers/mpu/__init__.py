@@ -1,0 +1,5 @@
+"""fleet.layers.mpu compat (reference: fleet/layers/mpu/)."""
+from ....parallel.mp_layers import (VocabParallelEmbedding, ColumnParallelLinear,  # noqa: F401
+                                    RowParallelLinear, ParallelCrossEntropy,
+                                    RNGStatesTracker, get_rng_state_tracker,
+                                    model_parallel_random_seed)

@@ -1,0 +1,125 @@
+"""Activation recomputation (reference: python/paddle/distributed/fleet/
+recompute/recompute.py — RecomputeFunction:128, recompute():459,
+recompute_sequential:626; recompute_hybrid.py:265).
+
+Tape-level recompute: forward runs under no_grad (no residuals saved); backward
+re-executes the function with the tape enabled and pulls gradients through.
+Works eagerly AND under program capture — in a captured program XLA sees the
+recomputation, i.e. this is rematerialization (jax.checkpoint's effect) with
+Paddle's API. RNG state is snapshotted and replayed so dropout masks match
+(the reference's mp-aware RNGStatesTracker replay)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...core.dispatch import unwrap, _state
+from ...autograd import no_grad
+from ...autograd.backward import backward as _tape_backward
+from ...autograd.node import GradNode
+from ...core import rng as rng_mod
+
+
+def recompute(function, *args, **kwargs):
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)  # noqa: F841
+    tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+    from ...core.dispatch import grad_enabled
+    needs_grad = grad_enabled() and any(not t.stop_gradient for t in tensor_inputs)
+
+    rng_snapshot = unwrap(rng_mod.default_generator().get_state()) \
+        if preserve_rng_state else None
+
+    with no_grad():
+        outs = function(*args, **kwargs)
+    if not needs_grad:
+        return outs
+
+    single = not isinstance(outs, (tuple, list))
+    outs_t = (outs,) if single else tuple(o for o in outs)
+    out_arrays = tuple(unwrap(o) for o in outs_t if isinstance(o, Tensor))
+
+    def vjp_fn(cots):
+        cots_t = (cots,) if not isinstance(cots, (tuple, list)) else tuple(cots)
+        # replay rng so dropout masks match the forward
+        gen = rng_mod.default_generator()
+        saved_state = gen.get_state()._data if preserve_rng_state else None
+        if preserve_rng_state:
+            gen._state._data = rng_snapshot
+        # re-run forward WITH tape on detached inputs
+        detached = []
+        for a in args:
+            if isinstance(a, Tensor):
+                d = a.detach()
+                d.stop_gradient = a.stop_gradient
+                detached.append(d)
+            else:
+                detached.append(a)
+        re_outs = function(*detached, **kwargs)
+        if preserve_rng_state and saved_state is not None:
+            gen._state._data = saved_state
+        re_outs_t = (re_outs,) if not isinstance(re_outs, (tuple, list)) \
+            else tuple(re_outs)
+        grads_in = [Tensor(c) for c in cots_t]
+        roots = [o for o in re_outs_t if isinstance(o, Tensor) and not o.stop_gradient]
+        gts = [g for o, g in zip([o for o in re_outs_t if isinstance(o, Tensor)],
+                                 grads_in) if not o.stop_gradient]
+        # mark detached leaves to retain grads
+        leaves = [d for d in detached if isinstance(d, Tensor) and not d.stop_gradient]
+        for l in leaves:
+            l._retain_grad = True
+        _tape_backward(roots, gts)
+        result = []
+        for a, d in zip(args, detached):
+            if isinstance(a, Tensor):
+                if d.grad is not None:
+                    result.append(d.grad._data)
+                else:
+                    result.append(jnp.zeros_like(unwrap(a)))
+        return tuple(result)
+
+    node = GradNode("recompute", vjp_fn, tuple(tensor_inputs), out_arrays)
+    wrapped = []
+    i = 0
+    final = []
+    for o in outs_t:
+        if isinstance(o, Tensor):
+            t = Tensor(unwrap(o), stop_gradient=False)
+            t._grad_node = node
+            t._out_slot = i
+            i += 1
+            wrapped.append(t)
+            final.append(t)
+        else:
+            final.append(o)
+    node.set_outputs(wrapped)
+    return final[0] if single else tuple(final)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """reference :626 — recompute over a Sequential in segments."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    seg_size = max(len(layers) // max(segments, 1), 1)
+
+    def run_segment(start, end):
+        def seg_fn(x):
+            for l in layers[start:end]:
+                x = l(x)
+            return x
+        return seg_fn
+
+    x = args[0]
+    start = 0
+    while start < len(layers):
+        end = min(start + seg_size, len(layers))
+        x = recompute(run_segment(start, end), x, **kwargs)
+        start = end
+    return x
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """reference recompute_hybrid.py:265 — mp-aware rng + offload. On TPU the
+    rng story is the global key (identical by construction) and offload maps to
+    XLA rematerialization, so this is plain recompute."""
+    return recompute(function, *args, **kwargs)

@@ -1,0 +1,3 @@
+"""fleet.utils compat (reference: fleet/utils/__init__.py)."""
+from ..recompute import recompute, recompute_sequential, recompute_hybrid  # noqa: F401
+from ....parallel import sequence_parallel as sequence_parallel_utils  # noqa: F401

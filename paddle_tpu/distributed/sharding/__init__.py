@@ -1,0 +1,2 @@
+"""paddle.distributed.sharding (reference: python/paddle/distributed/sharding)."""
+from ...parallel.sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401

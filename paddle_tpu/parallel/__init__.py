@@ -1,2 +1,21 @@
-"""Hybrid-parallel layer library (TP/SP/PP/EP) — SURVEY §2.4 parallelism
-strategies, redesigned as GSPMD shardings + shard_map collectives."""
+"""Hybrid-parallel layer library (SURVEY §2.4), TPU-native:
+TP/SP = GSPMD shardings; EP = dense GShard dispatch + mesh alltoall;
+PP = ppermute schedule (SPMD) or stage-pinned container; CP = ring attention."""
+from .mp_layers import (VocabParallelEmbedding, ColumnParallelLinear,  # noqa: F401
+                        RowParallelLinear, ParallelCrossEntropy,
+                        RNGStatesTracker, get_rng_state_tracker,
+                        model_parallel_random_seed)
+from .sequence_parallel import (ColumnSequenceParallelLinear,  # noqa: F401
+                                RowSequenceParallelLinear, AllGatherOp,
+                                ReduceScatterOp,
+                                mark_as_sequence_parallel_parameter,
+                                register_sequence_parallel_allreduce_hooks)
+from .moe import MoELayer, ExpertMLP, top2_gating  # noqa: F401
+from .ring_attention import ring_flash_attention  # noqa: F401
+from .pipeline import pipeline_forward, pipeline_call  # noqa: F401
+from .pipeline_layer import (PipelineLayer, LayerDesc, SharedLayerDesc,  # noqa: F401
+                             PipelineParallel, PipelineParallelWithInterleave)
+from .tensor_parallel import TensorParallel, SegmentParallel  # noqa: F401
+from .sharding import (group_sharded_parallel, save_group_sharded_model,  # noqa: F401
+                       DygraphShardingOptimizer, GroupShardedStage2,
+                       shard_parameters, shard_accumulators)

