@@ -1,0 +1,226 @@
+"""Llama-3 family (BASELINE config #2: 8B pretrain, FSDP→GSPMD; #5 MoE variant).
+
+Architecture: RMSNorm + GQA attention with RoPE + SwiGLU MLP, tied to the
+paddle_tpu.nn stack. `shard_llama` applies the hybrid placement policy
+(dp/fsdp/mp/sep axes) — the fleet 4D mapping from SURVEY §2.4 as GSPMD.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from .. import ops
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..nn.layer.common import Linear, Embedding
+from ..nn.layer.norm import RMSNorm
+from ..nn.layer.container import LayerList
+from ..nn import functional as F
+from ..nn.functional.rope import fused_rotary_position_embedding
+from ..nn.initializer import Normal
+
+
+class LlamaConfig:
+    def __init__(self, vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+                 num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+                 max_position_embeddings=8192, rms_norm_eps=1e-5, rope_theta=500000.0,
+                 tie_word_embeddings=False, initializer_range=0.02,
+                 num_experts=0, num_experts_per_tok=2, moe_intermediate_size=None):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_key_value_heads = num_key_value_heads
+        self.max_position_embeddings = max_position_embeddings
+        self.rms_norm_eps = rms_norm_eps
+        self.rope_theta = rope_theta
+        self.tie_word_embeddings = tie_word_embeddings
+        self.initializer_range = initializer_range
+        self.num_experts = num_experts
+        self.num_experts_per_tok = num_experts_per_tok
+        self.moe_intermediate_size = moe_intermediate_size
+
+    @classmethod
+    def llama3_8b(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("num_hidden_layers", 2)
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("num_key_value_heads", 2)
+        kw.setdefault("max_position_embeddings", 128)
+        kw.setdefault("rope_theta", 10000.0)
+        return cls(**kw)
+
+    @classmethod
+    def tiny_moe(cls, **kw):
+        kw.setdefault("num_experts", 4)
+        return cls.tiny(**kw)
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = h // self.num_heads
+        self.rope_theta = config.rope_theta
+        init = Normal(std=config.initializer_range)
+        self.q_proj = Linear(h, self.num_heads * self.head_dim, weight_attr=init,
+                             bias_attr=False)
+        self.k_proj = Linear(h, self.num_kv_heads * self.head_dim, weight_attr=init,
+                             bias_attr=False)
+        self.v_proj = Linear(h, self.num_kv_heads * self.head_dim, weight_attr=init,
+                             bias_attr=False)
+        self.o_proj = Linear(self.num_heads * self.head_dim, h, weight_attr=init,
+                             bias_attr=False)
+
+    def forward(self, x, position_ids=None):
+        b, s, h = x.shape
+        q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
+        k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        q, k, _ = fused_rotary_position_embedding(
+            q, k, None, position_ids=position_ids, rotary_emb_base=self.rope_theta)
+        from ..distributed.fleet.topology import get_hybrid_communicate_group
+        if get_hybrid_communicate_group().get_sep_parallel_world_size() > 1:
+            # context parallelism: sequence sharded on 'sep', ring attention
+            from ..parallel.ring_attention import ring_flash_attention
+            rep = self.num_heads // self.num_kv_heads
+            if rep > 1:
+                k = ops.repeat_interleave(k, rep, axis=2)
+                v = ops.repeat_interleave(v, rep, axis=2)
+            out = ring_flash_attention(q, k, v, causal=True, axis_name="sep")
+        else:
+            out, _ = F.flash_attention(q, k, v, causal=True)
+        return self.o_proj(out.reshape([b, s, self.num_heads * self.head_dim]))
+
+
+class LlamaMLP(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, m = config.hidden_size, config.intermediate_size
+        init = Normal(std=config.initializer_range)
+        self.gate_proj = Linear(h, m, weight_attr=init, bias_attr=False)
+        self.up_proj = Linear(h, m, weight_attr=init, bias_attr=False)
+        self.down_proj = Linear(m, h, weight_attr=init, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                epsilon=config.rms_norm_eps)
+        if config.num_experts > 0:
+            from ..parallel.moe import MoELayer
+            self.mlp = MoELayer(config.hidden_size, num_experts=config.num_experts,
+                                d_hidden=config.moe_intermediate_size
+                                or config.intermediate_size)
+        else:
+            self.mlp = LlamaMLP(config)
+
+    def forward(self, x, position_ids=None):
+        x = x + self.self_attn(self.input_layernorm(x), position_ids)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = Embedding(config.vocab_size, config.hidden_size,
+                                      weight_attr=Normal(std=config.initializer_range))
+        self.layers = LayerList([LlamaDecoderLayer(config)
+                                 for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+
+    def forward(self, input_ids, position_ids=None):
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x, position_ids)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  weight_attr=Normal(std=config.initializer_range),
+                                  bias_attr=False)
+
+    def forward(self, input_ids, labels=None, position_ids=None):
+        hidden = self.llama(input_ids, position_ids)
+        if self.lm_head is not None:
+            logits = self.lm_head(hidden)
+        else:
+            logits = ops.matmul(hidden, self.llama.embed_tokens.weight,
+                                transpose_y=True)
+        if labels is not None:
+            loss = F.cross_entropy(logits.reshape([-1, self.config.vocab_size]),
+                                   labels.reshape([-1]))
+            aux = None
+            for layer in self.llama.layers:
+                al = getattr(layer.mlp, "aux_loss", None)
+                if al is not None:
+                    aux = al if aux is None else aux + al
+            if aux is not None:
+                loss = loss + 0.01 * aux
+            return logits, loss
+        return logits
+
+
+def shard_llama(model: LlamaForCausalLM, mesh, fsdp_axis="dp", mp_axis="mp"):
+    """Apply the hybrid placement policy: Megatron TP on 'mp', FSDP (param
+    sharding) on the fsdp axis — SURVEY §2.4 DP/sharding/TP mapping."""
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.mp_layers import _shard_param
+
+    def put(p, spec):
+        if p is not None:
+            _shard_param(p, spec)
+
+    put(model.llama.embed_tokens.weight, P(mp_axis, None))
+    if model.lm_head is not None:
+        put(model.lm_head.weight, P(None, mp_axis))
+    for layer in model.llama.layers:
+        att, mlp = layer.self_attn, layer.mlp
+        put(att.q_proj.weight, P(fsdp_axis, mp_axis))
+        put(att.k_proj.weight, P(fsdp_axis, mp_axis))
+        put(att.v_proj.weight, P(fsdp_axis, mp_axis))
+        put(att.o_proj.weight, P(mp_axis, fsdp_axis))
+        if isinstance(mlp, LlamaMLP):
+            put(mlp.gate_proj.weight, P(fsdp_axis, mp_axis))
+            put(mlp.up_proj.weight, P(fsdp_axis, mp_axis))
+            put(mlp.down_proj.weight, P(mp_axis, fsdp_axis))
+    return model
+
+
+def llama3_8b():
+    return LlamaForCausalLM(LlamaConfig.llama3_8b())
+
+
+def llama_tiny():
+    return LlamaForCausalLM(LlamaConfig.tiny())
+
+
+def llama_tiny_moe():
+    return LlamaForCausalLM(LlamaConfig.tiny_moe())
