@@ -22,7 +22,7 @@ def main():
     on_tpu = dev.platform in ("tpu", "axon")
     # sized so the one-time eager spy pass fits HBM until the Pallas
     # flash-attention kernel removes the S^2 residuals
-    batch, seqlen = (4, 512) if on_tpu else (2, 128)
+    batch, seqlen = (8, 1024) if on_tpu else (2, 128)
     steps = 10 if on_tpu else 3
 
     paddle.seed(0)

@@ -57,6 +57,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
         from ...core.rng import next_key
         rng_key = next_key()
     def f(q, k, v):
+        if m is None and rng_key is None and _use_pallas(q, k):
+            from ...ops.pallas.flash_attention import flash_attention_bshd
+            return flash_attention_bshd(q, k, v, causal=is_causal)
         return _sdpa_ref(q, k, v, mask=m, causal=is_causal,
                          dropout_p=dropout_p if training else 0.0, key=rng_key)
     return apply_op("scaled_dot_product_attention", f, query, key, value)
@@ -72,7 +75,7 @@ def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax
         from ...core.rng import next_key
         rng_key = next_key()
     def f(q, k, v):
-        if rng_key is None and _use_pallas(q):
+        if rng_key is None and _use_pallas(q, k):
             from ...ops.pallas.flash_attention import flash_attention_bshd
             return flash_attention_bshd(q, k, v, causal=causal)
         return _sdpa_ref(q, k, v, mask=m, causal=causal,
@@ -91,7 +94,7 @@ def _pallas_kernel_available() -> bool:
         return False
 
 
-def _use_pallas(q) -> bool:
+def _use_pallas(q, k=None) -> bool:
     import jax
     if not _pallas_kernel_available():
         return False
@@ -102,8 +105,11 @@ def _use_pallas(q) -> bool:
         platform = jax.default_backend()
     if platform not in ("tpu", "axon"):
         return False
-    # MXU-friendly shapes only; fall back otherwise
-    return q.shape[-1] % 128 == 0 and q.shape[1] % 128 == 0
+    # MXU/lane-friendly shapes only (block=128); fall back otherwise
+    ok = q.shape[-1] % 64 == 0 and q.shape[1] % 128 == 0
+    if k is not None:
+        ok = ok and k.shape[1] % 128 == 0
+    return ok
 
 
 def flash_attn_unpadded(*args, **kwargs):

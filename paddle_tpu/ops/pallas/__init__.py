@@ -1,0 +1,3 @@
+"""Pallas TPU kernels — the hand-written hot ops (SURVEY §7: flash attention,
+paged/block attention, MoE dispatch, quantized matmul; everything else is XLA)."""
+from . import flash_attention  # noqa: F401

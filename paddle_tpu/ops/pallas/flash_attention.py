@@ -1,0 +1,280 @@
+"""Flash attention Pallas TPU kernel (reference capability:
+phi/kernels/gpu/flash_attn_kernel.cu:673 wrapping third_party/flashattn).
+
+TPU-native blockwise online-softmax attention:
+  forward — grid (B*H, Sq/BQ, Sk/BK); running (m, l, acc) in VMEM scratch
+            persisted across the sequential k dimension; causal blocks skipped.
+  backward — two kernels: dq (accumulate over k blocks) and dk/dv (accumulate
+            over q blocks), recomputing P from the saved logsumexp; f32
+            accumulation throughout; O(S) memory instead of O(S^2).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BQ = 128
+BK = 128
+
+
+def _interpret() -> bool:
+    # CPU has no Mosaic backend; run kernels in interpret mode (tests/CI)
+    import jax
+    return jax.default_backend() == "cpu"
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *, scale,
+                causal, nk):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    run = True
+    if causal:
+        run = (j * BK) <= (i * BQ + BQ - 1)
+
+    @pl.when(run if causal else True)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = i * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 0)
+            cols = j * BK + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_prev = m_s[:, 0]
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_s[:, 0] * corr + jnp.sum(p, axis=1)
+        acc_s[:] = acc_s[:] * corr[:, None] + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_s[:] = jnp.broadcast_to(m_new[:, None], m_s.shape)
+        l_s[:] = jnp.broadcast_to(l_new[:, None], l_s.shape)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        l = l_s[:, 0]
+        o_ref[0] = (acc_s[:] / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = (m_s[:, 0] + jnp.log(jnp.maximum(l, 1e-30)))[:, None] \
+            + jnp.zeros_like(lse_ref[0])
+
+
+def _flash_fwd(q3, k3, v3, scale, causal):
+    """q3/k3/v3: [BH, S, D] -> (o [BH, Sq, D], lse [BH, Sq, 128])."""
+    BH, Sq, D = q3.shape
+    Sk = k3.shape[1]
+    nq, nk = Sq // BQ, Sk // BK
+    kern = functools.partial(_fwd_kernel, scale=scale, causal=causal, nk=nk)
+    o, lse = pl.pallas_call(
+        kern,
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, BQ, 128), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sq, D), q3.dtype),
+            jax.ShapeDtypeStruct((BH, Sq, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((BQ, 128), jnp.float32),
+            pltpu.VMEM((BQ, 128), jnp.float32),
+            pltpu.VMEM((BQ, D), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3)
+    return o, lse
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, dq_s, *,
+               scale, causal, nk):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_s[:] = jnp.zeros_like(dq_s)
+
+    run = True
+    if causal:
+        run = (j * BK) <= (i * BQ + BQ - 1)
+
+    @pl.when(run if causal else True)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        o = o_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = i * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 0)
+            cols = j * BK + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        delta = jnp.sum(do * o, axis=1)
+        ds = p * (dp - delta[:, None])
+        dq_s[:] = dq_s[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_s[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref,
+                dk_s, dv_s, *, scale, causal, nq):
+    j = pl.program_id(1)  # k block
+    i = pl.program_id(2)  # q block (sequential)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_s[:] = jnp.zeros_like(dk_s)
+        dv_s[:] = jnp.zeros_like(dv_s)
+
+    run = True
+    if causal:
+        run = (j * BK) <= (i * BQ + BQ - 1)
+
+    @pl.when(run if causal else True)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        o = o_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = i * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 0)
+            cols = j * BK + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv_s[:] = dv_s[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        delta = jnp.sum(do * o, axis=1)
+        ds = p * (dp - delta[:, None])
+        dk_s[:] = dk_s[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(i == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_s[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal):
+    BH, Sq, D = q3.shape
+    Sk = k3.shape[1]
+    nq, nk = Sq // BQ, Sk // BK
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal, nk=nk),
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, BQ, 128), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((BQ, D), jnp.float32)],
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, o3, lse)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal, nq=nq),
+        grid=(BH, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, BQ, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, BQ, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, BQ, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, BQ, 128), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, BK, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sk, D), k3.dtype),
+            jax.ShapeDtypeStruct((BH, Sk, D), v3.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((BK, D), jnp.float32),
+            pltpu.VMEM((BK, D), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, o3, lse)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash3(q3, k3, v3, scale, causal):
+    o, _ = _flash_fwd(q3, k3, v3, scale, causal)
+    return o
+
+
+def _flash3_fwd(q3, k3, v3, scale, causal):
+    o, lse = _flash_fwd(q3, k3, v3, scale, causal)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _flash3_bwd(scale, causal, res, do):
+    q3, k3, v3, o, lse = res
+    dq, dk, dv = _flash_bwd(q3, k3, v3, o, lse, do, scale, causal)
+    return dq, dk, dv
+
+
+_flash3.defvjp(_flash3_fwd, _flash3_bwd)
+
+
+def flash_attention_bshd(q, k, v, causal=True, scale=None):
+    """[B, S, H, D] flash attention with GQA support (kv heads repeated)."""
+    B, Sq, H, D = q.shape
+    Hk = k.shape[2]
+    if H != Hk:
+        rep = H // Hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    s = scale if scale is not None else 1.0 / math.sqrt(D)
+    q3 = jnp.moveaxis(q, 2, 1).reshape(B * H, Sq, D)
+    k3 = jnp.moveaxis(k, 2, 1).reshape(B * H, k.shape[1], D)
+    v3 = jnp.moveaxis(v, 2, 1).reshape(B * H, v.shape[1], D)
+    o3 = _flash3(q3, k3, v3, s, causal)
+    return jnp.moveaxis(o3.reshape(B, H, Sq, D), 1, 2)
+
+
+def supported(q_shape, dtype) -> bool:
+    B, S, H, D = q_shape
+    return S % BQ == 0 and D in (128, 256) or (D % 128 == 0)

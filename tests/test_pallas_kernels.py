@@ -1,0 +1,60 @@
+"""Pallas kernel numerics (interpret mode on CPU; compiled path covered by
+bench/verify on the real chip)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas.flash_attention import flash_attention_bshd
+from paddle_tpu.nn.functional.attention import _sdpa_ref
+
+rng = np.random.RandomState(0)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("D", [64, 128])
+def test_flash_forward_matches_reference(causal, D):
+    B, S, H = 1, 256, 2
+    q = jnp.asarray(rng.rand(B, S, H, D).astype(np.float32))
+    k = jnp.asarray(rng.rand(B, S, H, D).astype(np.float32))
+    v = jnp.asarray(rng.rand(B, S, H, D).astype(np.float32))
+    out = flash_attention_bshd(q, k, v, causal=causal)
+    ref = _sdpa_ref(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gradients_match_reference(causal):
+    B, S, H, D = 1, 256, 1, 128
+    q = jnp.asarray(rng.rand(B, S, H, D).astype(np.float32))
+    k = jnp.asarray(rng.rand(B, S, H, D).astype(np.float32))
+    v = jnp.asarray(rng.rand(B, S, H, D).astype(np.float32))
+
+    def loss_fl(q, k, v):
+        return jnp.sum(flash_attention_bshd(q, k, v, causal=causal) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_sdpa_ref(q, k, v, causal=causal) ** 2)
+
+    g1 = jax.grad(loss_fl, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
+def test_flash_gqa():
+    B, S, H, D = 1, 128, 4, 64
+    q = jnp.asarray(rng.rand(B, S, H, D).astype(np.float32))
+    kv = jnp.asarray(rng.rand(B, S, 1, D).astype(np.float32))
+    out = flash_attention_bshd(q, kv, kv, causal=True)
+    ref = _sdpa_ref(q, kv, kv, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_bf16():
+    B, S, H, D = 1, 128, 2, 64
+    q = jnp.asarray(rng.rand(B, S, H, D).astype(np.float32)).astype(jnp.bfloat16)
+    out = flash_attention_bshd(q, q, q, causal=True)
+    ref = _sdpa_ref(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref, np.float32),
+                               atol=2e-2)
