@@ -56,6 +56,7 @@ class _SpyContext:
     def __init__(self):
         self.reads: dict[int, Tensor] = {}
         self.writes: dict[int, Tensor] = {}
+        self.grad_reads: dict[int, Tensor] = {}
         self.grad_writes: dict[int, Tensor] = {}
         self.created: set[int] = set()
 
@@ -73,6 +74,13 @@ class _SpyContext:
         t._buf = value
 
     def on_grad_read(self, t):
+        # a pre-existing grad read before any write this step (gradient
+        # accumulation with clear_grad outside the captured fn) is external
+        # state: record it so replay lifts it to a program input instead of
+        # baking the spy pass's concrete grad in as a trace constant
+        if (t._grad_buf is not None and id(t) not in self.created
+                and id(t) not in self.grad_writes):
+            self.grad_reads.setdefault(id(t), t)
         return t._grad_buf
 
     def on_grad_write(self, t, value):
@@ -86,8 +94,9 @@ class _ReplayContext:
 
     mode = "replay"
 
-    def __init__(self, lifted: dict[int, object]):
+    def __init__(self, lifted: dict[int, object], grad_lifted=None):
         self.values = lifted                  # id(Tensor) -> traced array
+        self.grad_lifted = grad_lifted or {}  # id(Tensor) -> traced grad array
         self.data_shadow: dict[int, object] = {}
         self.grad_shadow: dict[int, object] = {}
 
@@ -119,7 +128,16 @@ class _ReplayContext:
             if v is None or isinstance(v, Tensor):
                 return v
             return Tensor(v)
-        return t._grad_buf
+        if k in self.grad_lifted:
+            return Tensor(self.grad_lifted[k])
+        g = t._grad_buf
+        if g is None:
+            return None
+        # a concrete pre-existing grad that the spy pass did not record would
+        # be embedded as a stale trace-time constant — refuse and re-trace
+        raise MissedCapture(
+            f"pre-existing grad of {t.name or id(t)!r} read during replay was "
+            "not captured in the spy pass")
 
     def on_grad_write(self, t, value):
         self.grad_shadow[id(t)] = value
@@ -131,7 +149,7 @@ class _ReplayContext:
 
 class _CacheEntry:
     __slots__ = ("compiled", "mut_list", "ro_list", "write_list", "grad_list",
-                 "out_treedef", "out_mask", "eager_only", "treedef")
+                 "grad_in_list", "out_treedef", "out_mask", "eager_only", "treedef")
 
     def __init__(self):
         self.compiled = None
@@ -209,6 +227,8 @@ class StaticFunction:
         entry.ro_list = [t for t in reads if id(t) not in write_ids]
         entry.write_list = [t for k, t in ctx.writes.items() if k not in arg_ids]
         entry.grad_list = list(ctx.grad_writes.values())
+        entry.grad_in_list = [t for k, t in ctx.grad_reads.items()
+                              if k not in arg_ids]
         self._cache[key] = entry
         try:
             self._compile(entry, leaves)
@@ -228,7 +248,7 @@ class StaticFunction:
         tensor_pos = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
         arg_meta = [(leaves[i].stop_gradient, leaves[i].name) for i in tensor_pos]
 
-        def pure_fn(arg_arrays, mut_arrays, ro_arrays):
+        def pure_fn(arg_arrays, mut_arrays, ro_arrays, grad_in_arrays):
             new_leaves = list(leaves)
             lifted: dict[int, object] = {}
             for j, i in enumerate(tensor_pos):
@@ -240,7 +260,9 @@ class StaticFunction:
                 lifted[id(t)] = arr
             for t, arr in zip(entry.ro_list, ro_arrays):
                 lifted[id(t)] = arr
-            ctx = _ReplayContext(lifted)
+            grad_lifted = {id(t): arr
+                           for t, arr in zip(entry.grad_in_list, grad_in_arrays)}
+            ctx = _ReplayContext(lifted, grad_lifted)
             prev = _state.trace_ctx
             _state.trace_ctx = ctx
             try:
@@ -269,16 +291,30 @@ class StaticFunction:
         arg_arrays = [leaves[i]._buf for i in tensor_pos]
         mut_arrays = [t._buf for t in entry.mut_list]
         ro_arrays = [t._buf for t in entry.ro_list]
+        grad_in_arrays = self._grad_in_arrays(entry)
         # abstract trace now: surfaces graph breaks + fills out_treedef/out_mask
-        jax.eval_shape(pure_fn, arg_arrays, mut_arrays, ro_arrays)
+        jax.eval_shape(pure_fn, arg_arrays, mut_arrays, ro_arrays, grad_in_arrays)
         entry.compiled = jax.jit(pure_fn, donate_argnums=donate)
+
+    @staticmethod
+    def _grad_in_arrays(entry):
+        arrays = []
+        for t in entry.grad_in_list:
+            g = t._grad_buf
+            if g is None:
+                raise MissedCapture(
+                    f"grad of {t.name or id(t)!r} was live at capture time but is "
+                    "now None")
+            arrays.append(g._buf if isinstance(g, Tensor) else g)
+        return arrays
 
     def _run(self, entry, leaves):
         tensor_pos = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
         arg_arrays = [leaves[i]._buf for i in tensor_pos]
         mut_arrays = [t._buf for t in entry.mut_list]
         ro_arrays = [t._buf for t in entry.ro_list]
-        out_vals, write_out, grad_out = entry.compiled(arg_arrays, mut_arrays, ro_arrays)
+        out_vals, write_out, grad_out = entry.compiled(
+            arg_arrays, mut_arrays, ro_arrays, self._grad_in_arrays(entry))
         for t, arr in zip(entry.write_list, write_out):
             t._buf = arr
         for t, g in zip(entry.grad_list, grad_out):

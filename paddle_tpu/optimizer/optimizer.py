@@ -109,6 +109,28 @@ class Optimizer:
     def _update_param(self, p, g, lr, weight_decay):
         raise NotImplementedError
 
+    # ---- master weights ------------------------------------------------------
+    def _master(self, p):
+        """(master_tensor_or_None, f32 working value).
+
+        With multi_precision set and a low-precision parameter, keep a
+        persistent f32 master copy as the update's source of truth — otherwise
+        updates smaller than one bf16 ulp are permanently lost (reference:
+        adamw multi_precision master-weight path,
+        python/paddle/optimizer/adamw.py)."""
+        if self._multi_precision and p._data.dtype in (jnp.bfloat16, jnp.float16):
+            mw = self._acc("master_weight", p, dtype=jnp.float32,
+                           init=unwrap(p).astype(jnp.float32))
+            return mw, unwrap(mw)
+        return None, unwrap(p).astype(jnp.float32)
+
+    def _commit(self, p, mw, pw):
+        """Store the updated f32 value: master keeps full precision, the model
+        copy is a cast-down view."""
+        if mw is not None:
+            mw._data = pw
+        p._data = pw.astype(p._data.dtype)
+
     def clear_grad(self, set_to_zero=False):
         for p in self._parameter_list:
             p.clear_grad(set_to_zero)
@@ -146,17 +168,20 @@ class Optimizer:
                 continue
             name, _, idx = key.rpartition("_")
             p = plist[int(idx)]
-            t = self._acc(name, p)
             v = value._data if isinstance(value, Tensor) else jnp.asarray(np.asarray(value))
+            # create with the SAVED dtype: creating with the parameter dtype
+            # would silently downcast checkpointed f32 Adam moments to bf16 on
+            # resume, degrading training after restart
+            t = self._acc(name, p, dtype=v.dtype)
             t._data = v.astype(t._data.dtype)
 
-    def _apply_weight_decay_l2(self, p, g, wd):
+    def _apply_weight_decay_l2(self, pw, g, wd):
         """Fold regularizer into grad (SGD/Momentum/Adam style): L2 adds coeff*p,
-        L1 adds coeff*sign(p) (reference: python/paddle/regularizer.py)."""
+        L1 adds coeff*sign(p) (reference: python/paddle/regularizer.py).
+        `pw` is the f32 working value of the parameter (master weight if set)."""
         if wd is None:
             return g
         coeff = wd.coeff if hasattr(wd, "coeff") else float(wd)
-        pw = unwrap(p).astype(g.dtype)
         if isinstance(wd, L1Decay):
             return g + coeff * jnp.sign(pw)
         return g + coeff * pw

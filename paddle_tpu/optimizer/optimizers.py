@@ -21,8 +21,9 @@ class SGD(Optimizer):
         self._multi_precision = multi_precision
 
     def _update_param(self, p, g, lr, wd):
-        g = self._apply_weight_decay_l2(p, g.astype(jnp.float32), wd)
-        p._data = (unwrap(p).astype(jnp.float32) - lr * g).astype(p._data.dtype)
+        mw, pw = self._master(p)
+        g = self._apply_weight_decay_l2(pw, g.astype(jnp.float32), wd)
+        self._commit(p, mw, pw - lr * g)
 
 
 class Momentum(Optimizer):
@@ -35,7 +36,8 @@ class Momentum(Optimizer):
         self._multi_precision = multi_precision
 
     def _update_param(self, p, g, lr, wd):
-        g = self._apply_weight_decay_l2(p, g.astype(jnp.float32), wd)
+        mw, pw = self._master(p)
+        g = self._apply_weight_decay_l2(pw, g.astype(jnp.float32), wd)
         vel = self._acc("velocity", p, dtype=jnp.float32)
         v = self._momentum * unwrap(vel) + g
         vel._data = v
@@ -43,7 +45,7 @@ class Momentum(Optimizer):
             update = g + self._momentum * v
         else:
             update = v
-        p._data = (unwrap(p).astype(jnp.float32) - lr * update).astype(p._data.dtype)
+        self._commit(p, mw, pw - lr * update)
 
 
 class Adam(Optimizer):
@@ -59,9 +61,10 @@ class Adam(Optimizer):
         return False
 
     def _update_param(self, p, g, lr, wd):
+        mw, pw = self._master(p)
         gf = g.astype(jnp.float32)
         if not self._decay_is_decoupled():
-            gf = self._apply_weight_decay_l2(p, gf, wd)
+            gf = self._apply_weight_decay_l2(pw, gf, wd)
         m = self._acc("moment1", p, dtype=jnp.float32)
         v = self._acc("moment2", p, dtype=jnp.float32)
         b1p = self._acc("beta1_pow", p, init=jnp.ones((), jnp.float32))
@@ -78,13 +81,11 @@ class Adam(Optimizer):
             vmax._data = vv
         mhat = mv / (1 - b1t)
         vhat = vv / (1 - b2t)
-        pw = unwrap(p).astype(jnp.float32)
         if self._decay_is_decoupled() and wd is not None:
             coeff = wd if isinstance(wd, float) else getattr(wd, "coeff", 0.0)
             if self._should_decay(p):
                 pw = pw * (1.0 - lr * coeff)
-        pw = pw - lr * mhat / (jnp.sqrt(vhat) + self._eps)
-        p._data = pw.astype(p._data.dtype)
+        self._commit(p, mw, pw - lr * mhat / (jnp.sqrt(vhat) + self._eps))
 
     def _should_decay(self, p):
         return True
@@ -117,7 +118,8 @@ class Adamax(Optimizer):
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
 
     def _update_param(self, p, g, lr, wd):
-        gf = self._apply_weight_decay_l2(p, g.astype(jnp.float32), wd)
+        mw, pw = self._master(p)
+        gf = self._apply_weight_decay_l2(pw, g.astype(jnp.float32), wd)
         m = self._acc("moment", p, dtype=jnp.float32)
         u = self._acc("inf_norm", p, dtype=jnp.float32)
         b1p = self._acc("beta1_pow", p, init=jnp.ones((), jnp.float32))
@@ -126,8 +128,7 @@ class Adamax(Optimizer):
         mv = self._beta1 * unwrap(m) + (1 - self._beta1) * gf
         uv = jnp.maximum(self._beta2 * unwrap(u), jnp.abs(gf))
         m._data, u._data = mv, uv
-        pw = unwrap(p).astype(jnp.float32) - lr / (1 - b1t) * mv / (uv + self._eps)
-        p._data = pw.astype(p._data.dtype)
+        self._commit(p, mw, pw - lr / (1 - b1t) * mv / (uv + self._eps))
 
 
 class Adagrad(Optimizer):
@@ -138,13 +139,13 @@ class Adagrad(Optimizer):
         self._init_acc = initial_accumulator_value
 
     def _update_param(self, p, g, lr, wd):
-        gf = self._apply_weight_decay_l2(p, g.astype(jnp.float32), wd)
+        mw, pw = self._master(p)
+        gf = self._apply_weight_decay_l2(pw, g.astype(jnp.float32), wd)
         acc = self._acc("moment", p,
                         init=jnp.full(p._data.shape, self._init_acc, jnp.float32))
         av = unwrap(acc) + jnp.square(gf)
         acc._data = av
-        pw = unwrap(p).astype(jnp.float32) - lr * gf / (jnp.sqrt(av) + self._eps)
-        p._data = pw.astype(p._data.dtype)
+        self._commit(p, mw, pw - lr * gf / (jnp.sqrt(av) + self._eps))
 
 
 class RMSProp(Optimizer):
@@ -156,7 +157,8 @@ class RMSProp(Optimizer):
         self._momentum, self._centered = momentum, centered
 
     def _update_param(self, p, g, lr, wd):
-        gf = self._apply_weight_decay_l2(p, g.astype(jnp.float32), wd)
+        mw, pw = self._master(p)
+        gf = self._apply_weight_decay_l2(pw, g.astype(jnp.float32), wd)
         ms = self._acc("mean_square", p, dtype=jnp.float32)
         mom = self._acc("momentum", p, dtype=jnp.float32)
         msv = self._rho * unwrap(ms) + (1 - self._rho) * jnp.square(gf)
@@ -170,7 +172,7 @@ class RMSProp(Optimizer):
             denom = jnp.sqrt(msv + self._eps)
         mv = self._momentum * unwrap(mom) + lr * gf / denom
         mom._data = mv
-        p._data = (unwrap(p).astype(jnp.float32) - mv).astype(p._data.dtype)
+        self._commit(p, mw, pw - mv)
 
 
 class Adadelta(Optimizer):
@@ -180,14 +182,15 @@ class Adadelta(Optimizer):
         self._eps, self._rho = epsilon, rho
 
     def _update_param(self, p, g, lr, wd):
-        gf = self._apply_weight_decay_l2(p, g.astype(jnp.float32), wd)
+        mw, pw = self._master(p)
+        gf = self._apply_weight_decay_l2(pw, g.astype(jnp.float32), wd)
         avg_sq = self._acc("avg_squared_grad", p, dtype=jnp.float32)
         avg_up = self._acc("avg_squared_update", p, dtype=jnp.float32)
         asv = self._rho * unwrap(avg_sq) + (1 - self._rho) * jnp.square(gf)
         update = jnp.sqrt(unwrap(avg_up) + self._eps) / jnp.sqrt(asv + self._eps) * gf
         auv = self._rho * unwrap(avg_up) + (1 - self._rho) * jnp.square(update)
         avg_sq._data, avg_up._data = asv, auv
-        p._data = (unwrap(p).astype(jnp.float32) - lr * update).astype(p._data.dtype)
+        self._commit(p, mw, pw - lr * update)
 
 
 class Lamb(Optimizer):
@@ -200,6 +203,7 @@ class Lamb(Optimizer):
         self._wd = lamb_weight_decay
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
         self._exclude_fn = exclude_from_weight_decay_fn
+        self._multi_precision = multi_precision
 
     def _update_param(self, p, g, lr, wd):
         gf = g.astype(jnp.float32)
@@ -214,14 +218,14 @@ class Lamb(Optimizer):
         m._data, v._data = mv, vv
         mhat = mv / (1 - b1t)
         vhat = vv / (1 - b2t)
-        pw = unwrap(p).astype(jnp.float32)
+        mw, pw = self._master(p)
         r = mhat / (jnp.sqrt(vhat) + self._eps)
         if self._exclude_fn is None or not self._exclude_fn(p):
             r = r + self._wd * pw
         w_norm = jnp.linalg.norm(pw)
         r_norm = jnp.linalg.norm(r)
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
-        p._data = (pw - lr * trust * r).astype(p._data.dtype)
+        self._commit(p, mw, pw - lr * trust * r)
 
 
 class LBFGS(Optimizer):

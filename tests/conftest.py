@@ -1,9 +1,31 @@
 """Test env: CPU XLA with 8 virtual devices (SURVEY §4 — the reference simulates
-multi-node as multi-process on one host; we simulate a TPU mesh as 8 CPU devices)."""
+multi-node as multi-process on one host; we simulate a TPU mesh as 8 CPU devices).
+
+This environment's TPU plugin ignores the ``JAX_PLATFORMS`` env var, so the env
+var alone is NOT enough: we must also force the platform through ``jax.config``
+and, if a TPU backend already initialized, clear it.  Tests hard-assert the
+8-device CPU mesh up front so a mis-forced platform fails loudly instead of
+silently testing less (round-1 failure mode).
+"""
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+if jax.devices()[0].platform != "cpu" or len(jax.devices()) < 8:
+    import jax.extend.backend
+
+    jax.extend.backend.clear_backends()
+
+assert jax.devices()[0].platform == "cpu", (
+    f"test suite requires the CPU platform, got {jax.devices()[0].platform}"
+)
+assert len(jax.devices()) == 8, (
+    f"test suite requires 8 virtual CPU devices, got {len(jax.devices())}"
+)

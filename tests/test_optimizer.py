@@ -157,3 +157,70 @@ class TestLRSchedulers:
         for m in [1.0, 1.0, 1.0, 1.0]:
             s.step(m)
         assert s() < 1.0
+
+
+class TestMasterWeights:
+    """multi_precision keeps a persistent f32 master copy (ADVICE r1 #2)."""
+
+    def test_sub_ulp_updates_accumulate(self):
+        # bf16 ulp near 1.0 is ~0.0078; 200 updates of 1e-4 only land if the
+        # master f32 copy persists between steps
+        p = pt.Parameter(np.ones((8,), np.float32))
+        p._buf = p._buf.astype("bfloat16")
+        opt = SGD(learning_rate=1e-4, parameters=[p], multi_precision=True)
+        for _ in range(200):
+            p.grad = pt.to_tensor(np.ones((8,), np.float32))
+            opt.step()
+            opt.clear_grad()
+        mw = opt._accumulators["master_weight"][id(p)]
+        np.testing.assert_allclose(np.asarray(mw._buf), 1.0 - 200 * 1e-4,
+                                   rtol=1e-5)
+        # model copy tracks the master, cast down
+        assert np.asarray(p._buf, np.float32)[0] < 1.0
+
+    def test_without_multi_precision_bf16_loses_small_updates(self):
+        p = pt.Parameter(np.ones((8,), np.float32))
+        p._buf = p._buf.astype("bfloat16")
+        opt = SGD(learning_rate=1e-4, parameters=[p], multi_precision=False)
+        for _ in range(5):
+            p.grad = pt.to_tensor(np.ones((8,), np.float32))
+            opt.step()
+            opt.clear_grad()
+        # documents the bf16 rounding behavior the master path avoids
+        assert np.asarray(p._buf, np.float32)[0] == 1.0
+
+    def test_master_weight_in_state_dict_roundtrip(self):
+        p = pt.Parameter(np.ones((4,), np.float32))
+        p._buf = p._buf.astype("bfloat16")
+        opt = AdamW(learning_rate=1e-3, parameters=[p], multi_precision=True)
+        p.grad = pt.to_tensor(np.full((4,), 0.5, np.float32))
+        opt.step()
+        sd = opt.state_dict()
+        assert any(k.startswith("master_weight") for k in sd)
+
+        p2 = pt.Parameter(np.ones((4,), np.float32))
+        p2._buf = p2._buf.astype("bfloat16")
+        opt2 = AdamW(learning_rate=1e-3, parameters=[p2], multi_precision=True)
+        opt2.set_state_dict(sd)
+        mw2 = opt2._accumulators["master_weight"][id(p2)]
+        assert mw2._buf.dtype == np.float32
+
+
+def test_set_state_dict_preserves_f32_moments_on_bf16_params():
+    """Restoring f32 Adam moments into a fresh optimizer over bf16 params must
+    NOT downcast them to bf16 (ADVICE r1 #3)."""
+    p = pt.Parameter(np.ones((4,), np.float32))
+    opt = Adam(learning_rate=1e-3, parameters=[p])
+    p.grad = pt.to_tensor(np.full((4,), 0.25, np.float32))
+    opt.step()
+    sd = opt.state_dict()
+    assert sd["moment1_0"]._buf.dtype == np.float32
+
+    p2 = pt.Parameter(np.ones((4,), np.float32))
+    p2._buf = p2._buf.astype("bfloat16")
+    opt2 = Adam(learning_rate=1e-3, parameters=[p2])
+    opt2.set_state_dict(sd)
+    m1 = opt2._accumulators["moment1"][id(p2)]
+    assert m1._buf.dtype == np.float32
+    np.testing.assert_allclose(np.asarray(m1._buf),
+                               np.asarray(sd["moment1_0"]._buf))
