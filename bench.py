@@ -40,31 +40,45 @@ def _spec_peak(device_kind: str, on_tpu: bool) -> float:
     return 1e12  # nominal CPU number so the ratio is defined
 
 
+def _sync(x):
+    """True device sync. Through the axon tunnel, block_until_ready returns
+    before execution finishes — only host materialization actually waits."""
+    return float(np.asarray(x[(0,) * getattr(x, "ndim", 0)]))
+
+
 def _measure_peak(jax):
     """Achievable matmul ceiling on THIS chip (tunneled chips can be slices).
 
-    Runs before any model state exists so the 4096^2 operands are the only
-    HBM users. Returns flops/s or None on failure.
+    Runs before any model state exists so the 4096^2 operands are the only HBM
+    users. Differential timing (48-chain minus 8-chain) cancels the ~80ms
+    tunnel round-trip latency that otherwise dominates. Returns flops/s or
+    None on failure.
     """
     import jax.numpy as jnp
 
     try:
-        a = jnp.ones((4096, 4096), jnp.bfloat16)
+        a = jnp.full((4096, 4096), 1e-3, jnp.bfloat16)
 
-        def chain(x):
-            y = x
-            for _ in range(8):
-                y = y @ x
-            return y
+        def chain(x, n):
+            for _ in range(n):
+                x = (x @ a) * 1e-3  # rescale so values stay finite
+            return x
 
-        cj = jax.jit(chain)
-        cj(a).block_until_ready()
+        g8 = jax.jit(lambda x: chain(x, 8))
+        g48 = jax.jit(lambda x: chain(x, 48))
+        _sync(g8(a))
+        _sync(g48(a))
         t0 = time.perf_counter()
-        cj(a).block_until_ready()
-        dt = time.perf_counter() - t0
-        del a, cj
+        _sync(g8(a))
+        t8 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        _sync(g48(a))
+        t48 = time.perf_counter() - t0
+        del a, g8, g48
         gc.collect()
-        return 8 * 2 * 4096 ** 3 / dt
+        if t48 <= t8:
+            return None
+        return 40 * 2 * 4096 ** 3 / (t48 - t8)
     except Exception as e:  # noqa: BLE001 — probe is best-effort
         print(f"peak probe failed ({type(e).__name__}): {e}", file=sys.stderr)
         gc.collect()
@@ -97,23 +111,42 @@ def _train(paddle, nn, cfg, batch, seqlen, steps):
         ids = rng.randint(0, cfg.vocab_size, (batch, seqlen + 1)).astype(np.int32)
         return paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:])
 
-    # warmup: spy pass + compile + one compiled step
-    static_step(*batch_data())
-    static_step(*batch_data()).block_until_ready()
-    static_step(*batch_data()).block_until_ready()
-
-    t0 = time.perf_counter()
-    loss = None
-    for _ in range(steps):
+    # warmup: spy (lazy state creation) + re-spy/trace + first compiled run
+    for _ in range(3):
         loss = static_step(*batch_data())
-    loss.block_until_ready()
-    dt = (time.perf_counter() - t0) / steps
+    final0 = float(np.asarray(loss._data, np.float32))  # sync before timing
+
+    # pre-generate batches so host-side RNG isn't in the timed region;
+    # single sync at the end via materialization (block_until_ready does not
+    # actually block through the tunnel), differential to cancel latency
+    data = [batch_data() for _ in range(steps)]
+
+    def timed(k):
+        t0 = time.perf_counter()
+        for i in range(k):
+            loss = static_step(*data[i])
+        float(np.asarray(loss._data, np.float32))
+        return time.perf_counter() - t0
+
+    t_small = timed(max(1, steps // 5))
+    t_full = timed(steps)
+    dt = (t_full - t_small) / (steps - max(1, steps // 5))
+    if dt <= 0:  # latency-dominated; fall back to the full-loop average
+        dt = t_full / steps
+    loss = static_step(*data[0])
     final_loss = float(np.asarray(loss._data, np.float32))
     return batch * seqlen / dt, dt, final_loss, n_params
 
 
 def main():
     import jax
+
+    try:  # persistent compile cache: later runs skip TPU compile RPCs
+        jax.config.update("jax_compilation_cache_dir", ".jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     from paddle_tpu.models.gpt2 import GPT2Config

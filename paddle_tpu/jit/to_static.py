@@ -171,10 +171,17 @@ def _sig_key(leaves, treedef):
 
 
 class StaticFunction:
+    # a MissedCapture during compile usually means the fn lazily CREATED state
+    # on its first run (optimizer accumulators, RNG trackers) that becomes
+    # external state from the second run on — re-spying then captures it.
+    # Bounded so non-idempotent state creation can't re-spy forever.
+    MAX_SPY_ATTEMPTS = 3
+
     def __init__(self, function, input_spec=None, build_strategy=None, backend=None,
                  full_graph=False, donate_state=True):
         self._fn = function
         self._cache: dict[str, _CacheEntry] = {}
+        self._spy_attempts: dict[str, int] = {}
         self._donate = donate_state
         try:
             functools.update_wrapper(self, function)
@@ -237,8 +244,19 @@ class StaticFunction:
                         type(e).__name__)
             entry.eager_only = True
         except MissedCapture as e:
-            logger.info("to_static: %s; signature stays eager", e)
-            entry.eager_only = True
+            attempts = self._spy_attempts.get(key, 0) + 1
+            self._spy_attempts[key] = attempts
+            if attempts < self.MAX_SPY_ATTEMPTS:
+                # state created during this spy (lazy-init accumulators) is
+                # external state next call — drop the entry so the next call
+                # re-spies with that state pre-existing and fully captured
+                logger.info("to_static: %s; re-spying on next call "
+                            "(attempt %d)", e, attempts)
+                del self._cache[key]
+            else:
+                logger.warning("to_static: %s after %d spy attempts; "
+                               "signature stays eager", e, attempts)
+                entry.eager_only = True
         return result
 
     # ---- build + jit the pure function --------------------------------------

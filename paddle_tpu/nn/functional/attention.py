@@ -23,28 +23,30 @@ def _sdpa_ref(q, k, v, mask=None, causal=False, dropout_p=0.0, scale=None, key=N
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
-    # grouped-query attention: repeat kv heads if fewer than q heads
-    hq, hk = q.shape[2], k.shape[2]
-    if hq != hk:
-        rep = hq // hk
-        kf = jnp.repeat(kf, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    logits = jnp.einsum("bshd,bthd->bhst", qf, kf) * s
+    vf = v.astype(jnp.float32)
+    # grouped-query attention without materializing repeated KV heads: fold
+    # the group into a 5-D einsum (XLA keeps it a batched matmul)
+    B, sq_len, hq, _ = q.shape
+    hk = k.shape[2]
+    rep = hq // hk
+    qg = qf.reshape(B, sq_len, hk, rep, d)
+    logits = jnp.einsum("bskrd,btkd->bkrst", qg, kf) * s  # [B,hk,rep,Sq,Sk]
     if causal:
         sq, sk = logits.shape[-2], logits.shape[-1]
         cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         logits = jnp.where(cm, logits, -jnp.inf)
     if mask is not None:
-        m = mask.astype(jnp.float32) if mask.dtype != jnp.bool_ else None
-        if m is None:
-            logits = jnp.where(mask, logits, -jnp.inf)
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        m5 = jnp.broadcast_to(mask, (B, hq, sq, sk)).reshape(B, hk, rep, sq, sk)
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(m5, logits, -jnp.inf)
         else:
-            logits = logits + m
+            logits = logits + m5.astype(jnp.float32)
     p = jax.nn.softmax(logits, axis=-1)
     if dropout_p > 0.0 and key is not None:
         keep = jax.random.bernoulli(key, 1.0 - dropout_p, p.shape)
         p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
-    out = jnp.einsum("bhst,bthd->bshd", p, v.astype(jnp.float32))
+    out = jnp.einsum("bkrst,btkd->bskrd", p, vf).reshape(B, sq_len, hq, d)
     return out.astype(q.dtype)
 
 
@@ -105,11 +107,10 @@ def _use_pallas(q, k=None) -> bool:
         platform = jax.default_backend()
     if platform not in ("tpu", "axon"):
         return False
-    # MXU/lane-friendly shapes only (block=128); fall back otherwise
-    ok = q.shape[-1] % 64 == 0 and q.shape[1] % 128 == 0
-    if k is not None:
-        ok = ok and k.shape[1] % 128 == 0
-    return ok
+    # single dispatch predicate lives with the kernel (ADVICE r1: _use_pallas
+    # and supported() had drifted apart)
+    from ...ops.pallas.flash_attention import supported
+    return supported(tuple(q.shape), tuple(k.shape) if k is not None else None)
 
 
 def flash_attn_unpadded(*args, **kwargs):

@@ -74,19 +74,43 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *, scale,
             + jnp.zeros_like(lse_ref[0])
 
 
-def _flash_fwd(q3, k3, v3, scale, causal):
-    """q3/k3/v3: [BH, S, D] -> (o [BH, Sq, D], lse [BH, Sq, 128])."""
+def _check_divisible(Sq, Sk, D):
+    if Sq % BQ != 0 or Sk % BK != 0:
+        raise ValueError(
+            f"flash attention requires seq lengths divisible by {BQ} "
+            f"(got q {Sq}, kv {Sk}); pad or use the XLA fallback")
+    if D % 64 != 0:
+        raise ValueError(f"flash attention requires head_dim % 64 == 0, got {D}")
+
+
+def _kv_index(nh, nhk):
+    """q-head grid index -> kv row index in a [B*nhk, Sk, D] tensor (GQA:
+    kv head = q head // group, computed in the BlockSpec instead of
+    materializing jnp.repeat'd K/V)."""
+    rep = nh // nhk
+
+    def index(b, i, j):
+        return (b // nh) * nhk + (b % nh) // rep, j, 0
+
+    return index
+
+
+def _flash_fwd(q3, k3, v3, scale, causal, nh, nhk):
+    """q3 [B*nh, Sq, D], k3/v3 [B*nhk, Sk, D] -> (o [B*nh, Sq, D],
+    lse [B*nh, Sq, 128])."""
     BH, Sq, D = q3.shape
     Sk = k3.shape[1]
+    _check_divisible(Sq, Sk, D)
     nq, nk = Sq // BQ, Sk // BK
+    kvix = _kv_index(nh, nhk)
     kern = functools.partial(_fwd_kernel, scale=scale, causal=causal, nk=nk)
     o, lse = pl.pallas_call(
         kern,
         grid=(BH, nq, nk),
         in_specs=[
             pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, BK, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, BK, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, BK, D), kvix),
+            pl.BlockSpec((1, BK, D), kvix),
         ],
         out_specs=[
             pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
@@ -148,11 +172,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, dq_s, *,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref,
-                dk_s, dv_s, *, scale, causal, nq):
+                dk_s, dv_s, *, scale, causal, nq, nt):
     j = pl.program_id(1)  # k block
-    i = pl.program_id(2)  # q block (sequential)
+    t = pl.program_id(2)  # combined (group q-head, q block) axis, sequential —
+    i = t % nq            # dk/dv accumulate across the GQA group's q heads
 
-    @pl.when(i == 0)
+    @pl.when(t == 0)
     def _init():
         dk_s[:] = jnp.zeros_like(dk_s)
         dv_s[:] = jnp.zeros_like(dv_s)
@@ -185,23 +210,26 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref,
         dk_s[:] = dk_s[:] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
-    @pl.when(i == nq - 1)
+    @pl.when(t == nt - 1)
     def _finish():
         dk_ref[0] = dk_s[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal):
+def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, nh, nhk):
     BH, Sq, D = q3.shape
     Sk = k3.shape[1]
+    _check_divisible(Sq, Sk, D)
     nq, nk = Sq // BQ, Sk // BK
+    rep = nh // nhk
+    kvix = _kv_index(nh, nhk)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal, nk=nk),
         grid=(BH, nq, nk),
         in_specs=[
             pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, BK, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, BK, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, BK, D), kvix),
+            pl.BlockSpec((1, BK, D), kvix),
             pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, BQ, 128), lambda b, i, j: (b, i, 0)),
@@ -211,24 +239,34 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal):
         scratch_shapes=[pltpu.VMEM((BQ, D), jnp.float32)],
         interpret=_interpret(),
     )(q3, k3, v3, do3, o3, lse)
+
+    # dk/dv: grid batch is the KV row; the combined t axis walks the GQA
+    # group's q heads × q blocks sequentially so dk/dv accumulate the whole
+    # group in VMEM scratch — no materialized head repeat anywhere.
+    BHk = k3.shape[0]
+    nt = rep * nq
+
+    def qix(b, j, t):
+        return (b // nhk) * nh + (b % nhk) * rep + t // nq, t % nq, 0
+
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal, nq=nq),
-        grid=(BH, nk, nq),
+        functools.partial(_dkv_kernel, scale=scale, causal=causal, nq=nq, nt=nt),
+        grid=(BHk, nk, nt),
         in_specs=[
-            pl.BlockSpec((1, BQ, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, BK, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, BK, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, BQ, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, BQ, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, BQ, 128), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, BQ, D), qix),
+            pl.BlockSpec((1, BK, D), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, BQ, D), qix),
+            pl.BlockSpec((1, BQ, D), qix),
+            pl.BlockSpec((1, BQ, 128), lambda b, j, t: qix(b, j, t)[:2] + (0,)),
         ],
         out_specs=[
-            pl.BlockSpec((1, BK, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, BK, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, j, t: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Sk, D), k3.dtype),
-            jax.ShapeDtypeStruct((BH, Sk, D), v3.dtype),
+            jax.ShapeDtypeStruct((BHk, Sk, D), k3.dtype),
+            jax.ShapeDtypeStruct((BHk, Sk, D), v3.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((BK, D), jnp.float32),
@@ -239,20 +277,20 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal):
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash3(q3, k3, v3, scale, causal):
-    o, _ = _flash_fwd(q3, k3, v3, scale, causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash3(q3, k3, v3, scale, causal, nh, nhk):
+    o, _ = _flash_fwd(q3, k3, v3, scale, causal, nh, nhk)
     return o
 
 
-def _flash3_fwd(q3, k3, v3, scale, causal):
-    o, lse = _flash_fwd(q3, k3, v3, scale, causal)
+def _flash3_fwd(q3, k3, v3, scale, causal, nh, nhk):
+    o, lse = _flash_fwd(q3, k3, v3, scale, causal, nh, nhk)
     return o, (q3, k3, v3, o, lse)
 
 
-def _flash3_bwd(scale, causal, res, do):
+def _flash3_bwd(scale, causal, nh, nhk, res, do):
     q3, k3, v3, o, lse = res
-    dq, dk, dv = _flash_bwd(q3, k3, v3, o, lse, do, scale, causal)
+    dq, dk, dv = _flash_bwd(q3, k3, v3, o, lse, do, scale, causal, nh, nhk)
     return dq, dk, dv
 
 
@@ -260,21 +298,26 @@ _flash3.defvjp(_flash3_fwd, _flash3_bwd)
 
 
 def flash_attention_bshd(q, k, v, causal=True, scale=None):
-    """[B, S, H, D] flash attention with GQA support (kv heads repeated)."""
+    """[B, S, H, D] flash attention. GQA indexes kv-head = q-head // group in
+    the kernel's BlockSpecs — K/V are never repeated in HBM (at Llama-3-8B's
+    32q/8kv that repeat would be 4x KV memory)."""
     B, Sq, H, D = q.shape
     Hk = k.shape[2]
-    if H != Hk:
-        rep = H // Hk
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    if H % Hk != 0:
+        raise ValueError(f"q heads ({H}) must be a multiple of kv heads ({Hk})")
     s = scale if scale is not None else 1.0 / math.sqrt(D)
     q3 = jnp.moveaxis(q, 2, 1).reshape(B * H, Sq, D)
-    k3 = jnp.moveaxis(k, 2, 1).reshape(B * H, k.shape[1], D)
-    v3 = jnp.moveaxis(v, 2, 1).reshape(B * H, v.shape[1], D)
-    o3 = _flash3(q3, k3, v3, s, causal)
+    k3 = jnp.moveaxis(k, 2, 1).reshape(B * Hk, k.shape[1], D)
+    v3 = jnp.moveaxis(v, 2, 1).reshape(B * Hk, v.shape[1], D)
+    o3 = _flash3(q3, k3, v3, s, causal, H, Hk)
     return jnp.moveaxis(o3.reshape(B, H, Sq, D), 1, 2)
 
 
-def supported(q_shape, dtype) -> bool:
+def supported(q_shape, kv_shape=None, dtype=None) -> bool:
+    """Single dispatch predicate for the Pallas path ([B, S, H, D] layouts)."""
     B, S, H, D = q_shape
-    return S % BQ == 0 and D in (128, 256) or (D % 128 == 0)
+    ok = (S % BQ == 0) and (D % 64 == 0)
+    if kv_shape is not None:
+        Sk, Hk = kv_shape[1], kv_shape[2]
+        ok = ok and (Sk % BK == 0) and (Hk > 0) and (H % Hk == 0)
+    return ok

@@ -120,3 +120,44 @@ def test_full_step_capture_with_clear_inside():
         loss = float(np.asarray(static(x, y)._buf, np.float32))
         sched.step()
     assert loss < 0.5  # converging
+    # the capture must actually COMPILE (round-1 regression: lazy accumulator
+    # creation during the spy made every optimizer step silently eager-only)
+    assert all(e.compiled is not None and not e.eager_only
+               for e in static._cache.values())
+
+
+def test_adamw_with_clip_capture_compiles():
+    """AdamW + global-norm clip (the bench configuration) must compile, not
+    silently fall back to eager."""
+    pt.seed(0)
+    lin = nn.Linear(4, 2)
+    opt = pt.optimizer.AdamW(learning_rate=1e-3, parameters=lin.parameters(),
+                             grad_clip=nn.ClipGradByGlobalNorm(1.0))
+
+    def step(x, y):
+        loss = ((lin(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    static = pt.jit.to_static(step)
+    x, y = _linear_problem()
+    eager_losses = []
+    for _ in range(4):
+        eager_losses.append(float(np.asarray(static(x, y)._buf, np.float32)))
+    assert all(e.compiled is not None and not e.eager_only
+               for e in static._cache.values())
+    # parity with a pure-eager twin
+    pt.seed(0)
+    lin2 = nn.Linear(4, 2)
+    opt2 = pt.optimizer.AdamW(learning_rate=1e-3, parameters=lin2.parameters(),
+                              grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    ref = []
+    for _ in range(4):
+        loss = ((lin2(x) - y) ** 2).mean()
+        loss.backward()
+        opt2.step()
+        opt2.clear_grad()
+        ref.append(float(np.asarray(loss._buf, np.float32)))
+    np.testing.assert_allclose(eager_losses, ref, rtol=1e-5)
