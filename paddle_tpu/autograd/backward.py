@@ -25,6 +25,19 @@ def _is_float0(x) -> bool:
     return getattr(x, "dtype", None) == jax.dtypes.float0
 
 
+def _same_device(a, b):
+    """Move b onto a's device when both are concrete arrays committed to
+    different single devices (pp: a stage-shared param — e.g. tied embeddings —
+    receives grads from stages pinned to different devices)."""
+    try:
+        da, db = a.device, b.device
+    except Exception:
+        return b
+    if da is not None and db is not None and da != db:
+        return jax.device_put(b, da)
+    return b
+
+
 def backward(tensors, grad_tensors=None, retain_graph=False):
     """paddle.autograd.backward analog."""
     if grad_tensors is None:
@@ -67,7 +80,7 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
             return
         k = id(t)
         if k in cots:
-            cots[k] = cots[k] + cot
+            cots[k] = cots[k] + _same_device(cots[k], cot)
         else:
             cots[k] = cot
             keepalive[k] = t
@@ -93,7 +106,8 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
             if t.grad is None:
                 t.grad = Tensor(cot, stop_gradient=True)
             else:
-                t.grad = Tensor(t.grad._data + cot, stop_gradient=True)
+                t.grad = Tensor(t.grad._data + _same_device(t.grad._data, cot),
+                                stop_gradient=True)
         return cot
 
     # --- seed ready queue: nodes with no pending consumers --------------------

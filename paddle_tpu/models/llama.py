@@ -175,16 +175,13 @@ class LlamaForCausalLM(Layer):
             logits = ops.matmul(hidden, self.llama.embed_tokens.weight,
                                 transpose_y=True)
         if labels is not None:
-            loss = F.cross_entropy(logits.reshape([-1, self.config.vocab_size]),
-                                   labels.reshape([-1]))
             aux = None
             for layer in self.llama.layers:
                 al = getattr(layer.mlp, "aux_loss", None)
                 if al is not None:
                     aux = al if aux is None else aux + al
-            if aux is not None:
-                loss = loss + 0.01 * aux
-            return logits, loss
+            return logits, causal_lm_loss(logits, labels,
+                                          self.config.vocab_size, aux)
         return logits
 
 
@@ -212,6 +209,179 @@ def shard_llama(model: LlamaForCausalLM, mesh, fsdp_axis="dp", mp_axis="mp"):
             put(mlp.up_proj.weight, P(fsdp_axis, mp_axis))
             put(mlp.down_proj.weight, P(mp_axis, fsdp_axis))
     return model
+
+
+def causal_lm_loss(logits, labels, vocab_size, aux_loss=None, aux_coef=0.01):
+    """Token cross-entropy (+ optional MoE load-balance aux) — the one loss
+    formula shared by the dense and pipeline-partitioned models."""
+    loss = F.cross_entropy(logits.reshape([-1, vocab_size]),
+                           labels.reshape([-1]))
+    if aux_loss is not None:
+        loss = loss + aux_coef * aux_loss
+    return loss
+
+
+def make_decoder_stage(config: LlamaConfig):
+    """Pure-jnp Llama decoder block as (init, apply) — the homogeneous stage
+    function for the SPMD stacked-weight pipeline (parallel/pipeline.py), which
+    runs inside shard_map on raw arrays. Real block: RMSNorm → GQA attention
+    with RoPE → RMSNorm → SwiGLU MLP."""
+    import jax
+
+    h = config.hidden_size
+    nh, nkv = config.num_attention_heads, config.num_key_value_heads
+    hd = h // nh
+    m = config.intermediate_size
+    theta = config.rope_theta
+    eps = config.rms_norm_eps
+    std = config.initializer_range
+
+    def init(key):
+        ks = jax.random.split(key, 7)
+        n = lambda k, shape: jax.random.normal(k, shape, jnp.float32) * std
+        return {
+            "ln1": jnp.ones((h,), jnp.float32),
+            "wq": n(ks[0], (h, nh * hd)), "wk": n(ks[1], (h, nkv * hd)),
+            "wv": n(ks[2], (h, nkv * hd)), "wo": n(ks[3], (nh * hd, h)),
+            "ln2": jnp.ones((h,), jnp.float32),
+            "wg": n(ks[4], (h, m)), "wu": n(ks[5], (h, m)),
+            "wd": n(ks[6], (m, h)),
+        }
+
+    def _rms(x, w):
+        v = jnp.mean(x.astype(jnp.float32) ** 2, -1, keepdims=True)
+        return (x * jax.lax.rsqrt(v + eps)).astype(x.dtype) * w
+
+    def _rope(x):
+        b, s, n_heads, d = x.shape
+        pos = jnp.arange(s, dtype=jnp.float32)
+        freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+        ang = pos[:, None] * freqs[None, :]
+        cos, sin = jnp.cos(ang), jnp.sin(ang)
+        x1, x2 = x[..., ::2], x[..., 1::2]
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
+        out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+        return out.reshape(x.shape)
+
+    def apply(p, x):
+        b, s, _ = x.shape
+        y = _rms(x, p["ln1"])
+        q = _rope((y @ p["wq"]).reshape(b, s, nh, hd))
+        k = _rope((y @ p["wk"]).reshape(b, s, nkv, hd))
+        v = (y @ p["wv"]).reshape(b, s, nkv, hd)
+        if nh != nkv:
+            k = jnp.repeat(k, nh // nkv, axis=2)
+            v = jnp.repeat(v, nh // nkv, axis=2)
+        scores = jnp.einsum("bsnd,btnd->bnst", q, k) / jnp.sqrt(float(hd))
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+        att = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bnst,btnd->bsnd", att, v).reshape(b, s, nh * hd)
+        x = x + o @ p["wo"]
+        y = _rms(x, p["ln2"])
+        return x + (jax.nn.silu(y @ p["wg"]) * (y @ p["wu"])) @ p["wd"]
+
+    return init, apply
+
+
+class LlamaEmbeddingPipe(Layer):
+    """Stage-0 pipe chunk: token embedding (reference PaddleNLP
+    LlamaEmbeddingPipe semantics — first pp stage owns the embedding).
+    For MoE configs it also seeds the carried aux-loss stream."""
+
+    def __init__(self, config: LlamaConfig, emit_aux=False):
+        super().__init__()
+        self.embed_tokens = Embedding(config.vocab_size, config.hidden_size,
+                                      weight_attr=Normal(std=config.initializer_range))
+        self._emit_aux = emit_aux
+
+    def forward(self, input_ids):
+        h = self.embed_tokens(input_ids)
+        if self._emit_aux:
+            from ..core.tensor import Tensor
+            import jax.numpy as jnp
+            return (h, Tensor(jnp.zeros((), jnp.float32)))
+        return h
+
+
+class LlamaDecoderLayerPipe(LlamaDecoderLayer):
+    """Decoder chunk that carries the running MoE aux loss through the stage
+    boundary as a second stream member — each chunk's aux contribution stays
+    inside that chunk's tape segment, so the chunked backward never crosses a
+    detach boundary (the reference allreduces aux across the pp group)."""
+
+    def forward(self, x):
+        x, aux = x
+        h = super().forward(x)
+        al = getattr(self.mlp, "aux_loss", None)
+        if al is not None:
+            aux = aux + al
+        return (h, aux)
+
+
+class LlamaNormHeadPipe(Layer):
+    """Last pipe chunk: final RMSNorm + LM head → logits. With tied embeddings
+    the weight is read through a closure (not registered here) so it belongs
+    to exactly one stage's parameter list."""
+
+    def __init__(self, config: LlamaConfig, tied_weight_getter=None):
+        super().__init__()
+        self.config = config
+        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+            self._tied_weight_getter = tied_weight_getter
+        else:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  weight_attr=Normal(std=config.initializer_range),
+                                  bias_attr=False)
+
+    def forward(self, x):
+        aux = None
+        if isinstance(x, tuple):
+            x, aux = x
+        h = self.norm(x)
+        if self.lm_head is not None:
+            logits = self.lm_head(h)
+        else:
+            logits = ops.matmul(h, self._tied_weight_getter(), transpose_y=True)
+        return logits if aux is None else (logits, aux)
+
+
+class LlamaForCausalLMPipe:
+    """Pipeline-partitioned Llama (reference: PaddleNLP LlamaForCausalLMPipe on
+    fleet pp_layers.py:258). Returns a PipelineLayer whose chunks are
+    [embedding | decoder blocks … | norm+head], segmented by decoder-layer
+    count so embedding rides stage 0 and the head rides the last stage."""
+
+    def __new__(cls, config: LlamaConfig, num_stages=2,
+                num_virtual_pipeline_stages=None, recompute_interval=0,
+                topology=None):
+        from ..parallel.pipeline_layer import PipelineLayer
+
+        moe = config.num_experts > 0
+        embed = LlamaEmbeddingPipe(config, emit_aux=moe)
+        dec_cls = LlamaDecoderLayerPipe if moe else LlamaDecoderLayer
+        decoders = [dec_cls(config) for _ in range(config.num_hidden_layers)]
+        head = LlamaNormHeadPipe(
+            config, tied_weight_getter=lambda: embed.embed_tokens.weight)
+
+        def loss_fn(out, labels):
+            logits, aux = out if isinstance(out, tuple) else (out, None)
+            return causal_lm_loss(logits, labels, config.vocab_size, aux)
+
+        pipe = PipelineLayer(
+            [embed] + decoders + [head],
+            num_stages=num_stages, loss_fn=loss_fn,
+            seg_method=f"layer:{dec_cls.__name__}",
+            recompute_interval=recompute_interval,
+            num_virtual_pipeline_stages=num_virtual_pipeline_stages,
+            topology=topology)
+        pipe.config = config
+        if config.tie_word_embeddings:
+            pipe._pin_exempt.add(id(embed.embed_tokens.weight))
+        return pipe
 
 
 def llama3_8b():

@@ -1,21 +1,38 @@
-"""PipelineLayer container + PipelineParallel wrapper (reference:
+"""PipelineLayer container + PipelineParallel 1F1B schedule (reference:
 fleet/meta_parallel/parallel_layers/pp_layers.py:258 PipelineLayer,
-fleet/meta_parallel/pipeline_parallel.py:255 PipelineParallel, train_batch:820).
+fleet/meta_parallel/pipeline_parallel.py:255 PipelineParallel, 1F1B
+forward_backward_pipeline:575, interleave PipelineParallelWithInterleave:1179).
 
-TPU-native: stages are contiguous segments of the layer list whose parameters
-are pinned (device_put) onto the stage's slice of the mesh; activations flow
-between slices through ordinary op dataflow (PJRT moves buffers; under capture
-XLA emits device-to-device copies). The microbatch loop + grad accumulation
-runs on the tape, so 'schedules' differ only in traversal order:
-FThenB (implemented), 1F1B (memory ordering — same numerics).
+TPU-native realization. The reference drives per-rank schedules over NCCL p2p;
+on a single-controller TPU mesh every stage's program is issued from one host,
+so the schedule is a *global interleaving* of per-stage forward/backward ops.
+What the schedule controls is the same thing it controls on GPU: how many
+microbatches are live at once (peak activation memory) and the op ordering XLA
+sees. Stage boundaries are realized as tape detach points: each stage's
+forward starts from a fresh leaf tensor, so its backward can run independently
+given the output cotangent — exactly the reference's p2p activation/grad
+hand-off, with PJRT device-to-device copies instead of NCCL send/recv.
+
+Schedules:
+  * FThenB (GPipe)   — all M forwards, then all M backwards; M live microbatches.
+  * 1F1B             — warmup of (num_stages-1) forwards, then steady-state
+                       one-forward-one-backward, then drain; at most
+                       `num_stages` live microbatches regardless of M.
+  * interleave (VPP) — layers split into num_stages × V chunks assigned
+                       round-robin (stage s owns chunks s, s+P, s+2P, …);
+                       1F1B at chunk granularity.
+
+The homogeneous stacked-stage SPMD fast path (shard_map + ppermute) lives in
+pipeline.py; this module is the generic heterogeneous-stage container.
 """
 from __future__ import annotations
+
+from collections import deque
 
 import numpy as np
 import jax
 
 from ..core.tensor import Tensor
-from ..core.dispatch import unwrap
 from ..nn.layer.layers import Layer
 from ..nn.layer.container import LayerList
 from .. import ops
@@ -45,7 +62,25 @@ class SharedLayerDesc(LayerDesc):
         self.shared_weight_attr = shared_weight_attr
 
 
+def _segment_uniform(n_items, n_parts):
+    """Even split of n_items into n_parts contiguous bounds."""
+    per = int(np.ceil(n_items / n_parts)) if n_items else 0
+    return [(min(i * per, n_items), min((i + 1) * per, n_items))
+            for i in range(n_parts)]
+
+
 class PipelineLayer(Layer):
+    """Stage-partitioned layer container.
+
+    seg_method:
+      * "uniform"            — split the raw layer list evenly.
+      * "layer:ClassName"    — count only layers of that class when balancing
+                               (reference SegmentLayers with method
+                               "layer:TransformerBlock"); leading non-matching
+                               layers (embedding) join the first chunk, trailing
+                               ones (final norm / head) join the last.
+    """
+
     def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
                  seg_method="uniform", recompute_interval=0, recompute_ctx=None,
                  num_virtual_pipeline_stages=None):
@@ -53,7 +88,9 @@ class PipelineLayer(Layer):
         self._loss_fn = loss_fn
         self._topo = topology
         self._num_stages = num_stages or (topology.get_dim("pp") if topology else 1)
+        self._num_virtual = num_virtual_pipeline_stages or 1
         self._recompute_interval = recompute_interval
+        self._seg_method = seg_method
         descs = list(layers)
         built = []
         self._shared = {}
@@ -80,49 +117,152 @@ class PipelineLayer(Layer):
         self.run_functions = built
         reg = LayerList([l for l, _ in built if isinstance(l, Layer)])
         self._layers_list = reg
-        # stage boundaries: uniform split
-        n = len(built)
-        per = int(np.ceil(n / self._num_stages))
-        self._stage_bounds = [(i * per, min((i + 1) * per, n))
-                              for i in range(self._num_stages)]
+        self._chunk_bounds = self._segment(self._num_stages * self._num_virtual)
+        self._pin_exempt = set()   # ids of params shared across stages (tied)
+
+    # ---- partitioning --------------------------------------------------------
+    def _segment(self, n_parts):
+        n = len(self.run_functions)
+        m = self._seg_method
+        if isinstance(m, str) and m.startswith("layer:"):
+            cls_name = m.split(":", 1)[1]
+            idxs = [i for i, (l, _) in enumerate(self.run_functions)
+                    if type(l).__name__ == cls_name]
+            if not idxs:
+                return _segment_uniform(n, n_parts)
+            if len(idxs) % n_parts != 0:
+                raise ValueError(
+                    f"cannot split {len(idxs)} {cls_name} layers into "
+                    f"{n_parts} equal pipeline chunks")
+            per = len(idxs) // n_parts
+            bounds = []
+            for p in range(n_parts):
+                a = 0 if p == 0 else idxs[p * per]
+                b = n if p == n_parts - 1 else idxs[(p + 1) * per]
+                bounds.append((a, b))
+            return bounds
+        return _segment_uniform(n, n_parts)
+
+    @property
+    def num_chunks(self):
+        return len(self._chunk_bounds)
+
+    def stage_of_chunk(self, c):
+        """Round-robin virtual-stage assignment: chunk c lives on stage c % P
+        (reference interleave get_model_chunk_id inverse)."""
+        return c % self._num_stages
 
     def get_stage_from_index(self, idx):
-        for s, (a, b) in enumerate(self._stage_bounds):
+        for c, (a, b) in enumerate(self._chunk_bounds):
             if a <= idx < b:
-                return s
+                return self.stage_of_chunk(c)
         return self._num_stages - 1
 
-    def forward(self, x):
+    # ---- execution -----------------------------------------------------------
+    def _run_segment(self, a, b, x):
         from ..distributed.fleet.recompute import recompute
-        for i, (layer, ffn) in enumerate(self.run_functions):
+        for i in range(a, b):
+            layer, ffn = self.run_functions[i]
             fn = ffn if ffn is not None else layer
             if self._recompute_interval and isinstance(layer, Layer) and \
                     i % self._recompute_interval == 0 and self.training:
-                x = recompute(fn, x) if ffn is None else recompute(lambda v: ffn(layer, v), x)
+                x = recompute(fn, x) if ffn is None else \
+                    recompute(lambda v: ffn(layer, v), x)
             else:
                 x = fn(x) if ffn is None else ffn(layer, x)
         return x
 
+    def forward_chunk(self, c, x):
+        a, b = self._chunk_bounds[c]
+        return self._run_segment(a, b, x)
+
+    def forward(self, x):
+        return self._run_segment(0, len(self.run_functions), x)
+
+    def chunk_parameters(self, c):
+        a, b = self._chunk_bounds[c]
+        out = []
+        for layer, _ in self.run_functions[a:b]:
+            if isinstance(layer, Layer):
+                out.extend(layer.parameters())
+        return out
+
     def pin_stages(self, mesh, axis_name="pp"):
-        """Place each stage's params on its slice of the pp axis."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        """Place each chunk's params on its stage's slice of the pp axis.
+        With VPP the round-robin assignment means stage s hosts V
+        non-contiguous chunks — the same placement the reference's interleave
+        partitioner produces (pp_layers.py _segment_network_for_interleave)."""
         jmesh = mesh.jax_mesh() if hasattr(mesh, "jax_mesh") else mesh
         names = list(jmesh.axis_names)
         if axis_name not in names:
             return self
         axis = names.index(axis_name)
         devs = np.moveaxis(jmesh.devices, axis, 0)
-        for s, (a, b) in enumerate(self._stage_bounds):
-            stage_devs = devs[s].reshape(-1)
-            for layer, _ in self.run_functions[a:b]:
-                if isinstance(layer, Layer):
-                    for p in layer.parameters():
-                        p._buf = jax.device_put(p._buf, stage_devs[0])
+        # params shared across chunks (tied embeddings, SharedLayerDesc) stay
+        # uncommitted so every consuming stage can read them — the reference
+        # instead allreduces tied-weight grads across the pp group
+        counts = {}
+        for c in range(self.num_chunks):
+            for p in self.chunk_parameters(c):
+                counts[id(p)] = counts.get(id(p), 0) + 1
+        shared = {k for k, v in counts.items() if v > 1} | self._pin_exempt
+        self._chunk_device = {}
+        for c in range(self.num_chunks):
+            stage_devs = np.asarray(devs[self.stage_of_chunk(c)]).reshape(-1)
+            self._chunk_device[c] = stage_devs[0]
+            for p in self.chunk_parameters(c):
+                if id(p) not in shared:
+                    p._buf = jax.device_put(p._buf, stage_devs[0])
         return self
 
 
+def _is_float_tensor(t):
+    import jax.numpy as jnp
+    return isinstance(t, Tensor) and jnp.issubdtype(t._data.dtype, jnp.floating)
+
+
+def _as_leaf(t, device=None):
+    """Detach into a fresh grad-requiring leaf — the tape-level stage boundary
+    (the reference's p2p recv of the activation). When stages are pinned,
+    `device` hops the activation onto the consuming stage's device (the
+    device-to-device copy NCCL send/recv performs on GPU)."""
+    if not _is_float_tensor(t):
+        return t
+    buf = t._data if device is None else jax.device_put(t._data, device)
+    leaf = Tensor(buf, stop_gradient=False)
+    return leaf
+
+
+def _as_leaf_struct(struct, device=None):
+    """Boundary detach over a flat tuple/list stream (stages may hand off
+    several tensors — e.g. hidden state + carried MoE aux loss — matching the
+    reference's tuple p2p payloads)."""
+    if isinstance(struct, (tuple, list)):
+        return type(struct)(_as_leaf(t, device) for t in struct)
+    return _as_leaf(struct, device)
+
+
+def _boundary_leaves(struct):
+    """Float-Tensor members of a boundary structure, positionally ordered."""
+    if isinstance(struct, (tuple, list)):
+        return [t for t in struct if _is_float_tensor(t)]
+    return [struct] if _is_float_tensor(struct) else []
+
+
+def _hop_cot(g, like):
+    """Move a boundary cotangent onto the producing stage's device."""
+    try:
+        dev = like._data.device
+    except Exception:
+        return g
+    return Tensor(jax.device_put(g._data, dev), stop_gradient=True)
+
+
 class PipelineParallel(Layer):
-    """reference pipeline_parallel.py:255; train_batch:820."""
+    """1F1B microbatch schedule (reference pipeline_parallel.py:255,
+    forward_backward_pipeline:575 — warmup / steady 1F1B / drain)."""
+
+    schedule_mode = "1F1B"
 
     def __init__(self, layers, hcg=None, strategy=None):
         super().__init__()
@@ -132,6 +272,11 @@ class PipelineParallel(Layer):
         cfg = getattr(strategy, "pipeline_configs", {}) if strategy else {}
         self.accumulate_steps = cfg.get("accumulate_steps", 1)
         self.micro_batch_size = cfg.get("micro_batch_size", None)
+        self.max_in_flight = 0       # schedule introspection (tests assert this)
+
+    @property
+    def num_stages(self):
+        return getattr(self._layers, "_num_stages", 1)
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
@@ -142,27 +287,86 @@ class PipelineParallel(Layer):
             return list(zip(*parts))
         return ops.split(data, n, axis=0)
 
+    # ---- per-microbatch stage-wise fwd/bwd ----------------------------------
+    def _forward_micro(self, x, y, loss_fn, n_micro):
+        """Forward one microbatch chunk-by-chunk with detach boundaries.
+        Returns (boundaries, loss): boundaries[c] = (leaf_in, out) per chunk."""
+        pl = self._layers
+        n_chunks = getattr(pl, "num_chunks", None)
+        dev_of = getattr(pl, "_chunk_device", None) or {}
+        boundaries = []
+        h = x
+        if n_chunks is None:          # plain Layer: single stage
+            out = pl(h)
+            boundaries.append((h, out))
+            h = out
+        else:
+            for c in range(n_chunks):
+                leaf = _as_leaf_struct(h, device=dev_of.get(c)) if c > 0 else h
+                out = pl.forward_chunk(c, leaf)
+                boundaries.append((leaf, out))
+                h = out
+        lf = loss_fn or getattr(pl, "_loss_fn", None)
+        loss = lf(h, y) if lf is not None else h
+        loss = loss / n_micro
+        return boundaries, loss
+
+    def _backward_micro(self, boundaries, loss, scaler=None):
+        """Backward chunk-by-chunk in reverse — each chunk's tape sweep is
+        independent because its input is a detached leaf; the cotangent hops
+        the boundary exactly like the reference's p2p grad send."""
+        from ..autograd.backward import backward as _backward
+        pinned = bool(getattr(self._layers, "_chunk_device", None))
+        cots = None          # aligned with _boundary_leaves of chunk c's output
+        for c in reversed(range(len(boundaries))):
+            leaf_struct, out_struct = boundaries[c]
+            if c == len(boundaries) - 1:
+                l = scaler.scale(loss) if scaler is not None else loss
+                _backward([l], [None])
+            else:
+                outs = _boundary_leaves(out_struct)
+                pairs = [(o, g) for o, g in zip(outs, cots) if g is not None]
+                if not pairs:
+                    raise RuntimeError(
+                        f"pipeline chunk {c + 1} produced no input gradient")
+                _backward([o for o, _ in pairs], [g for _, g in pairs])
+            if c > 0:
+                leaves = _boundary_leaves(leaf_struct)
+                prev_outs = _boundary_leaves(boundaries[c - 1][1])
+                cots = []
+                for leaf, po in zip(leaves, prev_outs):
+                    g = leaf.grad
+                    leaf.grad = None
+                    if g is not None and pinned:
+                        g = _hop_cot(g, po)
+                    cots.append(g)
+
+    # ---- schedules -----------------------------------------------------------
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None,
                     loss_fn=None):
-        """F-then-B microbatch schedule with grad accumulation on the tape."""
+        """1F1B: warmup (P-1) forwards, steady one-fwd-one-bwd, drain.
+        Peak live microbatches = min(P, M) — the 1F1B memory bound — vs
+        GPipe's M (reference forward_backward_pipeline:575)."""
         self.train()
         inputs, labels = data
         n = self.accumulate_steps
         micro_x = self._split_micro(inputs, n)
         micro_y = self._split_micro(labels, n)
+        P = self.num_stages
+        in_flight = deque()
+        self.max_in_flight = 0
         total = None
-        losses = []
-        for x, y in zip(micro_x, micro_y):
-            out = self._layers(x)
-            lf = loss_fn or getattr(self._layers, "_loss_fn", None)
-            loss = lf(out, y) if lf is not None else out
-            loss = loss / n
-            if scaler is not None:
-                scaler.scale(loss).backward()
-            else:
-                loss.backward()
-            losses.append(loss)
-            total = loss if total is None else total + loss.detach()
+        for m in range(n):
+            boundaries, loss = self._forward_micro(micro_x[m], micro_y[m],
+                                                   loss_fn, n)
+            d = loss.detach()
+            total = d if total is None else total + d
+            in_flight.append((boundaries, loss))
+            self.max_in_flight = max(self.max_in_flight, len(in_flight))
+            if len(in_flight) >= P:           # steady state: 1F1B
+                self._backward_micro(*in_flight.popleft(), scaler=scaler)
+        while in_flight:                      # drain
+            self._backward_micro(*in_flight.popleft(), scaler=scaler)
         if scaler is not None:
             scaler.step(optimizer)
         else:
@@ -170,10 +374,7 @@ class PipelineParallel(Layer):
         optimizer.clear_grad()
         if lr_scheduler is not None:
             lr_scheduler.step()
-        acc = losses[0].detach()
-        for l in losses[1:]:
-            acc = acc + l.detach()
-        return acc
+        return total
 
     def eval_batch(self, data, compute_loss=True):
         self.eval()
@@ -194,7 +395,162 @@ class PipelineParallel(Layer):
         return self._layers.parameters(*a, **k)
 
 
+def interleave_schedule(num_micro, num_stages, num_virtual, rank):
+    """Per-rank interleaved-1F1B op list: [('F'|'B', microbatch, chunk), ...]
+    (reference PipelineParallelWithInterleave:1179 / Megatron interleaving).
+
+    Forward-op k on rank r touches chunk ((k % (P*V)) // P) of microbatch
+    ((k // (P*V)) * P + k % P); warmup covers (P - r - 1) * 2 + (V - 1) * P
+    forward ops, then steady state alternates 1F1B, then drain.
+    Used for introspection/verification of the global executed order.
+    """
+    P, V, M = num_stages, num_virtual, num_micro
+    if M % P != 0:
+        raise ValueError("interleave requires microbatches % stages == 0")
+    total = M * V
+
+    def fwd_k(k):
+        grp = k // (P * V)
+        chunk = (k % (P * V)) // P
+        micro = grp * P + k % P
+        return ("F", micro, chunk)
+
+    def bwd_k(k):
+        grp = k // (P * V)
+        chunk = V - 1 - (k % (P * V)) // P
+        micro = grp * P + k % P
+        return ("B", micro, chunk)
+
+    warmup = min((P - rank - 1) * 2 + (V - 1) * P, total)
+    sched = [fwd_k(k) for k in range(warmup)]
+    for k in range(warmup, total):
+        sched.append(fwd_k(k))
+        sched.append(bwd_k(k - warmup))
+    sched.extend(bwd_k(k) for k in range(total - warmup, total))
+    return sched
+
+
 class PipelineParallelWithInterleave(PipelineParallel):
-    """Interleaved/VPP schedule (reference :1179) — numerics identical; the
-    virtual-stage ordering is a memory/overlap optimization the XLA scheduler
-    performs on the captured program."""
+    """Interleaved (virtual-stage) 1F1B (reference :1179).
+
+    The container must be built with num_virtual_pipeline_stages=V; chunks are
+    assigned round-robin so stage s hosts chunks s, s+P, … Execution runs the
+    chunk-granular schedule: warmup forwards per the interleave depth, then
+    one-chunk-forward/one-chunk-backward, then drain. Numerics are identical
+    to 1F1B; what changes is chunk placement + op order (bubble shrinks by V)."""
+
+    schedule_mode = "interleave"
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__(layers, hcg=hcg, strategy=strategy)
+        if getattr(layers, "_num_virtual", 1) < 2:
+            raise ValueError(
+                "PipelineParallelWithInterleave needs a PipelineLayer built "
+                "with num_virtual_pipeline_stages >= 2")
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None,
+                    loss_fn=None):
+        self.train()
+        inputs, labels = data
+        n = self.accumulate_steps
+        P = self.num_stages
+        V = self._layers._num_virtual
+        if n % P != 0:
+            raise ValueError(
+                f"interleave schedule needs accumulate_steps ({n}) divisible "
+                f"by num_stages ({P})")
+        micro_x = self._split_micro(inputs, n)
+        micro_y = self._split_micro(labels, n)
+
+        # chunk-granular state per microbatch
+        G = self._layers.num_chunks                     # global chunks = P * V
+        acts = [[None] * G for _ in range(n)]           # (leaf, out) per chunk
+        losses = [None] * n
+        cots = [None] * n                               # boundary cotangent
+        self.max_in_flight = 0
+        live = set()
+
+        dev_of = getattr(self._layers, "_chunk_device", None) or {}
+
+        def fwd_chunk(m, g):
+            h = micro_x[m] if g == 0 else acts[m][g - 1][1]
+            leaf = _as_leaf_struct(h, device=dev_of.get(g)) if g > 0 else h
+            out = self._layers.forward_chunk(g, leaf)
+            acts[m][g] = (leaf, out)
+            if g + 1 == G:
+                lf = loss_fn or getattr(self._layers, "_loss_fn", None)
+                losses[m] = (lf(out, micro_y[m]) if lf is not None else out) / n
+            live.add(m)
+            self.max_in_flight = max(self.max_in_flight, len(live))
+
+        def bwd_chunk(m, g):
+            from ..autograd.backward import backward as _backward
+            leaf_struct, out_struct = acts[m][g]
+            if g == G - 1:
+                l = scaler.scale(losses[m]) if scaler is not None else losses[m]
+                _backward([l], [None])
+            else:
+                outs = _boundary_leaves(out_struct)
+                pairs = [(o, c) for o, c in zip(outs, cots[m]) if c is not None]
+                if not pairs:
+                    raise RuntimeError(
+                        f"pipeline chunk {g + 1} produced no input gradient")
+                _backward([o for o, _ in pairs], [c for _, c in pairs])
+            if g > 0:
+                leaves = _boundary_leaves(leaf_struct)
+                prev_outs = _boundary_leaves(acts[m][g - 1][1])
+                gs = []
+                for leaf, po in zip(leaves, prev_outs):
+                    cg = leaf.grad
+                    leaf.grad = None
+                    if cg is not None and dev_of:
+                        cg = _hop_cot(cg, po)
+                    gs.append(cg)
+                cots[m] = gs
+            acts[m][g] = None
+            if g == 0:
+                live.discard(m)
+
+        # Merge every rank's interleave schedule into one dependency-ordered
+        # global execution (the single-controller realization of the per-rank
+        # p2p-synchronized schedules). Rank r owns global chunks v*P + r.
+        rank_ops = [deque(interleave_schedule(n, P, V, r)) for r in range(P)]
+        done_f, done_b = set(), set()
+
+        def runnable(op, r):
+            kind, m, v = op
+            g = v * P + r
+            if kind == "F":
+                return g == 0 or (m, g - 1) in done_f
+            if (m, g) not in done_f:
+                return False
+            return g == G - 1 or (m, g + 1) in done_b
+
+        while any(rank_ops):
+            progress = False
+            for r in range(P):
+                while rank_ops[r] and runnable(rank_ops[r][0], r):
+                    kind, m, v = rank_ops[r].popleft()
+                    g = v * P + r
+                    if kind == "F":
+                        fwd_chunk(m, g)
+                        done_f.add((m, g))
+                    else:
+                        bwd_chunk(m, g)
+                        done_b.add((m, g))
+                    progress = True
+            if not progress:
+                raise RuntimeError("interleave schedule deadlocked")
+
+        total = None
+        for m in range(n):
+            d = losses[m].detach()
+            total = d if total is None else total + d
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return total
