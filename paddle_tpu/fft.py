@@ -1,0 +1,126 @@
+"""paddle.fft analog — discrete Fourier transforms (reference:
+python/paddle/fft.py, ~1.8k LoC over phi fft kernels; here each transform is
+the jnp.fft primitive routed through dispatch so autograd/AMP/capture apply)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import apply_op
+from .core.tensor import Tensor
+
+__all__ = [
+    "fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+    "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+    "hfft", "ihfft", "hfft2", "ihfft2", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    if norm in (None, "backward", "forward", "ortho"):
+        return norm
+    raise ValueError(f"invalid norm {norm!r}")
+
+
+def _1d(name, jfn):
+    def op(x, n=None, axis=-1, norm="backward", name_=None):
+        return apply_op(name, lambda a: jfn(a, n=n, axis=axis,
+                                            norm=_norm(norm)), x)
+    op.__name__ = name
+    return op
+
+
+def _2d(name, jfn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name_=None):
+        return apply_op(name, lambda a: jfn(a, s=s, axes=axes,
+                                            norm=_norm(norm)), x)
+    op.__name__ = name
+    return op
+
+
+def _nd(name, jfn):
+    def op(x, s=None, axes=None, norm="backward", name_=None):
+        return apply_op(name, lambda a: jfn(a, s=s, axes=axes,
+                                            norm=_norm(norm)), x)
+    op.__name__ = name
+    return op
+
+
+fft = _1d("fft", jnp.fft.fft)
+ifft = _1d("ifft", jnp.fft.ifft)
+rfft = _1d("rfft", jnp.fft.rfft)
+irfft = _1d("irfft", jnp.fft.irfft)
+hfft = _1d("hfft", jnp.fft.hfft)
+ihfft = _1d("ihfft", jnp.fft.ihfft)
+
+fft2 = _2d("fft2", jnp.fft.fft2)
+ifft2 = _2d("ifft2", jnp.fft.ifft2)
+rfft2 = _2d("rfft2", jnp.fft.rfft2)
+irfft2 = _2d("irfft2", jnp.fft.irfft2)
+
+fftn = _nd("fftn", jnp.fft.fftn)
+ifftn = _nd("ifftn", jnp.fft.ifftn)
+rfftn = _nd("rfftn", jnp.fft.rfftn)
+irfftn = _nd("irfftn", jnp.fft.irfftn)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """Hermitian 2-D fft: irfft along the last axis after fft on the first."""
+    def f(a):
+        return jnp.fft.fft2(jnp.conj(a), s=s, axes=axes, norm=_norm(norm)).real
+    # compose from hfft over the last axis and fft over the first
+    def g(a):
+        n0 = None if s is None else s[0]
+        n1 = None if s is None else s[1]
+        out = jnp.fft.hfft(a, n=n1, axis=axes[1], norm=_norm(norm))
+        return jnp.fft.fft(out, n=n0, axis=axes[0], norm=_norm(norm)).real
+    return apply_op("hfft2", g, x)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    def g(a):
+        n0 = None if s is None else s[0]
+        n1 = None if s is None else s[1]
+        out = jnp.fft.ihfft(a, n=n1, axis=axes[1], norm=_norm(norm))
+        return jnp.fft.ifft(out, n=n0, axis=axes[0], norm=_norm(norm))
+    return apply_op("ihfft2", g, x)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    def g(a):
+        ax = axes if axes is not None else list(range(a.ndim))
+        nlast = None if s is None else s[-1]
+        out = jnp.fft.hfft(a, n=nlast, axis=ax[-1], norm=_norm(norm))
+        if len(ax) > 1:
+            sn = None if s is None else s[:-1]
+            out = jnp.fft.fftn(out, s=sn, axes=ax[:-1], norm=_norm(norm)).real
+        return out
+    return apply_op("hfftn", g, x)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    def g(a):
+        ax = axes if axes is not None else list(range(a.ndim))
+        nlast = None if s is None else s[-1]
+        out = jnp.fft.ihfft(a, n=nlast, axis=ax[-1], norm=_norm(norm))
+        if len(ax) > 1:
+            sn = None if s is None else s[:-1]
+            out = jnp.fft.ifftn(out, s=sn, axes=ax[:-1], norm=_norm(norm))
+        return out
+    return apply_op("ihfftn", g, x)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d=d).astype(dtype or jnp.float32))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d=d).astype(dtype or jnp.float32))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes), x)

@@ -11,7 +11,8 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 from ..core.dispatch import apply_op, unwrap
-from . import creation, math, manipulation, logic, linalg, search, random, stat
+from . import (creation, math, manipulation, logic, linalg, search, random,
+               stat, math_extra, manip_extra, linalg_extra)
 from .creation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
@@ -20,6 +21,9 @@ from .linalg import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
+from .math_extra import *  # noqa: F401,F403
+from .manip_extra import *  # noqa: F401,F403
+from .linalg_extra import *  # noqa: F401,F403
 from .einsum_op import einsum  # noqa: F401
 
 
@@ -157,7 +161,8 @@ _DELEGATED = [
     "bitwise_or", "bitwise_xor", "bitwise_not", "equal_all", "all", "any", "isclose",
     "allclose", "where",
     # manipulation
-    "reshape", "reshape_", "transpose", "moveaxis", "swapaxes", "t", "squeeze",
+    "reshape", "reshape_", "transpose", "transpose_", "moveaxis", "swapaxes",
+    "t", "squeeze",
     "unsqueeze", "split", "chunk", "unbind", "flatten", "tile", "expand",
     "broadcast_to", "expand_as", "flip", "rot90", "roll", "gather", "gather_nd",
     "take_along_axis", "put_along_axis", "index_select", "index_add", "index_put",
@@ -174,6 +179,21 @@ _DELEGATED = [
     "var", "std", "median", "nanmedian", "quantile", "nanquantile",
     # creation
     "tril", "triu", "diag", "clone",
+    # math_extra
+    "sinc", "signbit", "gammaln", "gammainc", "gammaincc", "multigammaln",
+    "polygamma", "diff", "sgn", "frexp", "trapezoid", "cumulative_trapezoid",
+    "vander", "renorm", "isin", "histogram_bin_edges", "reduce_as",
+    # manip_extra
+    "reverse", "less", "bitwise_invert", "tensor_split", "hsplit", "vsplit",
+    "dsplit", "unstack", "take", "unflatten", "as_strided", "view_as",
+    "matrix_transpose", "rank", "is_complex", "is_integer", "is_floating_point",
+    "slice_scatter", "select_scatter", "diagonal_scatter", "index_fill",
+    "masked_scatter",
+    # linalg_extra
+    "lu", "lu_unpack", "ormqr", "cond", "cholesky_inverse", "cdist",
+    # random extras
+    "top_p_sampling", "cauchy_", "geometric_", "log_normal_", "uniform_",
+    "normal_", "exponential_",
 ]
 
 _INPLACE = {
@@ -189,6 +209,30 @@ _INPLACE = {
     "fill_diagonal_": manipulation.fill_diagonal, "cast_": manipulation.cast,
     "scatter_": manipulation.scatter, "where_": logic.where,
 }
+
+# the remaining in-place tensor_method_func surface is mechanical: `name_`
+# computes out-of-place then rebinds the buffer (reference inplace codegen,
+# paddle/fluid/pybind/eager_generator: *_ apis)
+_AUTO_INPLACE = [
+    "asin", "cumsum", "cumprod", "logit", "log", "log2", "log10", "square",
+    "multigammaln", "nan_to_num", "hypot", "floor_divide", "mod", "log1p",
+    "addmm", "lgamma", "gammaincc", "gammainc", "equal", "greater_equal",
+    "greater_than", "less_equal", "less_than", "less", "logical_and",
+    "logical_not", "logical_or", "logical_xor", "not_equal", "tan", "gammaln",
+    "digamma", "trunc", "frac", "bitwise_and", "bitwise_or", "bitwise_xor",
+    "bitwise_not", "bitwise_invert", "atanh", "gcd", "lcm", "erfinv",
+    "put_along_axis", "ldexp", "i0", "polygamma", "renorm", "tril", "triu",
+    "acos", "atan", "cos", "cosh", "sin", "sinc", "sinh", "acosh", "asinh",
+    "copysign", "bitwise_left_shift", "bitwise_right_shift", "index_fill",
+    "masked_scatter", "t",
+]
+
+
+def _set_(self, source, name=None):
+    """x.set_(y): rebind x's buffer/shape/dtype to y's (reference set_ op)."""
+    src = source._data if isinstance(source, Tensor) else jnp.asarray(source)
+    self._data = src
+    return self
 
 
 def _install():
@@ -207,12 +251,24 @@ def _install():
         setattr(Tensor, name, make(fn))
     for name, fn in _INPLACE.items():
         setattr(Tensor, name, _inplace_from(fn))
+    for base in _AUTO_INPLACE:
+        fn = getattr(mod, base, None)
+        if fn is not None:
+            setattr(Tensor, base + "_", _inplace_from(fn))
+            setattr(mod, base + "_", _inplace_from(fn))
+    # paddle name quirk: floor_mod_ aliases mod_
+    Tensor.floor_mod_ = Tensor.mod_
+    Tensor.set_ = _set_
     # random inplace
-    from .random import uniform_, normal_, exponential_, bernoulli_
+    from .random import (uniform_, normal_, exponential_, bernoulli_,
+                         cauchy_, geometric_, log_normal_)
     Tensor.uniform_ = uniform_
     Tensor.normal_ = normal_
     Tensor.exponential_ = exponential_
     Tensor.bernoulli_ = bernoulli_
+    Tensor.cauchy_ = cauchy_
+    Tensor.geometric_ = geometric_
+    Tensor.log_normal_ = log_normal_
 
     def fill_(self, value):
         self._data = jnp.full_like(self._data, value)
@@ -229,6 +285,16 @@ def _install():
         self._data = v.astype(self._data.dtype).reshape(self._data.shape)
         return self
     Tensor.set_value = set_value
+
+    def _stft_m(self, *a, **k):
+        from ..signal import stft
+        return stft(self, *a, **k)
+
+    def _istft_m(self, *a, **k):
+        from ..signal import istft
+        return istft(self, *a, **k)
+    Tensor.stft = _stft_m
+    Tensor.istft = _istft_m
 
 
 _install()

@@ -182,3 +182,30 @@ def complex(real, imag, name=None):
 
 def polar(abs, angle, name=None):
     return apply_op("polar", lambda r, t: jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t)), abs, angle)
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """reference python/paddle/tensor/creation.py create_tensor."""
+    return Tensor(jnp.zeros((), _dt(dtype)))
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """reference python/paddle/tensor/creation.py create_parameter."""
+    from ..core.tensor import Parameter
+    dt = _dt(dtype)
+    if default_initializer is not None:
+        t = Tensor(jnp.zeros(_norm_shape(shape), dt))
+        default_initializer(t)
+        arr = t._data
+    elif is_bias:
+        arr = jnp.zeros(_norm_shape(shape), dt)
+    else:
+        k = float(np.sqrt(6.0 / max(1, int(np.prod(shape)))))
+        from ..core.rng import next_key
+        import jax as _jax
+        arr = _jax.random.uniform(next_key(), _norm_shape(shape), jnp.float32,
+                                  -k, k).astype(dt)
+    p = Parameter(arr)
+    p.stop_gradient = False
+    return p

@@ -1,0 +1,176 @@
+"""Manipulation/indexing ops closing the paddle.tensor surface gap (reference:
+python/paddle/tensor/manipulation.py — tensor_split family, unstack, take,
+unflatten, as_strided, scatter variants; kernels phi/kernels/*)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply_op, unwrap
+from . import manipulation as _manip
+from . import logic as _logic
+
+
+def reverse(x, axis, name=None):
+    return _manip.flip(x, axis)
+
+
+less = _logic.less_than
+bitwise_invert = _logic.bitwise_not
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    n = x.shape[axis] if hasattr(x, "shape") else None
+    if isinstance(num_or_indices, int):
+        k = num_or_indices
+        base, rem = divmod(n, k)
+        sizes = [base + (1 if i < rem else 0) for i in range(k)]
+        bounds = np.cumsum([0] + sizes)
+    else:
+        idx = list(num_or_indices)
+        bounds = [0] + idx + [n]
+    outs = []
+    for a, b in zip(bounds[:-1], bounds[1:]):
+        a, b = int(a), int(b)
+        outs.append(apply_op("tensor_split",
+                             lambda arr, a=a, b=b:
+                             jnp.take(arr, jnp.arange(a, b), axis=axis), x))
+    return outs
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num or x.shape[axis]
+    return [apply_op("unstack",
+                     lambda a, i=i: jnp.take(a, i, axis=axis), x)
+            for i in range(n)]
+
+
+def take(x, index, mode="raise", name=None):
+    if mode not in ("raise", "wrap", "clip"):
+        raise ValueError(f"take mode must be raise/wrap/clip, got {mode}")
+    jmode = {"raise": "clip", "wrap": "wrap", "clip": "clip"}[mode]
+    return apply_op("take",
+                    lambda a, i: jnp.take(a.reshape(-1), i, mode=jmode),
+                    x, index)
+
+
+def unflatten(x, axis, shape, name=None):
+    shape = [int(s) for s in (shape.tolist() if isinstance(shape, Tensor)
+                              else shape)]
+
+    def f(a):
+        ax = axis if axis >= 0 else a.ndim + axis
+        new = list(a.shape[:ax]) + shape + list(a.shape[ax + 1:])
+        # resolve a single -1
+        if -1 in shape:
+            known = int(np.prod([s for s in shape if s != -1]))
+            new[new.index(-1)] = a.shape[ax] // known
+        return a.reshape(new)
+    return apply_op("unflatten", f, x)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view via gather on the flat buffer (reference as_strided is a
+    metadata-only view; XLA has no aliased strides, so this materializes)."""
+    shape = [int(s) for s in shape]
+    stride = [int(s) for s in stride]
+
+    def f(a):
+        idx = np.asarray(offset)
+        for s, st in zip(shape, stride):
+            idx = idx[..., None] + np.arange(s) * st
+        return a.reshape(-1)[jnp.asarray(idx.reshape(shape))]
+    return apply_op("as_strided", f, x)
+
+
+def view_as(x, other, name=None):
+    return _manip.reshape(x, list(other.shape))
+
+
+def matrix_transpose(x, name=None):
+    return apply_op("matrix_transpose", lambda a: jnp.swapaxes(a, -2, -1), x)
+
+
+def rank(x, name=None):
+    return Tensor(jnp.asarray(len(x.shape), jnp.int32))
+
+
+def is_complex(x):
+    return bool(jnp.issubdtype(unwrap(x).dtype, jnp.complexfloating))
+
+
+def is_integer(x):
+    return bool(jnp.issubdtype(unwrap(x).dtype, jnp.integer))
+
+
+def is_floating_point(x):
+    return bool(jnp.issubdtype(unwrap(x).dtype, jnp.floating))
+
+
+def _slices_for(axes, starts, ends, strides, ndim):
+    sl = [slice(None)] * ndim
+    for ax, st, en, sr in zip(axes, starts, ends, strides):
+        sl[ax] = slice(int(st), int(en), int(sr))
+    return tuple(sl)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def f(a, v):
+        sl = _slices_for(axes, starts, ends, strides, a.ndim)
+        return a.at[sl].set(v.astype(a.dtype))
+    return apply_op("slice_scatter", f, x, value)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def f(a, v):
+        sl = [slice(None)] * a.ndim
+        sl[axis] = int(index)
+        return a.at[tuple(sl)].set(v.astype(a.dtype))
+    return apply_op("select_scatter", f, x, values)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def f(a, v):
+        n, m = a.shape[axis1], a.shape[axis2]
+        if offset >= 0:
+            k = min(n, m - offset)
+            rows, cols = np.arange(k), np.arange(k) + offset
+        else:
+            k = min(n + offset, m)
+            rows, cols = np.arange(k) - offset, np.arange(k)
+        moved = jnp.moveaxis(a, (axis1, axis2), (0, 1))
+        # v's diagonal dim is last; bring it first to line up with [rows, cols]
+        vmoved = jnp.moveaxis(v, -1, 0) if v.ndim == a.ndim - 1 else v
+        out = moved.at[rows, cols].set(vmoved.astype(a.dtype))
+        return jnp.moveaxis(out, (0, 1), (axis1, axis2))
+    return apply_op("diagonal_scatter", f, x, y)
+
+
+def index_fill(x, index, axis, value, name=None):
+    def f(a, i):
+        moved = jnp.moveaxis(a, axis, 0)
+        out = moved.at[i].set(jnp.asarray(value, a.dtype))
+        return jnp.moveaxis(out, 0, axis)
+    return apply_op("index_fill", f, x, index)
+
+
+def masked_scatter(x, mask, value, name=None):
+    def f(a, m, v):
+        m = jnp.broadcast_to(m, a.shape)
+        pos = jnp.cumsum(m.reshape(-1)) - 1
+        src = v.reshape(-1)[jnp.clip(pos, 0, v.size - 1)].reshape(a.shape)
+        return jnp.where(m, src.astype(a.dtype), a)
+    return apply_op("masked_scatter", f, x, mask, value)

@@ -243,9 +243,11 @@ def index_select(x, index, axis=0, name=None):
 
 
 def index_add(x, index, axis, value, name=None):
+    import builtins
     idx = unwrap(index)
     def f(a, v):
-        sl = [slice(None)] * a.ndim
+        # NB: builtins.slice — this module defines a paddle `slice` op
+        sl = [builtins.slice(None)] * a.ndim
         sl[axis] = idx
         return a.at[tuple(sl)].add(v)
     return apply_op("index_add", f, x, value)

@@ -1,0 +1,158 @@
+"""Math ops closing the paddle.tensor surface gap (reference:
+python/paddle/tensor/math.py — sinc, gammainc family, diff, trapezoid, vander,
+renorm, isin, histogram family, reduce_as, block_diag; kernels under
+phi/kernels/*)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply_op, unwrap
+from . import math as _math
+
+
+def sinc(x, name=None):
+    return apply_op("sinc", jnp.sinc, x)
+
+
+def signbit(x, name=None):
+    return apply_op("signbit", jnp.signbit, x)
+
+
+gammaln = _math.lgamma
+
+
+def gammainc(x, y, name=None):
+    return apply_op("gammainc", jax.scipy.special.gammainc, x, y)
+
+
+def gammaincc(x, y, name=None):
+    return apply_op("gammaincc", jax.scipy.special.gammaincc, x, y)
+
+
+def multigammaln(x, p, name=None):
+    return apply_op("multigammaln",
+                    lambda a: jax.scipy.special.multigammaln(a, p), x)
+
+
+def polygamma(x, n, name=None):
+    return apply_op("polygamma",
+                    lambda a: jax.scipy.special.polygamma(n, a), x)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    args = [t for t in (prepend, append) if isinstance(t, Tensor)]
+
+    def f(a, *rest):
+        pre = rest[0] if isinstance(prepend, Tensor) else prepend
+        app = rest[-1] if isinstance(append, Tensor) else append
+        return jnp.diff(a, n=n, axis=axis, prepend=pre, append=app)
+
+    return apply_op("diff", f, x, *args)
+
+
+def sgn(x, name=None):
+    def f(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0, a / jnp.where(mag == 0, 1, mag))
+        return jnp.sign(a)
+    return apply_op("sgn", f, x)
+
+
+def frexp(x, name=None):
+    def f(a):
+        m, e = jnp.frexp(a)
+        return m, e.astype(a.dtype)
+    return apply_op("frexp", f, x)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return apply_op("trapezoid",
+                        lambda a, b: jnp.trapezoid(a, x=b, axis=axis), y, x)
+    return apply_op("trapezoid",
+                    lambda a: jnp.trapezoid(a, dx=1.0 if dx is None else dx,
+                                            axis=axis), y)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def _cum(a, spacing):
+        a0 = jnp.moveaxis(a, axis, -1)
+        avg = (a0[..., 1:] + a0[..., :-1]) / 2.0
+        out = jnp.cumsum(avg * spacing, axis=-1)
+        return jnp.moveaxis(out, -1, axis)
+
+    if x is not None:
+        def f(a, b):
+            b0 = jnp.moveaxis(b, axis, -1) if b.ndim == a.ndim else b
+            d = jnp.diff(b0, axis=-1 if b.ndim == a.ndim else 0)
+            if b.ndim != a.ndim:  # 1-D sample positions broadcast along axis
+                shape = [1] * a.ndim
+                shape[axis if axis >= 0 else a.ndim + axis] = -1
+                d = d.reshape(shape)
+                d = jnp.moveaxis(d, axis, -1)
+            return _cum(a, d)
+        return apply_op("cumulative_trapezoid", f, y, x)
+    return apply_op("cumulative_trapezoid",
+                    lambda a: _cum(a, 1.0 if dx is None else dx), y)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return apply_op("vander",
+                    lambda a: jnp.vander(a, N=n, increasing=increasing), x)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def f(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        out = flat * scale[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+    return apply_op("renorm", f, x)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return apply_op("isin",
+                    lambda a, b: jnp.isin(a, b, assume_unique=assume_unique,
+                                          invert=invert), x, test_x)
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    def f(a):
+        rng = None if (min == 0 and max == 0) else (min, max)
+        return jnp.histogram_bin_edges(a, bins=bins, range=rng)
+    return apply_op("histogram_bin_edges", f, input)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    xs = unwrap(x)
+    w = unwrap(weights) if isinstance(weights, Tensor) else weights
+    hist, edges = jnp.histogramdd(xs, bins=bins, range=ranges,
+                                  density=density, weights=w)
+    return Tensor(hist), [Tensor(e) for e in edges]
+
+
+def reduce_as(x, target, name=None):
+    """Sum-reduce x to target's shape (reference reduce_as op)."""
+    tshape = tuple(target.shape) if isinstance(target, Tensor) else tuple(target)
+
+    def f(a):
+        extra = a.ndim - len(tshape)
+        if extra:
+            a = jnp.sum(a, axis=tuple(range(extra)))
+        keep = tuple(i for i, (s, t) in enumerate(zip(a.shape, tshape))
+                     if s != t)
+        if keep:
+            a = jnp.sum(a, axis=keep, keepdims=True)
+        return a
+    return apply_op("reduce_as", f, x)
+
+
+def block_diag(inputs, name=None):
+    return apply_op("block_diag",
+                    lambda *arrs: jax.scipy.linalg.block_diag(*arrs), *inputs)

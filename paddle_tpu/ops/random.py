@@ -139,3 +139,50 @@ def exponential_(x, lam=1.0, name=None):
 def log_normal(mean=1.0, std=2.0, shape=None, name=None):
     out = jax.random.normal(next_key(), _norm_shape(shape), dtype=jnp.float32) * std + mean
     return Tensor(jnp.exp(out).astype(dtypes.get_default_dtype()))
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    u = jax.random.uniform(next_key(), tuple(x.shape), dtype=jnp.float32,
+                           minval=1e-6, maxval=1 - 1e-6)
+    x._data = (loc + scale * jnp.tan(jnp.pi * (u - 0.5))).astype(x._data.dtype)
+    return x
+
+
+def geometric_(x, probs=0.5, name=None):
+    u = jax.random.uniform(next_key(), tuple(x.shape), dtype=jnp.float32,
+                           minval=1e-6, maxval=1 - 1e-6)
+    p = unwrap(probs) if hasattr(probs, "_data") else probs
+    out = jnp.floor(jnp.log1p(-u) / jnp.log1p(-p)) + 1
+    x._data = out.astype(x._data.dtype)
+    return x
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    g = jax.random.normal(next_key(), tuple(x.shape), dtype=jnp.float32)
+    x._data = jnp.exp(g * std + mean).astype(x._data.dtype)
+    return x
+
+
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
+                   mode="truncated", return_top=False, name=None):
+    """Nucleus (top-p) sampling over a probability matrix [batch, vocab]
+    (reference: phi/kernels/top_p_sampling_kernel.h). Sorts descending, keeps
+    the smallest prefix with cumulative prob >= ps, renormalizes, samples.
+    Returns (scores, ids) like the reference."""
+    probs = unwrap(x)
+    p = unwrap(ps) if hasattr(ps, "_data") else jnp.asarray(ps, jnp.float32)
+    p = p.reshape(-1, 1) if p.ndim <= 1 else p
+    key = jax.random.PRNGKey(seed) if seed not in (-1, None) else next_key()
+
+    sort_idx = jnp.argsort(-probs, axis=-1)
+    sorted_p = jnp.take_along_axis(probs, sort_idx, axis=-1)
+    cum = jnp.cumsum(sorted_p, axis=-1)
+    # keep tokens whose *preceding* cumulative mass is < p (always >= 1 token)
+    keep = (cum - sorted_p) < p
+    filtered = jnp.where(keep, sorted_p, 0.0)
+    filtered = filtered / jnp.sum(filtered, axis=-1, keepdims=True)
+    choice = jax.random.categorical(key, jnp.log(jnp.maximum(filtered, 1e-30)),
+                                    axis=-1)
+    ids = jnp.take_along_axis(sort_idx, choice[:, None], axis=-1)
+    scores = jnp.take_along_axis(probs, ids, axis=-1)
+    return Tensor(scores), Tensor(ids.astype(jnp.int64))

@@ -1,0 +1,125 @@
+"""paddle.signal analog — STFT/ISTFT (reference: python/paddle/signal.py over
+phi frame/overlap_add kernels). Framing is a gather (static indices, so XLA
+lowers it to cheap dynamic-slices); overlap-add is a segment-sum scatter."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .core.dispatch import apply_op, unwrap
+from .core.tensor import Tensor
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice x into overlapping frames along `axis` (reference signal.frame)."""
+    def f(a):
+        n = a.shape[axis]
+        n_frames = 1 + (n - frame_length) // hop_length
+        starts = np.arange(n_frames) * hop_length
+        idx = starts[:, None] + np.arange(frame_length)[None, :]
+        moved = jnp.moveaxis(a, axis, -1)
+        framed = moved[..., jnp.asarray(idx)]        # [..., n_frames, frame_length]
+        if axis in (-1, a.ndim - 1):
+            return jnp.moveaxis(framed, (-2, -1), (-1, -2))  # [.., frame_length, n_frames]
+        return jnp.moveaxis(framed, (-2, -1), (axis, axis + 1))
+    return apply_op("frame", f, x)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Reconstruct from frames by overlap-adding (reference signal.overlap_add).
+    x: [..., frame_length, n_frames] when axis=-1."""
+    def f(a):
+        if axis in (-1, a.ndim - 1):
+            fl, nf = a.shape[-2], a.shape[-1]
+            frames = jnp.moveaxis(a, -1, -2)          # [..., n_frames, frame_length]
+        else:
+            fl, nf = a.shape[axis + 1], a.shape[axis]
+            frames = jnp.moveaxis(a, (axis, axis + 1), (-2, -1))
+        n = (nf - 1) * hop_length + fl
+        starts = np.arange(nf) * hop_length
+        idx = (starts[:, None] + np.arange(fl)[None, :]).reshape(-1)
+        flat = frames.reshape(frames.shape[:-2] + (nf * fl,))
+        out = jnp.zeros(frames.shape[:-2] + (n,), a.dtype)
+        return out.at[..., jnp.asarray(idx)].add(flat)
+    return apply_op("overlap_add", f, x)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """Short-time Fourier transform (reference python/paddle/signal.py:stft).
+    x: [batch, n] or [n] real (or complex with onesided=False).
+    Returns [batch, n_fft//2+1 | n_fft, n_frames] complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = unwrap(window) if isinstance(window, Tensor) else window
+
+    def f(a, *rest):
+        win = rest[0] if rest else jnp.ones((win_length,), jnp.float32)
+        # pad window to n_fft centered
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        if center:
+            pad = [(0, 0)] * (a.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            a = jnp.pad(a, pad, mode=pad_mode)
+        n = a.shape[-1]
+        n_frames = 1 + (n - n_fft) // hop_length
+        starts = np.arange(n_frames) * hop_length
+        idx = starts[:, None] + np.arange(n_fft)[None, :]
+        frames = a[..., jnp.asarray(idx)] * win       # [..., n_frames, n_fft]
+        if onesided and not jnp.iscomplexobj(a):
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.moveaxis(spec, -1, -2)             # [..., freq, n_frames]
+
+    args = (x, window) if isinstance(window, Tensor) else (x,)
+    return apply_op("stft", f, *args)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    """Inverse STFT with windowed overlap-add + window-envelope normalization
+    (reference signal.istft)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def f(a, *rest):
+        win = rest[0] if rest else jnp.ones((win_length,), jnp.float32)
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        spec = jnp.moveaxis(a, -2, -1)                # [..., n_frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * win
+        nf = frames.shape[-2]
+        n = (nf - 1) * hop_length + n_fft
+        starts = np.arange(nf) * hop_length
+        idx = (starts[:, None] + np.arange(n_fft)[None, :]).reshape(-1)
+        flat = frames.reshape(frames.shape[:-2] + (nf * n_fft,))
+        out = jnp.zeros(frames.shape[:-2] + (n,), flat.dtype)
+        out = out.at[..., jnp.asarray(idx)].add(flat)
+        # window envelope for COLA normalization
+        wsq = jnp.tile(win * win, (nf,))
+        env = jnp.zeros((n,), win.dtype).at[jnp.asarray(idx)].add(wsq)
+        out = out / jnp.where(env > 1e-11, env, 1.0)
+        if center:
+            out = out[..., n_fft // 2: n - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    args = (x, window) if isinstance(window, Tensor) else (x,)
+    return apply_op("istft", f, *args)

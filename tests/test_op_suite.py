@@ -1,0 +1,121 @@
+"""Registry-driven OpTest suite (reference: test/legacy_test/op_test.py:418 —
+golden outputs + analytic-vs-finite-difference gradients, driven here by the
+declarative op registry instead of per-op test classes)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.registry import REGISTRY, coverage_report
+
+GOLDEN = sorted(n for n, s in REGISTRY.items() if s.kind == "golden")
+SMOKE = sorted(n for n, s in REGISTRY.items() if s.kind == "smoke")
+ALIAS = sorted(n for n, s in REGISTRY.items() if s.kind == "alias")
+INPLACE = sorted(n for n, s in REGISTRY.items() if s.kind == "inplace")
+GRAD = sorted(n for n, s in REGISTRY.items() if s.grad)
+
+
+def _wrap(x):
+    if isinstance(x, list):
+        return [pt.to_tensor(v) for v in x]
+    return pt.to_tensor(x)
+
+
+def _kwargs(spec):
+    return {k: (pt.to_tensor(v) if isinstance(v, np.ndarray) else v)
+            for k, v in spec.kwargs.items()}
+
+
+def _run(spec):
+    op = spec.resolve()
+    raw = spec.sample() if spec.sample else []
+    ins = [_wrap(x) for x in raw]
+    return raw, op(*ins, **_kwargs(spec))
+
+
+def _flat_outs(out):
+    if isinstance(out, (list, tuple)):
+        res = []
+        for o in out:
+            res.extend(_flat_outs(o))
+        return res
+    return [out] if isinstance(out, Tensor) else []
+
+
+@pytest.mark.parametrize("name", GOLDEN)
+def test_golden(name):
+    spec = REGISTRY[name]
+    raw, out = _run(spec)
+    ref = spec.np_ref(*raw)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    refs = ref if isinstance(ref, (list, tuple)) else [ref]
+    for o, r in zip(outs, refs):
+        o_np = np.asarray(o.numpy()) if isinstance(o, Tensor) else np.asarray(o)
+        r_np = np.asarray(r)
+        if np.iscomplexobj(r_np) or np.iscomplexobj(o_np):
+            np.testing.assert_allclose(o_np.astype(np.complex128),
+                                       r_np.astype(np.complex128),
+                                       atol=spec.atol, rtol=spec.rtol)
+        elif r_np.dtype == np.bool_ or o_np.dtype == np.bool_:
+            np.testing.assert_array_equal(o_np.astype(bool), r_np.astype(bool))
+        elif np.issubdtype(r_np.dtype, np.integer):
+            np.testing.assert_array_equal(o_np.astype(np.int64),
+                                          r_np.astype(np.int64))
+        else:
+            np.testing.assert_allclose(o_np.astype(np.float64),
+                                       r_np.astype(np.float64),
+                                       atol=spec.atol, rtol=spec.rtol)
+
+
+@pytest.mark.parametrize("name", SMOKE)
+def test_smoke(name):
+    spec = REGISTRY[name]
+    _, out = _run(spec)
+    for o in _flat_outs(out):
+        a = np.asarray(o.numpy())
+        if np.issubdtype(a.dtype, np.floating):
+            assert np.isfinite(a).all(), f"{name} produced non-finite output"
+
+
+@pytest.mark.parametrize("name", ALIAS)
+def test_alias(name):
+    import paddle_tpu.ops as O
+    spec = REGISTRY[name]
+    assert callable(getattr(O, name))
+    assert callable(getattr(O, spec.alias_of))
+
+
+@pytest.mark.parametrize("name", INPLACE)
+def test_inplace_installed(name):
+    assert hasattr(Tensor, name), f"Tensor.{name} missing"
+
+
+@pytest.mark.parametrize("name", GRAD)
+def test_grad(name):
+    from op_test import check_grad
+    spec = REGISTRY[name]
+    raw = spec.sample() if spec.sample else []
+    if not raw or any(isinstance(x, list) for x in raw):
+        pytest.skip("grad check needs plain tensor inputs")
+    idx = [i for i, x in enumerate(raw)
+           if np.issubdtype(np.asarray(x).dtype, np.floating)]
+    check_grad(spec.resolve(), raw, grad_idx=idx, kwargs=_kwargs(spec),
+               atol=8e-3, rtol=8e-3)
+
+
+def test_inplace_semantics():
+    x = pt.to_tensor(np.array([1.0, 4.0, 9.0], np.float32))
+    y = x.clone()
+    y.sqrt_()
+    np.testing.assert_allclose(y.numpy(), np.sqrt(x.numpy()), rtol=1e-6)
+    z = pt.to_tensor(np.array([1.0, 2.0], np.float32))
+    z.set_(pt.to_tensor(np.array([5.0], np.float32)))
+    assert z.shape == [1] and float(z.numpy()[0]) == 5.0
+
+
+def test_coverage_floor():
+    """VERDICT #3 done-criterion: >= 380 registered ops with OpTest entries."""
+    rep = coverage_report()
+    assert rep["registered_ops"] >= 380, rep
+    assert rep["golden_tested"] >= 200, rep
+    assert rep["grad_checked"] >= 60, rep
