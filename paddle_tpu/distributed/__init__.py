@@ -12,6 +12,7 @@ from .parallel import DataParallel  # noqa: F401
 from .auto_parallel.api import (ProcessMesh, shard_tensor, reshard, shard_layer,  # noqa: F401
                                 dtensor_from_fn, unshard_dtensor)
 from .auto_parallel.placement import (Placement, Replicate, Shard, Partial)  # noqa: F401
+from .watchdog import CommTaskManager  # noqa: F401
 from .collective import (all_reduce, all_gather, all_gather_object, reduce,  # noqa: F401
                          broadcast, scatter, all_to_all, reduce_scatter,
                          send, recv, barrier, new_group, get_group, ReduceOp,
@@ -21,22 +22,34 @@ from . import checkpoint  # noqa: F401
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
-    """reference: distributed/spawn.py:463 — single-node multiprocess launch."""
+    """reference: distributed/spawn.py:463 — single-node multiprocess launch.
+    Children can call init_parallel_env(): a coordinator address on a free
+    port is provisioned here."""
     import multiprocessing as mp
-    import os
+    import socket
     if nprocs == -1:
         import jax
         nprocs = jax.device_count()
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
     ctx = mp.get_context("spawn")
     procs = []
     for rank in range(nprocs):
-        env = {"PADDLE_TRAINER_ID": str(rank), "PADDLE_TRAINERS_NUM": str(nprocs)}
+        env = {"PADDLE_TRAINER_ID": str(rank),
+               "PADDLE_TRAINERS_NUM": str(nprocs),
+               "PADDLE_LOCAL_RANK": str(rank),
+               "PADDLE_MASTER": f"127.0.0.1:{port}",
+               "MASTER_ADDR": "127.0.0.1", "MASTER_PORT": str(port)}
         p = ctx.Process(target=_spawn_entry, args=(func, args, env), daemon=daemon)
         p.start()
         procs.append(p)
     if join:
         for p in procs:
             p.join()
+        bad = [p.exitcode for p in procs if p.exitcode != 0]
+        if bad:
+            raise RuntimeError(f"spawn: worker(s) failed with exit codes {bad}")
     return procs
 
 

@@ -216,23 +216,47 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """Explicit scatter. Cross-process: src broadcasts the stacked payload and
+    every rank keeps its slice. This is the *explicit-API* path for control
+    data; bulk compute scatters are GSPMD shardings (shard_tensor)."""
     g = group or _get_global_group()
     if g.get_world_size() <= 1 or jax.process_count() == 1:
         if tensor_list:
             tensor._data = unwrap(tensor_list[0])
         return tensor
-    raise NotImplementedError("cross-process scatter: use sharded arrays / shard_map")
+    from jax.experimental import multihost_utils
+    me = get_rank()
+    world = jax.process_count()
+    if me == src:
+        stacked = np.stack([np.asarray(unwrap(t)) for t in tensor_list])
+    else:
+        one = np.asarray(unwrap(tensor))
+        stacked = np.zeros((world,) + one.shape, one.dtype)
+    stacked = multihost_utils.broadcast_one_to_all(stacked,
+                                                   is_source=me == src)
+    tensor._data = jnp.asarray(np.asarray(stacked)[me])
+    return tensor
 
 
 def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """Explicit all-to-all: allgather the stacked per-destination payloads,
+    then each rank keeps column [*, me]. Compute-plane a2a (MoE dispatch) is
+    GSPMD inside shard_map — this is the explicit-API/control path."""
     g = group or _get_global_group()
     if g.get_world_size() <= 1 or jax.process_count() == 1:
         out_tensor_list.extend(Tensor(unwrap(t)) for t in in_tensor_list)
         return out_tensor_list
-    raise NotImplementedError("cross-process alltoall: use shard_map (EP layers do)")
+    from jax.experimental import multihost_utils
+    me = get_rank()
+    stacked = np.stack([np.asarray(unwrap(t)) for t in in_tensor_list])
+    gathered = np.asarray(multihost_utils.process_allgather(stacked))
+    out_tensor_list.extend(Tensor(jnp.asarray(gathered[srcr, me]))
+                           for srcr in range(jax.process_count()))
+    return out_tensor_list
 
 
-def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
     g = group or _get_global_group()
     if g.get_world_size() <= 1 or jax.process_count() == 1:
         acc = unwrap(tensor_list[0])
@@ -240,19 +264,67 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=Tru
             acc = acc + unwrap(t)
         tensor._data = acc
         return tensor
-    raise NotImplementedError("cross-process reduce_scatter: use shard_map")
+    from jax.experimental import multihost_utils
+    me = get_rank()
+    stacked = np.stack([np.asarray(unwrap(t)) for t in tensor_list])
+    gathered = np.asarray(multihost_utils.process_allgather(stacked))
+    red = _np_reduce(gathered, op, axis=0)            # [world, ...] per-dst
+    tensor._data = jnp.asarray(red[me])
+    return tensor
+
+
+def _np_reduce(arr, op, axis):
+    if op == ReduceOp.SUM:
+        return arr.sum(axis=axis)
+    if op == ReduceOp.MAX:
+        return arr.max(axis=axis)
+    if op == ReduceOp.MIN:
+        return arr.min(axis=axis)
+    if op == ReduceOp.PROD:
+        return arr.prod(axis=axis)
+    raise ValueError(f"unsupported reduce op {op}")
+
+
+_p2p_seq = {}
+
+
+def _store_or_raise():
+    from .env import get_store
+    store = get_store()
+    if store is None:
+        raise RuntimeError(
+            "send/recv need init_parallel_env() in a multi-process job "
+            "(the TCPStore control plane is not up)")
+    return store
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "point-to-point send/recv across processes is not a TPU-native primitive; "
-        "pipeline parallelism uses ppermute inside shard_map (see parallel/pipeline)")
+    """P2P send over the TCPStore control plane (reference send over NCCL;
+    on TPU the compute plane uses ppermute inside shard_map — see
+    parallel/pipeline — so explicit send/recv is host-side by design)."""
+    import pickle
+    store = _store_or_raise()
+    me = get_rank()
+    k = ("send", me, dst)
+    seq = _p2p_seq.get(k, 0)
+    _p2p_seq[k] = seq + 1
+    arr = np.asarray(unwrap(tensor))
+    store.set(f"p2p/{me}->{dst}/{seq}", pickle.dumps(arr))
+    return tensor
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "point-to-point send/recv across processes is not a TPU-native primitive; "
-        "pipeline parallelism uses ppermute inside shard_map (see parallel/pipeline)")
+    import pickle
+    store = _store_or_raise()
+    me = get_rank()
+    k = ("recv", src, me)
+    seq = _p2p_seq.get(k, 0)
+    _p2p_seq[k] = seq + 1
+    key = f"p2p/{src}->{me}/{seq}"
+    arr = pickle.loads(store.get(key))
+    store.delete_key(key)
+    tensor._data = jnp.asarray(arr)
+    return tensor
 
 
 def barrier(group=None):
@@ -298,3 +370,18 @@ def mesh_all_to_all(x, axis_name, split_axis, concat_axis):
 
 def mesh_ppermute(x, axis_name, perm):
     return jax.lax.ppermute(x, axis_name, perm)
+
+
+# ---- watchdog instrumentation (reference comm_task_manager.h:37) -------------
+from .watchdog import watched as _watched  # noqa: E402
+
+all_reduce = _watched(all_reduce)
+all_gather = _watched(all_gather)
+broadcast = _watched(broadcast)
+reduce = _watched(reduce)
+scatter = _watched(scatter)
+all_to_all = _watched(all_to_all)
+reduce_scatter = _watched(reduce_scatter)
+send = _watched(send)
+recv = _watched(recv)
+barrier = _watched(barrier)

@@ -54,26 +54,45 @@ def get_world_size(group=None) -> int:
     return _env().world_size
 
 
+_store = None
+
+
 def init_parallel_env():
     """reference: distributed/parallel.py:978 init_parallel_env.
 
-    Multi-host: jax.distributed.initialize using the launcher-provided
-    coordinator address (the TCPStore analog is JAX's coordination service).
+    Multi-host: jax.distributed.initialize (rendezvous through JAX's
+    coordination service) + a TCPStore on the master for the control plane
+    (p2p payloads, barriers, user KV — reference tcp_store.h:121).
     Single-host multi-device needs no process bring-up on TPU.
     """
-    global _initialized
+    global _initialized, _store
     env = _env()
     if _initialized:
         return env
     coord = os.environ.get("PADDLE_MASTER", os.environ.get("MASTER_ADDR"))
     if env.world_size > 1 and coord:
-        port = os.environ.get("MASTER_PORT", "8476")
-        addr = coord if ":" in coord else f"{coord}:{port}"
-        jax.distributed.initialize(coordinator_address=addr,
+        host = coord.split(":")[0]
+        port = int(os.environ.get("MASTER_PORT",
+                                  coord.split(":")[1] if ":" in coord
+                                  else "8476"))
+        # importing paddle_tpu may already have touched the XLA backend;
+        # drop it so the coordination service can come up first
+        import jax.extend.backend
+        jax.extend.backend.clear_backends()
+        jax.distributed.initialize(coordinator_address=f"{host}:{port}",
                                    num_processes=env.world_size,
                                    process_id=env.rank)
+        from .store import TCPStore
+        # store rides master port + 1000 (worker endpoints use +1..+world)
+        _store = TCPStore(host, port + 1000, is_master=(env.rank == 0),
+                          world_size=env.world_size)
     _initialized = True
     return env
+
+
+def get_store():
+    """The job's control-plane TCPStore (None when single-process)."""
+    return _store
 
 
 def is_initialized() -> bool:

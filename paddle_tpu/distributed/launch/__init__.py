@@ -1,0 +1,2 @@
+"""paddle.distributed.launch analog (reference launch/main.py:23)."""
+from .main import launch  # noqa: F401

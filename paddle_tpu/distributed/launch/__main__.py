@@ -1,0 +1,4 @@
+import sys
+from .main import launch
+
+sys.exit(launch())

@@ -1,0 +1,163 @@
+"""TCPStore — rendezvous/control-plane key-value store (reference:
+phi/core/distributed/store/tcp_store.h:121 + tcp_utils; python surface
+paddle.distributed.TCPStore).
+
+The master rank hosts a tiny threaded socket server; every rank (master
+included) connects as a client. Values are opaque bytes; `get` blocks until
+the key exists (the reference's Wait semantics). This is the control plane
+only — bulk tensor traffic rides XLA collectives, not this store."""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+
+
+def _send_msg(sock, obj):
+    data = pickle.dumps(obj)
+    sock.sendall(struct.pack("!I", len(data)) + data)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            raise ConnectionError("store connection closed")
+        hdr += chunk
+    (n,) = struct.unpack("!I", hdr)
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("store connection closed")
+        buf += chunk
+    return pickle.loads(buf)
+
+
+class _StoreServer(threading.Thread):
+    def __init__(self, host, port):
+        super().__init__(daemon=True)
+        self._kv = {}
+        self._cv = threading.Condition()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(128)
+        self.port = self._srv.getsockname()[1]
+
+    def run(self):
+        while True:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                cmd, key, val, timeout = _recv_msg(conn)
+                if cmd == "set":
+                    with self._cv:
+                        self._kv[key] = val
+                        self._cv.notify_all()
+                    _send_msg(conn, ("ok", None))
+                elif cmd == "get":
+                    deadline = time.time() + timeout
+                    with self._cv:
+                        while key not in self._kv:
+                            left = deadline - time.time()
+                            if left <= 0:
+                                break
+                            self._cv.wait(left)
+                        if key in self._kv:
+                            _send_msg(conn, ("ok", self._kv[key]))
+                        else:
+                            _send_msg(conn, ("timeout", None))
+                elif cmd == "add":
+                    with self._cv:
+                        cur = int(self._kv.get(key, 0)) + int(val)
+                        self._kv[key] = cur
+                        self._cv.notify_all()
+                    _send_msg(conn, ("ok", cur))
+                elif cmd == "delete":
+                    with self._cv:
+                        existed = self._kv.pop(key, None) is not None
+                        self._cv.notify_all()
+                    _send_msg(conn, ("ok", existed))
+                elif cmd == "wait":
+                    deadline = time.time() + timeout
+                    ok = True
+                    with self._cv:
+                        for k in key:       # key is a list here
+                            while k not in self._kv:
+                                left = deadline - time.time()
+                                if left <= 0:
+                                    ok = False
+                                    break
+                                self._cv.wait(left)
+                    _send_msg(conn, ("ok" if ok else "timeout", None))
+                else:
+                    _send_msg(conn, ("badcmd", None))
+        except (ConnectionError, EOFError, OSError):
+            pass
+        finally:
+            conn.close()
+
+
+class TCPStore:
+    """Client handle; rank `is_master` also hosts the server in-process."""
+
+    def __init__(self, host="127.0.0.1", port=0, is_master=False,
+                 world_size=1, timeout=300.0):
+        self.timeout = timeout
+        self._server = None
+        if is_master:
+            self._server = _StoreServer(host if host != "127.0.0.1" else
+                                        "0.0.0.0", port)
+            self._server.start()
+            port = self._server.port
+        self.host, self.port = host, port
+        deadline = time.time() + timeout
+        last = None
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port), timeout=5)
+                break
+            except OSError as e:
+                last = e
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"could not reach TCPStore at {host}:{port}") from last
+                time.sleep(0.1)
+        self._lock = threading.Lock()
+
+    def _rpc(self, cmd, key, val=None, timeout=None):
+        with self._lock:
+            _send_msg(self._sock, (cmd, key, val,
+                                   self.timeout if timeout is None else timeout))
+            status, out = _recv_msg(self._sock)
+        if status == "timeout":
+            raise TimeoutError(f"TCPStore {cmd}({key!r}) timed out")
+        if status != "ok":
+            raise RuntimeError(f"TCPStore error: {status}")
+        return out
+
+    def set(self, key, value):
+        self._rpc("set", key, value)
+
+    def get(self, key, timeout=None):
+        return self._rpc("get", key, timeout=timeout)
+
+    def add(self, key, amount=1):
+        return self._rpc("add", key, amount)
+
+    def delete_key(self, key):
+        return self._rpc("delete", key)
+
+    def wait(self, keys, timeout=None):
+        self._rpc("wait", list(keys), timeout=timeout)

@@ -1,0 +1,136 @@
+"""Collective watchdog — hang/failure detection for the comm plane
+(reference: phi/core/distributed/comm_task_manager.h:37, .cc:141-273 — a
+background thread that tracks in-flight collectives and aborts/logs when one
+exceeds its timeout).
+
+On TPU most collectives are compiled into XLA programs, so the watchable
+surface is the explicit host-side collective API + blocking device fetches.
+Every explicit collective in distributed/collective.py registers here when the
+watchdog is enabled (FLAGS enable_comm_watchdog or enable())."""
+from __future__ import annotations
+
+import functools
+import threading
+import time
+import traceback
+
+
+class CommTask:
+    __slots__ = ("name", "rank", "start", "timeout", "done", "stack", "seq")
+
+    def __init__(self, name, rank, timeout, seq):
+        self.name = name
+        self.rank = rank
+        self.start = time.monotonic()
+        self.timeout = timeout
+        self.done = False
+        self.seq = seq
+        self.stack = traceback.format_stack(limit=8)
+
+
+class CommTaskManager:
+    """Singleton watchdog (reference CommTaskManager::GetInstance)."""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self, default_timeout=600.0, poll_interval=1.0):
+        self.default_timeout = default_timeout
+        self.poll_interval = poll_interval
+        self._tasks = {}
+        self._seq = 0
+        self._mu = threading.Lock()
+        self._thread = None
+        self._stop = threading.Event()
+        self.timed_out: list[CommTask] = []
+        self.on_timeout = self._default_handler
+        self.enabled = False
+
+    @classmethod
+    def instance(cls) -> "CommTaskManager":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = CommTaskManager()
+            return cls._instance
+
+    # ---- lifecycle ------------------------------------------------------------
+    def enable(self, timeout=None, on_timeout=None, poll_interval=None):
+        if timeout is not None:
+            self.default_timeout = timeout
+        if on_timeout is not None:
+            self.on_timeout = on_timeout
+        if poll_interval is not None:
+            self.poll_interval = poll_interval
+        self.enabled = True
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._watch, daemon=True,
+                                            name="comm-watchdog")
+            self._thread.start()
+
+    def disable(self):
+        self.enabled = False
+        self._stop.set()
+
+    # ---- task tracking ----------------------------------------------------------
+    def begin(self, name, rank=0, timeout=None) -> int:
+        if not self.enabled:
+            return -1
+        with self._mu:
+            self._seq += 1
+            seq = self._seq
+            self._tasks[seq] = CommTask(name, rank,
+                                        timeout or self.default_timeout, seq)
+        return seq
+
+    def end(self, seq: int):
+        if seq < 0:
+            return
+        with self._mu:
+            t = self._tasks.pop(seq, None)
+            if t is not None:
+                t.done = True
+
+    def in_flight(self):
+        with self._mu:
+            return list(self._tasks.values())
+
+    # ---- watchdog loop ----------------------------------------------------------
+    def _watch(self):
+        while not self._stop.wait(self.poll_interval):
+            now = time.monotonic()
+            expired = []
+            with self._mu:
+                for seq, t in list(self._tasks.items()):
+                    if now - t.start > t.timeout:
+                        expired.append(t)
+                        del self._tasks[seq]
+            for t in expired:
+                self.timed_out.append(t)
+                try:
+                    self.on_timeout(t)
+                except Exception:
+                    traceback.print_exc()
+
+    @staticmethod
+    def _default_handler(task: CommTask):
+        import sys
+        print(f"[comm-watchdog] collective '{task.name}' (rank {task.rank}) "
+              f"exceeded {task.timeout:.0f}s — probable hang. Issued from:\n"
+              + "".join(task.stack), file=sys.stderr, flush=True)
+
+
+def watched(fn):
+    """Decorator: track an explicit collective in the watchdog."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        mgr = CommTaskManager.instance()
+        if not mgr.enabled:
+            return fn(*args, **kwargs)
+        from .env import get_rank
+        seq = mgr.begin(fn.__name__, rank=get_rank())
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            mgr.end(seq)
+    return wrapper

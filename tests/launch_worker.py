@@ -1,0 +1,90 @@
+"""Worker driven by test_launch.py through paddle_tpu.distributed.launch.
+Exercises the multi-process bring-up + every explicit collective + a DP train
+step whose gradients allreduce across processes. Prints LAUNCH_WORKER_OK on
+success; any assert kills the job (the launcher propagates rc)."""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+env = dist.init_parallel_env()
+rank, world = dist.get_rank(), dist.get_world_size()
+assert jax.process_count() == world, (jax.process_count(), world)
+
+# ---- explicit collectives ----------------------------------------------------
+t = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))
+dist.all_reduce(t)
+expect = sum(range(1, world + 1))
+np.testing.assert_allclose(t.numpy(), np.full((4,), expect, np.float32))
+
+tl = []
+dist.all_gather(tl, paddle.to_tensor(np.full((2,), float(rank), np.float32)))
+assert len(tl) == world
+for r in range(world):
+    np.testing.assert_allclose(tl[r].numpy(), np.full((2,), float(r)))
+
+b = paddle.to_tensor(np.full((3,), float(rank * 10 + 7), np.float32))
+dist.broadcast(b, src=0)
+np.testing.assert_allclose(b.numpy(), np.full((3,), 7.0))
+
+# scatter: rank 0 hands rank r the value r+100
+st = paddle.to_tensor(np.zeros((2,), np.float32))
+parts = [paddle.to_tensor(np.full((2,), float(r + 100), np.float32))
+         for r in range(world)] if rank == 0 else None
+dist.scatter(st, parts, src=0)
+np.testing.assert_allclose(st.numpy(), np.full((2,), float(rank + 100)))
+
+# all_to_all: rank r sends value r*10+dst to dst
+outs = []
+ins = [paddle.to_tensor(np.full((2,), float(rank * 10 + d), np.float32))
+       for d in range(world)]
+dist.all_to_all(outs, ins)
+for srcr in range(world):
+    np.testing.assert_allclose(outs[srcr].numpy(),
+                               np.full((2,), float(srcr * 10 + rank)))
+
+# reduce_scatter: each dst gets sum_r (r + dst)
+rs = paddle.to_tensor(np.zeros((2,), np.float32))
+dist.reduce_scatter(rs, [paddle.to_tensor(
+    np.full((2,), float(rank + d), np.float32)) for d in range(world)])
+np.testing.assert_allclose(rs.numpy(),
+                           np.full((2,), float(sum(r + rank for r in range(world)))))
+
+# p2p over the control-plane store
+if world >= 2:
+    if rank == 0:
+        dist.send(paddle.to_tensor(np.arange(3, dtype=np.float32)), dst=1)
+    elif rank == 1:
+        rv = paddle.to_tensor(np.zeros((3,), np.float32))
+        dist.recv(rv, src=0)
+        np.testing.assert_allclose(rv.numpy(), np.arange(3, dtype=np.float32))
+
+dist.barrier()
+
+# ---- DP training step: grads must be identical across processes --------------
+paddle.seed(0)  # same init on every rank
+model = paddle.nn.Linear(8, 4)
+opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+rng = np.random.RandomState(rank)          # different data per rank
+x = paddle.to_tensor(rng.rand(4, 8).astype(np.float32))
+y = paddle.to_tensor(rng.rand(4, 4).astype(np.float32))
+loss = ((model(x) - y) ** 2).mean()
+loss.backward()
+for p in model.parameters():               # DP allreduce-mean of grads
+    dist.all_reduce(p.grad)
+    p.grad.scale_(1.0 / world)
+opt.step()
+# weights must now be bit-identical everywhere: allgather and compare
+wl = []
+dist.all_gather(wl, model.weight)
+for r in range(world):
+    np.testing.assert_allclose(wl[r].numpy(), wl[0].numpy(), atol=0)
+
+print(f"LAUNCH_WORKER_OK rank={rank}/{world}", flush=True)

@@ -1,0 +1,120 @@
+"""Multi-host bring-up tests (VERDICT #4): the launch CLI spawns a real
+2-process CPU-backend job; workers rendezvous via jax.distributed + TCPStore
+and exercise every explicit collective (reference launch/main.py:23,
+parallel.py:978, tcp_store.h:121)."""
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_launch(tmp_path, extra_args, script, timeout=300):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--master", f"127.0.0.1:{_free_port()}",
+           "--log_dir", str(tmp_path / "log"), *extra_args, script]
+    return subprocess.run(cmd, env=env, cwd=ROOT, timeout=timeout,
+                          capture_output=True, text=True), tmp_path / "log"
+
+
+class TestLaunch2Proc:
+    def test_collectives_and_dp_step(self, tmp_path):
+        res, logdir = _run_launch(
+            tmp_path, ["--nproc_per_node", "2", "--backend", "cpu"],
+            os.path.join(ROOT, "tests", "launch_worker.py"))
+        logs = ""
+        for f in sorted(logdir.glob("workerlog.*")):
+            logs += f"--- {f.name} ---\n" + f.read_text()
+        assert res.returncode == 0, f"launch failed:\n{res.stderr}\n{logs}"
+        assert logs.count("LAUNCH_WORKER_OK") == 2, logs
+
+    def test_failure_propagates(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import sys; sys.exit(3)\n")
+        res, _ = _run_launch(tmp_path, ["--nproc_per_node", "2",
+                                        "--backend", "cpu"], str(bad))
+        assert res.returncode != 0
+
+    def test_elastic_restart(self, tmp_path):
+        """First attempt fails (marker file missing), restart succeeds —
+        the fleet/elastic/manager.py:125 restart loop."""
+        script = tmp_path / "flaky.py"
+        marker = tmp_path / "attempted"
+        script.write_text(
+            "import os, sys\n"
+            f"m = {str(repr(str(marker)))}\n"
+            "if not os.path.exists(m):\n"
+            "    open(m, 'w').close()\n"
+            "    sys.exit(1)\n"
+            "print('RECOVERED')\n")
+        res, logdir = _run_launch(
+            tmp_path, ["--nproc_per_node", "1", "--max_restarts", "2"],
+            str(script))
+        assert res.returncode == 0, res.stderr
+        logs = "".join(f.read_text() for f in logdir.glob("workerlog.*"))
+        assert "RECOVERED" in logs
+
+
+class TestTCPStore:
+    def test_kv_roundtrip_and_blocking_wait(self):
+        from paddle_tpu.distributed.store import TCPStore
+        master = TCPStore("127.0.0.1", 0, is_master=True)
+        client = TCPStore("127.0.0.1", master.port)
+        master.set("k1", b"v1")
+        assert client.get("k1") == b"v1"
+        assert client.add("ctr", 5) == 5
+        assert master.add("ctr", 2) == 7
+
+        def late_set():
+            import time
+            time.sleep(0.3)
+            master.set("late", b"now")
+        threading.Thread(target=late_set).start()
+        assert client.get("late", timeout=5) == b"now"   # blocks until set
+        with pytest.raises(TimeoutError):
+            client.get("never", timeout=0.2)
+        assert client.delete_key("k1") is True
+
+
+class TestCommWatchdog:
+    def test_timeout_detection(self):
+        from paddle_tpu.distributed.watchdog import CommTaskManager
+        mgr = CommTaskManager()          # private instance, not the singleton
+        hits = []
+        mgr.enable(timeout=0.3, on_timeout=hits.append, poll_interval=0.05)
+        seq = mgr.begin("all_reduce_hang", rank=0)
+        ok_seq = mgr.begin("all_reduce_fast", rank=0)
+        mgr.end(ok_seq)                  # completes in time
+        import time
+        time.sleep(1.0)
+        mgr.disable()
+        assert len(hits) == 1 and hits[0].name == "all_reduce_hang"
+        assert mgr.timed_out and mgr.timed_out[0].name == "all_reduce_hang"
+        assert not mgr.in_flight()
+
+    def test_collectives_register_when_enabled(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.watchdog import CommTaskManager
+        import numpy as np
+        mgr = CommTaskManager.instance()
+        mgr.enable(timeout=60)
+        try:
+            t = paddle.to_tensor(np.ones((2,), np.float32))
+            dist.all_reduce(t)           # single-process fast path, still tracked
+            assert not mgr.in_flight()   # completed and deregistered
+        finally:
+            mgr.disable()
