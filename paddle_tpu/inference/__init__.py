@@ -1,0 +1,142 @@
+"""paddle.inference analog — the deployment predictor surface (reference:
+fluid/inference/api/analysis_predictor.h:101 AnalysisPredictor +
+paddle_inference_api.h Config/Predictor/Tensor).
+
+TPU-native: the "optimized program" is the jax.export StableHLO artifact
+written by paddle.jit.save; Config points at it, create_predictor loads it and
+jits execution. Input/output handles copy through numpy (zero-copy within the
+process via jax arrays)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorPool"]
+
+
+class Config:
+    """reference: analysis_config.cc — model path + runtime knobs."""
+
+    def __init__(self, model_dir=None, prog_file=None, params_file=None):
+        if model_dir and prog_file is None:
+            # accept either a dir containing "inference.pdmodel" or a prefix
+            if os.path.isdir(model_dir):
+                prefix = os.path.join(model_dir, "inference")
+            else:
+                prefix = model_dir
+        else:
+            prefix = (prog_file or "").replace(".pdmodel", "")
+        self._prefix = prefix
+        self._batch = 1
+        self._device = None
+        self._memory_pool_mb = 0
+        self._enable_profile = False
+
+    def model_path(self):
+        return self._prefix
+
+    def enable_xpu(self, *a, **k):
+        pass
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._memory_pool_mb = memory_pool_init_size_mb
+        self._device = device_id
+
+    def disable_gpu(self):
+        self._device = None
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+
+class _IOHandle:
+    """Input/output tensor handle (reference ZeroCopyTensor)."""
+
+    def __init__(self, predictor, idx, is_input):
+        self._p = predictor
+        self._idx = idx
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr):
+        assert self._is_input
+        self._p._inputs[self._idx] = np.asarray(arr)
+
+    def reshape(self, shape):
+        pass
+
+    def copy_to_cpu(self):
+        assert not self._is_input
+        return np.asarray(self._p._outputs[self._idx])
+
+    def shape(self):
+        src = self._p._inputs if self._is_input else self._p._outputs
+        a = src[self._idx]
+        return list(a.shape) if a is not None else None
+
+
+class Predictor:
+    """reference AnalysisPredictor: named IO handles + run()."""
+
+    def __init__(self, config: Config):
+        from ..jit import load
+        self._config = config
+        self._layer = load(config.model_path())
+        spec = getattr(self._layer, "_input_spec", None)
+        n_in = len(spec) if spec else len(self._layer._exported.in_avals) - 1
+        self._input_names = [f"x{i}" for i in range(max(n_in, 1))]
+        self._inputs = [None] * len(self._input_names)
+        self._outputs = []
+
+    # ---- handle surface -----------------------------------------------------
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name):
+        return _IOHandle(self, self._input_names.index(name), True)
+
+    def get_output_names(self):
+        return [f"out{i}" for i in range(len(self._outputs) or 1)]
+
+    def get_output_handle(self, name):
+        idx = int(name.replace("out", "") or 0)
+        return _IOHandle(self, idx, False)
+
+    # ---- execution ----------------------------------------------------------
+    def run(self, inputs=None):
+        """Batch-friendly run: positional list of numpy arrays (or via the
+        copy_from_cpu handles). Returns list of numpy outputs."""
+        if inputs is not None:
+            self._inputs = [np.asarray(i) for i in inputs]
+        if any(i is None for i in self._inputs):
+            raise RuntimeError("predictor inputs not set")
+        out = self._layer(*self._inputs)
+        outs = out if isinstance(out, tuple) else (out,)
+        self._outputs = [np.asarray(o.numpy()) for o in outs]
+        return self._outputs
+
+    def clone(self):
+        return Predictor(self._config)
+
+    def clear_intermediate_tensor(self):
+        pass
+
+
+class PredictorPool:
+    """reference: paddle_inference_api.h PredictorPool — N cloned predictors."""
+
+    def __init__(self, config: Config, size: int = 1):
+        self._predictors = [Predictor(config) for _ in range(size)]
+
+    def retrieve(self, idx):
+        return self._predictors[idx]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -65,6 +65,62 @@ class LlamaConfig:
         return cls.tiny(**kw)
 
 
+class KVCache:
+    """Per-layer dense KV cache for autoregressive decode (the serving path's
+    block/paged variant is ops/pallas/paged_attention.py; reference:
+    block_multi_head_attention's cache_kv tensors)."""
+
+    def __init__(self, batch, max_len, num_kv_heads, head_dim, dtype="float32"):
+        import jax.numpy as jnp
+        self.k = Tensor(jnp.zeros((batch, max_len, num_kv_heads, head_dim),
+                                  dtype))
+        self.v = Tensor(jnp.zeros((batch, max_len, num_kv_heads, head_dim),
+                                  dtype))
+        self.offset = 0
+        self.max_len = max_len
+
+    def update(self, k_new, v_new):
+        """Write s new steps at the current offset; returns the valid prefix."""
+        import jax
+        s = k_new.shape[1]
+        off = self.offset
+        self.k = Tensor(jax.lax.dynamic_update_slice(
+            self.k._data, k_new._data.astype(self.k._data.dtype),
+            (0, off, 0, 0)))
+        self.v = Tensor(jax.lax.dynamic_update_slice(
+            self.v._data, v_new._data.astype(self.v._data.dtype),
+            (0, off, 0, 0)))
+        self.offset = off + s
+        return self.k[:, :self.offset], self.v[:, :self.offset]
+
+
+def _cached_sdpa(q, k, v, q_offset):
+    """Attention of the last `s` positions (starting at q_offset) against the
+    full cache prefix; causal within the overlap."""
+    from ..core.dispatch import apply_op
+
+    def f(qa, ka, va):
+        b, s, h, d = qa.shape
+        t = ka.shape[1]
+        rep = h // ka.shape[2]
+        if rep > 1:
+            ka2 = jnp.repeat(ka, rep, axis=2)
+            va2 = jnp.repeat(va, rep, axis=2)
+        else:
+            ka2, va2 = ka, va
+        sc = jnp.einsum("bshd,bthd->bhst", qa.astype(jnp.float32),
+                        ka2.astype(jnp.float32)) / np.sqrt(d)
+        rows = q_offset + jnp.arange(s)[:, None]
+        cols = jnp.arange(t)[None, :]
+        sc = jnp.where((cols <= rows)[None, None], sc, -1e30)
+        p = jax.nn.softmax(sc, axis=-1)
+        out = jnp.einsum("bhst,bthd->bshd", p, va2.astype(jnp.float32))
+        return out.astype(qa.dtype)
+
+    import jax
+    return apply_op("cached_sdpa", f, q, k, v)
+
+
 class LlamaAttention(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -83,13 +139,23 @@ class LlamaAttention(Layer):
         self.o_proj = Linear(self.num_heads * self.head_dim, h, weight_attr=init,
                              bias_attr=False)
 
-    def forward(self, x, position_ids=None):
+    def forward(self, x, position_ids=None, kv_cache: KVCache = None):
         b, s, h = x.shape
         q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
         k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
         v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        if kv_cache is not None and position_ids is None:
+            from .. import ops
+            pos = ops.arange(kv_cache.offset, kv_cache.offset + s,
+                             dtype="int64")
+            position_ids = ops.tile(pos.reshape([1, s]), [b, 1])
         q, k, _ = fused_rotary_position_embedding(
             q, k, None, position_ids=position_ids, rotary_emb_base=self.rope_theta)
+        if kv_cache is not None:
+            q_offset = kv_cache.offset
+            kk, vv = kv_cache.update(k, v)
+            out = _cached_sdpa(q, kk, vv, q_offset)
+            return self.o_proj(out.reshape([b, s, self.num_heads * self.head_dim]))
         from ..distributed.fleet.topology import get_hybrid_communicate_group
         if get_hybrid_communicate_group().get_sep_parallel_world_size() > 1:
             # context parallelism: sequence sharded on 'sep', ring attention
@@ -132,8 +198,9 @@ class LlamaDecoderLayer(Layer):
         else:
             self.mlp = LlamaMLP(config)
 
-    def forward(self, x, position_ids=None):
-        x = x + self.self_attn(self.input_layernorm(x), position_ids)
+    def forward(self, x, position_ids=None, kv_cache=None):
+        x = x + self.self_attn(self.input_layernorm(x), position_ids,
+                               kv_cache=kv_cache)
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
 
@@ -148,10 +215,11 @@ class LlamaModel(Layer):
                                  for _ in range(config.num_hidden_layers)])
         self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, kv_caches=None):
         x = self.embed_tokens(input_ids)
-        for layer in self.layers:
-            x = layer(x, position_ids)
+        for i, layer in enumerate(self.layers):
+            x = layer(x, position_ids,
+                      kv_cache=kv_caches[i] if kv_caches else None)
         return self.norm(x)
 
 
@@ -166,6 +234,79 @@ class LlamaForCausalLM(Layer):
             self.lm_head = Linear(config.hidden_size, config.vocab_size,
                                   weight_attr=Normal(std=config.initializer_range),
                                   bias_attr=False)
+
+    def new_kv_caches(self, batch, max_len, dtype="float32"):
+        cfg = self.config
+        return [KVCache(batch, max_len, cfg.num_key_value_heads,
+                        cfg.hidden_size // cfg.num_attention_heads, dtype)
+                for _ in range(cfg.num_hidden_layers)]
+
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
+                 top_p=1.0, top_k=0, temperature=1.0, eos_token_id=None,
+                 use_cache=True, seed=None):
+        """Autoregressive decoding with a per-layer KV cache (reference:
+        PaddleNLP generation + phi top_p_sampling_kernel.h for the sampler).
+        Greedy when do_sample=False; nucleus/top-k sampling otherwise.
+        Returns [B, prompt + new] token ids."""
+        from .. import ops
+        from ..autograd import no_grad
+
+        with no_grad():
+            b, prompt = input_ids.shape
+            caches = self.new_kv_caches(b, prompt + max_new_tokens) \
+                if use_cache else None
+            ids = input_ids
+            finished = None
+            cur = input_ids
+            for step in range(max_new_tokens):
+                if use_cache:
+                    hidden = self.llama(cur, kv_caches=caches)
+                else:
+                    hidden = self.llama(ids)
+                if self.lm_head is not None:
+                    logits = self.lm_head(hidden[:, -1])
+                else:
+                    logits = ops.matmul(hidden[:, -1],
+                                        self.llama.embed_tokens.weight,
+                                        transpose_y=True)
+                nxt = self._sample(logits, do_sample, top_p, top_k,
+                                   temperature, seed)
+                if eos_token_id is not None:
+                    import jax.numpy as jnp
+                    done_now = Tensor((nxt._data == eos_token_id).reshape(-1))
+                    if finished is not None:
+                        nxt = Tensor(jnp.where(finished._data,
+                                               jnp.asarray(eos_token_id,
+                                                           nxt._data.dtype),
+                                               nxt._data.reshape(-1)).reshape(-1, 1))
+                        done_now = Tensor(finished._data | done_now._data)
+                    finished = done_now
+                ids = ops.concat([ids, nxt.astype(ids.dtype)], axis=1)
+                cur = nxt.astype(ids.dtype)
+                if finished is not None and bool(np.asarray(finished._data).all()):
+                    break
+            return ids
+
+    def _sample(self, logits, do_sample, top_p, top_k, temperature, seed):
+        from .. import ops
+        if not do_sample:
+            return ops.argmax(logits, axis=-1, keepdim=True)
+        if temperature and temperature != 1.0:
+            logits = logits / temperature
+        from ..nn import functional as F
+        probs = F.softmax(logits, axis=-1)
+        if top_k:
+            vals, _ = ops.topk(probs, k=top_k)
+            import jax.numpy as jnp
+            thresh = vals[:, -1:]
+            probs = Tensor(jnp.where(probs._data >= thresh._data,
+                                     probs._data, 0.0))
+            probs = probs / probs.sum(axis=-1, keepdim=True)
+        if top_p < 1.0:
+            _, ids = ops.top_p_sampling(probs, top_p,
+                                        seed=-1 if seed is None else seed)
+            return ids
+        return ops.multinomial(probs, num_samples=1)
 
     def forward(self, input_ids, labels=None, position_ids=None):
         hidden = self.llama(input_ids, position_ids)

@@ -7,5 +7,5 @@ from .conv import (conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose,  
 from .pooling import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .attention import (scaled_dot_product_attention, flash_attention,  # noqa: F401
-                        sequence_mask)
+                        sequence_mask, paged_attention)
 from .rope import fused_rotary_position_embedding  # noqa: F401

@@ -126,3 +126,20 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     row = jnp.arange(ml)
     mask = row[None, :] < lengths[..., None]
     return Tensor(mask.astype(convert_dtype(dtype)))
+
+
+def paged_attention(query, key_pages, value_pages, block_tables, context_lens,
+                    scale=None, name=None):
+    """Decode attention against a paged KV cache (reference:
+    phi/kernels/fusion block_multi_head_attention). Tensor-level wrapper over
+    the Pallas kernel (ops/pallas/paged_attention.py)."""
+    from ...ops.pallas.paged_attention import paged_attention as _kern
+    from ...core.dispatch import apply_op, unwrap
+
+    bt = unwrap(block_tables)
+    cl = unwrap(context_lens)
+
+    def f(q, kp, vp):
+        return _kern(q, kp, vp, bt, cl, scale=scale)
+
+    return apply_op("paged_attention", f, query, key_pages, value_pages)

@@ -23,6 +23,11 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
         if sin_a is not None:
             s, c = sin_a, cos_a
         else:
+            if pos is not None:
+                try:                      # decode: table must reach max position
+                    seq_len = max(seq_len, int(pos.max()) + 1)
+                except Exception:         # tracer: caller guarantees coverage
+                    pass
             inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
             t = jnp.arange(seq_len, dtype=jnp.float32)
             freqs = jnp.outer(t, inv)

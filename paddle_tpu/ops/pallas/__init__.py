@@ -1,3 +1,4 @@
 """Pallas TPU kernels — the hand-written hot ops (SURVEY §7: flash attention,
 paged/block attention, MoE dispatch, quantized matmul; everything else is XLA)."""
 from . import flash_attention  # noqa: F401
+from . import paged_attention  # noqa: F401

@@ -1,0 +1,142 @@
+"""Paged (block) KV-cache decode attention — the second Pallas TPU kernel
+(reference capability: phi/kernels/fusion/gpu/block_multi_head_attention /
+block_attn.h: paged KV blocks + per-sequence block tables).
+
+TPU-native design: the KV cache lives in fixed-size pages
+[num_pages, page_size, kv_heads, head_dim]; each sequence owns a row of the
+block table. The kernel runs a (batch, page_slot) grid with the block table
+scalar-prefetched, so each page's DMA address is computed *before* the body
+runs (pltpu.PrefetchScalarGridSpec — the canonical TPU paged-attention
+pattern). Online softmax state (m, l, acc) persists in VMEM scratch across the
+sequential page_slot dimension; GQA q-head groups index their kv head directly
+(no repeat materialization)."""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def _kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s, *,
+            scale, page_size, n_slots, kv_heads, group):
+    b = pl.program_id(0)
+    s = pl.program_id(1)
+
+    @pl.when(s == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    cl = cl_ref[b]
+    n_valid = (cl + page_size - 1) // page_size
+
+    @pl.when(s < n_valid)
+    def _compute():
+        # token validity inside this page
+        tok = s * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        valid = tok < cl                                   # [1, page_size]
+        for h in range(kv_heads):
+            q = q_ref[0, h * group:(h + 1) * group, :].astype(jnp.float32)
+            k = k_ref[0, :, h, :].astype(jnp.float32)      # [page, D]
+            v = v_ref[0, :, h, :].astype(jnp.float32)
+            sc = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            sc = jnp.where(valid, sc, NEG_INF)             # [group, page]
+            row = slice(h * group, (h + 1) * group)
+            m_prev = m_s[row, 0]
+            m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1))
+            p = jnp.exp(sc - m_new[:, None])
+            corr = jnp.exp(m_prev - m_new)
+            l_s[row, 0] = l_s[row, 0] * corr + jnp.sum(p, axis=1)
+            acc_s[row, :] = acc_s[row, :] * corr[:, None] + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_s[row, 0] = m_new
+
+    @pl.when(s == n_slots - 1)
+    def _finish():
+        denom = jnp.maximum(l_s[:, 0:1], 1e-30)
+        o_ref[0] = (acc_s[:] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale",))
+def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
+                    *, scale=None):
+    """Decode-step attention against a paged KV cache.
+
+    q:             [B, H, D]       current-step queries
+    k_pages/v_pages: [P, page_size, KVH, D]
+    block_tables:  [B, S] int32    physical page id per (sequence, slot)
+    context_lens:  [B]   int32     tokens already in cache (incl. current)
+    returns        [B, H, D]
+    """
+    B, H, D = q.shape
+    P, page_size, KVH, _ = k_pages.shape
+    S = block_tables.shape[1]
+    assert H % KVH == 0, f"q heads {H} not a multiple of kv heads {KVH}"
+    group = H // KVH
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, S),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda b, s, bt, cl: (b, 0, 0)),
+            pl.BlockSpec((1, page_size, KVH, D),
+                         lambda b, s, bt, cl: (bt[b, s], 0, 0, 0)),
+            pl.BlockSpec((1, page_size, KVH, D),
+                         lambda b, s, bt, cl: (bt[b, s], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda b, s, bt, cl: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, D), jnp.float32),
+        ],
+    )
+    kern = functools.partial(_kernel, scale=scale, page_size=page_size,
+                             n_slots=S, kv_heads=KVH, group=group)
+    return pl.pallas_call(
+        kern, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        interpret=_interpret(),
+    )(block_tables, context_lens, q, k_pages, v_pages)
+
+
+def paged_attention_ref(q, k_pages, v_pages, block_tables, context_lens,
+                        *, scale=None):
+    """jnp reference (gathers pages densely) — golden for the kernel test."""
+    B, H, D = q.shape
+    P, page_size, KVH, _ = k_pages.shape
+    S = block_tables.shape[1]
+    group = H // KVH
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    out = []
+    for b_i in range(B):
+        pages = block_tables[b_i]                       # [S]
+        k = k_pages[pages].reshape(S * page_size, KVH, D)
+        v = v_pages[pages].reshape(S * page_size, KVH, D)
+        cl = context_lens[b_i]
+        mask = jnp.arange(S * page_size) < cl
+        qh = q[b_i].reshape(KVH, group, D).astype(jnp.float32)
+        kh = jnp.moveaxis(k, 1, 0).astype(jnp.float32)  # [KVH, T, D]
+        vh = jnp.moveaxis(v, 1, 0).astype(jnp.float32)
+        sc = jnp.einsum("hgd,htd->hgt", qh * scale, kh)
+        sc = jnp.where(mask[None, None, :], sc, NEG_INF)
+        p = jax.nn.softmax(sc, axis=-1)
+        out.append(jnp.einsum("hgt,htd->hgd", p, vh).reshape(H, D))
+    return jnp.stack(out).astype(q.dtype)

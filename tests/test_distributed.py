@@ -399,3 +399,43 @@ class TestDistributedCheckpoint:
         dst = {"w": shard_tensor(pt.zeros([16, 8]), mesh, [Shard(1)])}
         load_state_dict(dst, str(tmp_path / "ckpt"))
         np.testing.assert_allclose(np.asarray(dst["w"]._data), w)
+
+    def test_mesh_change_reshard_no_host_gather(self, tmp_path):
+        """VERDICT #7 done-criterion: save on mp=8, load on dp=2 x mp=4 —
+        orbax restores each destination shard directly; zero full-array
+        host materializations on the load path."""
+        import jax
+        import paddle_tpu.distributed.checkpoint as ckpt
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        devs = np.array(jax.devices()[:8])
+        mesh8 = ProcessMesh(np.arange(8), ["mp"])
+        w = rng.rand(32, 16).astype(np.float32)
+        src = {"w": shard_tensor(pt.to_tensor(w), mesh8, [Shard(0)])}
+        ckpt.save_state_dict(src, str(tmp_path / "ck2"))
+        meta = ckpt.load_metadata(str(tmp_path / "ck2"))
+        assert meta["w"]["shape"] == [32, 16]
+        assert "mp" in str(meta["w"]["sharding"])
+
+        mesh24 = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+        dst = {"w": shard_tensor(pt.zeros([32, 16]), mesh24,
+                                 [Replicate(), Shard(1)])}
+        before = ckpt._host_gather_count
+        ckpt.load_state_dict(dst, str(tmp_path / "ck2"))
+        assert ckpt._host_gather_count == before, "load gathered to host"
+        out = dst["w"]._data
+        # destination sharding took effect: each shard holds a 32x4 slice
+        assert out.addressable_shards[0].data.shape == (32, 4)
+        np.testing.assert_allclose(np.asarray(out), w)
+
+    def test_async_save_snapshots_before_queueing(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import (save_state_dict,
+                                                       load_state_dict,
+                                                       wait_async_save)
+        w = pt.to_tensor(rng.rand(8, 4).astype(np.float32))
+        expect = np.asarray(w._data).copy()
+        save_state_dict({"w": w}, str(tmp_path / "ck3"), async_save=True)
+        w._data = w._data * 0.0          # mutate immediately after queueing
+        wait_async_save()
+        dst = {"w": pt.zeros([8, 4])}
+        load_state_dict(dst, str(tmp_path / "ck3"))
+        np.testing.assert_allclose(np.asarray(dst["w"]._data), expect)
