@@ -38,19 +38,63 @@ def _same_device(a, b):
     return b
 
 
-def backward(tensors, grad_tensors=None, retain_graph=False):
-    """paddle.autograd.backward analog."""
+def _vjp_on_tape(node, out_cots):
+    """Run node's vjp through dispatch so the grad computation is recorded
+    (double grad). Returns per-input cotangents aligned with node.inputs."""
+    from ..core.dispatch import apply_op
+
+    n_in = len(node.in_arrays)
+    idxs = [i for i, inp in enumerate(node.inputs)
+            if inp is not None and not inp.stop_gradient]
+    if not idxs:
+        return (None,) * n_in
+    raw_fn = node.raw_fn
+    n_outs = node.n_outs
+
+    def grad_fn(*xs):
+        ins, cots = xs[:n_in], xs[n_in:]
+        _, vjp = jax.vjp(raw_fn, *ins)
+        arg = cots[0] if n_outs == 1 else tuple(cots)
+        all_cots = vjp(arg)
+        sel = tuple(all_cots[i] for i in idxs)
+        return sel if len(sel) > 1 else sel[0]
+
+    args = [node.inputs[i] if node.inputs[i] is not None else node.in_arrays[i]
+            for i in range(n_in)]
+    res = apply_op(f"grad[{node.name}]", grad_fn, *args, *out_cots)
+    res = res if isinstance(res, tuple) else (res,)
+    out = [None] * n_in
+    for k, i in enumerate(idxs):
+        out[i] = res[k]
+    return tuple(out)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False,
+             create_graph=False, _only=None):
+    """paddle.autograd.backward analog.
+
+    create_graph=True runs every node's vjp THROUGH dispatch (apply_op), so
+    cotangents are tape Tensors and the produced grads are differentiable —
+    the eager double-grad semantics of fluid/eager RunBackward+grad ops.
+    _only (internal, paddle.grad only_inputs=True): restrict .grad writes to
+    this id-set so a grad() call never pollutes other leaves' .grad."""
     if grad_tensors is None:
         grad_tensors = [None] * len(tensors)
+
+    def _const(arr):
+        return Tensor(arr, stop_gradient=True) if create_graph else arr
+
     roots, root_cots = [], []
     for t, g in zip(tensors, grad_tensors):
         if t.stop_gradient and t._grad_node is None:
             continue
         roots.append(t)
         if g is None:
-            root_cots.append(_ones_like(t))
+            root_cots.append(_const(_ones_like(t)))
+        elif isinstance(g, Tensor):
+            root_cots.append(g if create_graph else g._data)
         else:
-            root_cots.append(g._data if isinstance(g, Tensor) else jnp.asarray(g))
+            root_cots.append(_const(jnp.asarray(g)))
     if not roots:
         return
 
@@ -94,16 +138,22 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
         if cot is None:
             return None
         if t._hooks:
-            g = Tensor(cot, stop_gradient=True)
+            g = cot if isinstance(cot, Tensor) else Tensor(cot,
+                                                           stop_gradient=True)
             for hook in list(t._hooks):
                 out = hook(g)
                 if out is not None:
                     g = out if isinstance(out, Tensor) else Tensor(jnp.asarray(out))
-            cot = g._data
+            cot = g if create_graph else g._data
             cots[id(t)] = cot
         is_leaf = t._grad_node is None
+        if _only is not None and id(t) not in _only and not t._retain_grad:
+            return cot
         if (is_leaf and not t.stop_gradient) or t._retain_grad:
-            if t.grad is None:
+            if create_graph:
+                gt = cot if isinstance(cot, Tensor) else Tensor(cot)
+                t.grad = gt if t.grad is None else t.grad + gt
+            elif t.grad is None:
                 t.grad = Tensor(cot, stop_gradient=True)
             else:
                 t.grad = Tensor(t.grad._data + _same_device(t.grad._data, cot),
@@ -131,11 +181,17 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
                 cot = finalize(t)
             if cot is None:
                 shape, dt = node.out_avals[i]
-                cot = jnp.zeros(shape, dtype=dt)
+                cot = _const(jnp.zeros(shape, dtype=dt))
             out_cots.append(cot)
-        arg = out_cots[0] if node.n_outs == 1 else tuple(out_cots)
-        in_cots = node.vjp_fn(arg)
-        if not retain_graph:
+        if create_graph and node.raw_fn is not None:
+            in_cots = _vjp_on_tape(node, out_cots)
+        else:
+            arg = out_cots[0] if node.n_outs == 1 else tuple(out_cots)
+            if create_graph:
+                arg = jax.tree_util.tree_map(
+                    lambda c: c._data if isinstance(c, Tensor) else c, arg)
+            in_cots = node.vjp_fn(arg)
+        if not retain_graph and not create_graph:
             node.release()
         for inp, cot in zip(node.inputs, in_cots):
             if inp is None or inp.stop_gradient:
@@ -156,12 +212,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
          only_inputs=True, allow_unused=False, no_grad_vars=None):
     """paddle.grad analog (python/paddle/autograd/__init__.py).
 
-    create_graph (double grad) is supported naturally: running backward under an
-    outer tape... not yet wired; round-1 supports first-order only and raises
-    otherwise.
-    """
-    if create_graph:
-        raise NotImplementedError("create_graph=True (double grad) lands in a later round")
+    create_graph=True returns differentiable grads: the backward sweep's vjp
+    calls run through dispatch, so grad-of-grad (and higher) just works —
+    see _vjp_on_tape (reference: fluid/eager double-grad node recording)."""
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
@@ -173,7 +226,10 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
         t.grad = None
         t._retain_grad = True
     try:
-        backward(list(outputs), grad_outputs, retain_graph=bool(retain_graph))
+        backward(list(outputs), grad_outputs,
+                 retain_graph=bool(retain_graph) or create_graph,
+                 create_graph=create_graph,
+                 _only={id(t) for t in inputs} if only_inputs else None)
         results = []
         for t in inputs:
             if t.grad is None:

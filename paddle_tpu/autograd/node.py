@@ -14,9 +14,11 @@ from ..core.tensor import Tensor
 
 
 class GradNode:
-    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "out_refs", "n_outs")
+    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "out_refs", "n_outs",
+                 "raw_fn", "in_arrays")
 
-    def __init__(self, name, vjp_fn, inputs, out_arrays):
+    def __init__(self, name, vjp_fn, inputs, out_arrays, raw_fn=None,
+                 in_arrays=None):
         self.name = name
         self.vjp_fn = vjp_fn
         # keep only Tensor inputs' autograd linkage; raw arrays get None
@@ -24,6 +26,11 @@ class GradNode:
         self.out_avals = tuple((o.shape, np.dtype(o.dtype)) for o in out_arrays)
         self.n_outs = len(out_arrays)
         self.out_refs = ()
+        # for create_graph (double grad): re-run the vjp THROUGH dispatch so
+        # the grad computation itself lands on the tape (fluid/eager double
+        # grad records grad ops the same way)
+        self.raw_fn = raw_fn
+        self.in_arrays = in_arrays
 
     def set_outputs(self, tensors):
         self.out_refs = tuple(weakref.ref(t) for t in tensors)

@@ -94,7 +94,8 @@ def apply_op(name: str, fn: Callable, *inputs, out_treedef_hint=None):
         outs, vjp_fn = jax.vjp(fn, *arrays)
         single = not isinstance(outs, (tuple, list))
         outs_t = (outs,) if single else tuple(outs)
-        node = GradNode(name, vjp_fn, inputs, outs_t)
+        node = GradNode(name, vjp_fn, inputs, outs_t, raw_fn=fn,
+                        in_arrays=arrays)
         wrapped = []
         for i, o in enumerate(outs_t):
             diff = np.dtype(o.dtype).kind in _FLOAT_KINDS
