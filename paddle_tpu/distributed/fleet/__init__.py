@@ -52,15 +52,15 @@ class Fleet:
         hc = self._strategy.hybrid_configs
         dims = [hc.get("dp_degree", 1), hc.get("pp_degree", 1),
                 hc.get("sharding_degree", 1), hc.get("sep_degree", 1),
-                hc.get("mp_degree", 1)]
+                hc.get("mp_degree", 1), hc.get("ep_degree", 1)]
         total = int(np.prod(dims))
         import jax
-        n_dev = jax.device_count() * max(1, get_world_size() // max(jax.process_count(), 1))
         n_dev = max(jax.device_count(), get_world_size())
         if total == 1 and n_dev > 1:
             dims[0] = n_dev  # default: pure DP over all devices
             total = n_dev
-        topo = CommunicateTopology(["dp", "pp", "sharding", "sep", "mp"], dims)
+        topo = CommunicateTopology(
+            ["dp", "pp", "sharding", "sep", "mp", "ep"], dims)
         self._hcg = HybridCommunicateGroup(topo)
         set_hybrid_communicate_group(self._hcg)
         self._is_initialized = True

@@ -2,7 +2,9 @@
 topology.py — CommunicateTopology:70, HybridCommunicateGroup:189).
 
 TPU-native: the topology IS a jax.sharding.Mesh with named axes
-[dp, pp, sharding, sep, mp] (reference axis order topology.py:199). Axis groups
+[dp, pp, sharding, sep, mp, ep] (reference axis order topology.py:199, plus a
+dedicated expert-parallel axis so TP and EP compose — the reference handles
+this via moe sub-meshes, auto_parallel/static/pir_pass.py:368). Axis groups
 become submeshes; collectives ride ICI via GSPMD/shard_map instead of NCCL rings.
 """
 from __future__ import annotations
@@ -14,13 +16,15 @@ import jax
 
 from ..auto_parallel.api import ProcessMesh
 
-_HYBRID_AXES = ["dp", "pp", "sharding", "sep", "mp"]
+_HYBRID_AXES = ["dp", "pp", "sharding", "sep", "mp", "ep"]
 
 
 class CommunicateTopology:
     def __init__(self, hybrid_group_names=None, dims=None):
         self._parallel_names = list(hybrid_group_names or _HYBRID_AXES)
         self._dims = list(dims or [1] * len(self._parallel_names))
+        # older call sites pass 5 dims (pre-ep); pad trailing axes with 1
+        self._dims += [1] * (len(self._parallel_names) - len(self._dims))
         self.coordinate = list(itertools.product(*(range(d) for d in self._dims)))
         self._world = int(np.prod(self._dims))
 
@@ -116,6 +120,15 @@ class HybridCommunicateGroup:
     def get_sep_parallel_world_size(self):
         return self._topo.get_dim("sep")
 
+    def get_expert_parallel_rank(self):
+        return self._axis("ep")
+
+    def get_expert_parallel_world_size(self):
+        try:
+            return self._topo.get_dim("ep")
+        except ValueError:
+            return 1
+
     # group objects (rank lists; collectives ride the mesh)
     def _group(self, name):
         from ..collective import new_group
@@ -139,6 +152,9 @@ class HybridCommunicateGroup:
 
     def get_sep_parallel_group(self):
         return self._group("sep")
+
+    def get_expert_parallel_group(self):
+        return self._group("ep")
 
     def get_check_parallel_group(self, *a):
         return self._group("mp")

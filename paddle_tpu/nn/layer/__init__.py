@@ -6,6 +6,8 @@ from .norm import *  # noqa: F401,F403
 from .conv import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
+from .rnn import (SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN,  # noqa: F401
+                  LSTM, GRU)
 from .transformer import (MultiHeadAttention, Transformer, TransformerEncoder,  # noqa: F401
                           TransformerEncoderLayer, TransformerDecoder,
                           TransformerDecoderLayer)

@@ -3,10 +3,14 @@ python/paddle/incubate/distributed/models/moe/moe_layer.py:263 MoELayer using
 global_scatter/global_gather all-to-all; gate kernels phi/kernels/*number_count,
 limit_by_capacity, random_routing; spmd rules moe_gate_dispatch/moe_combine).
 
-TPU-native: experts' weights are stacked [E, ...] and sharded on the mesh axis
-'mp' (expert-parallel axis); token dispatch is a dense capacity-bucketed einsum
-(GShard-style) whose all-to-all is emitted by GSPMD from the shardings. No
-host-side routing — everything is jit-compatible dense math on the MXU.
+TPU-native: experts' weights are stacked [E, ...] and sharded on the dedicated
+'ep' mesh axis when the hybrid topology has one (falling back to 'mp' on
+pre-ep meshes), with the expert FFN hidden dim sharded on 'mp' so TP and EP
+compose (reference composes them via moe sub-meshes,
+auto_parallel/static/pir_pass.py:368). Token dispatch is a dense
+capacity-bucketed einsum (GShard-style) whose all-to-all is emitted by GSPMD
+from the shardings. No host-side routing — everything is jit-compatible dense
+math on the MXU.
 """
 from __future__ import annotations
 
@@ -21,6 +25,18 @@ from ..nn.layer.layers import Layer
 from ..nn.initializer import XavierUniform
 from ..nn import functional as F
 from .mp_layers import _mp_mesh, _shard_param, _constrain
+
+
+def _expert_axes():
+    """(ep_axis, tp_axis) for expert sharding on the current mesh: experts go
+    on 'ep' when the mesh has one (size>1), else 'mp' (pre-ep 5-axis
+    topologies); the expert FFN hidden dim additionally shards on 'mp' only
+    when ep and mp are both active (TP x EP composition)."""
+    mesh = _mp_mesh()
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if sizes.get("ep", 1) > 1:
+        return "ep", ("mp" if sizes.get("mp", 1) > 1 else None)
+    return "mp", None
 
 
 def top2_gating(logits, capacity):
@@ -72,8 +88,9 @@ class ExpertMLP(Layer):
                                         default_initializer=XavierUniform())
         self.w2 = self.create_parameter([num_experts, d_hidden, d_model],
                                         default_initializer=XavierUniform())
-        _shard_param(self.w1, P("mp", None, None))
-        _shard_param(self.w2, P("mp", None, None))
+        self.ep_axis, tp = _expert_axes()
+        _shard_param(self.w1, P(self.ep_axis, None, tp))
+        _shard_param(self.w2, P(self.ep_axis, tp, None))
         self.act = activation
 
     def forward(self, x):
@@ -118,9 +135,15 @@ class MoELayer(Layer):
             return exp_in, combine.astype(jnp.float32), aux
 
         exp_in, combine, aux = apply_op("moe_dispatch", f, x, self.gate_w)
-        exp_in = _constrain(exp_in, P("mp", None, None))
+        # prefer the axis fixed at construction (consistent with the expert
+        # weight sharding); if the active mesh no longer has that axis, fall
+        # back to what the current mesh supports so _constrain can't KeyError
+        ep = getattr(self.experts, "ep_axis", None)
+        if ep is None or ep not in _mp_mesh().axis_names:
+            ep = _expert_axes()[0]
+        exp_in = _constrain(exp_in, P(ep, None, None))
         exp_out = self.experts(exp_in)
-        exp_out = _constrain(exp_out, P("mp", None, None))
+        exp_out = _constrain(exp_out, P(ep, None, None))
 
         def g(eo, comb):
             out = jnp.einsum("sec,ecm->sm", comb.astype(eo.dtype), eo)
