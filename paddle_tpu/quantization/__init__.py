@@ -1,0 +1,44 @@
+"""paddle.quantization analog (reference: python/paddle/quantization — 3.9k
+LoC: QuantConfig/QAT/PTQ + observers + quanters + imperative pass).
+
+TPU-native: QAT fake-quant is one custom-vjp op (STE) that captures into a
+single XLA program under to_static; PTQ freezes to int8-weight layers whose
+dequant folds into the MXU matmul epilogue (weight-only int8/int4 — the
+bandwidth-bound decode case the TPU actually cares about)."""
+from .base import (BaseObserver, BaseQuanter, ObserverFactory, QuanterFactory,
+                   quanter, fake_quant)
+from .config import QuantConfig
+from .observers import (AbsmaxObserver, AbsmaxObserverLayer,
+                        PerChannelAbsmaxObserver,
+                        PerChannelAbsmaxObserverLayer, HistObserver,
+                        HistObserverLayer, KLObserver, KLObserverLayer)
+from .quanters import (FakeQuanterWithAbsMaxObserver,
+                       FakeQuanterWithAbsMaxObserverLayer,
+                       FakeQuanterChannelWiseAbsMax,
+                       FakeQuanterChannelWiseAbsMaxLayer)
+from .qat_layers import (QuantedLinear, QuantedConv2D, QuantizedLinearInfer,
+                         QuantizedConv2DInfer)
+from .quantize import Quantization, QAT, PTQ, ObserveWrapper
+from .weight_only import (weight_quantize, weight_dequantize,
+                          weight_only_linear)
+
+# imperative-API aliases (reference: quantization/imperative/ptq_quantizer.py)
+AbsmaxQuantizer = AbsmaxObserver
+PerChannelAbsmaxQuantizer = PerChannelAbsmaxObserver
+HistQuantizer = HistObserver
+KLQuantizer = KLObserver
+
+__all__ = [
+    "BaseObserver", "BaseQuanter", "ObserverFactory", "QuanterFactory",
+    "quanter", "fake_quant", "QuantConfig", "AbsmaxObserver",
+    "AbsmaxObserverLayer", "PerChannelAbsmaxObserver",
+    "PerChannelAbsmaxObserverLayer", "HistObserver", "HistObserverLayer",
+    "KLObserver", "KLObserverLayer", "FakeQuanterWithAbsMaxObserver",
+    "FakeQuanterWithAbsMaxObserverLayer", "FakeQuanterChannelWiseAbsMax",
+    "FakeQuanterChannelWiseAbsMaxLayer", "QuantedLinear", "QuantedConv2D",
+    "QuantizedLinearInfer", "QuantizedConv2DInfer", "Quantization", "QAT",
+    "PTQ", "ObserveWrapper",
+    "weight_quantize", "weight_dequantize", "weight_only_linear",
+    "AbsmaxQuantizer", "PerChannelAbsmaxQuantizer", "HistQuantizer",
+    "KLQuantizer",
+]

@@ -1,0 +1,108 @@
+"""Quantization bases (reference: python/paddle/quantization/base_observer.py,
+base_quanter.py, factory.py).
+
+TPU-native: fake-quant is ONE jit-friendly op with a custom straight-through
+vjp (jax.custom_vjp) dispatched like every other op, so QAT graphs capture
+into a single XLA program under to_static; observers keep their running
+statistics in Layer buffers (capture-lifted like RNG state)."""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+
+__all__ = ["BaseObserver", "BaseQuanter", "ObserverFactory", "QuanterFactory",
+           "quanter", "fake_quant"]
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def fake_quant(x, scale, qmax):
+    """Symmetric fake quantize-dequantize: round(x/s*qmax)/qmax*s, clipped.
+
+    scale broadcasts against x (scalar for per-tensor, shaped for
+    per-channel). The vjp is the clipped straight-through estimator
+    (reference: fake_quantize_dequantize_moving_average_abs_max grad)."""
+    s = jnp.maximum(scale, 1e-9).astype(x.dtype)
+    return jnp.clip(jnp.round(x / s * qmax), -qmax, qmax) / qmax * s
+
+
+def _fq_fwd(x, scale, qmax):
+    return fake_quant(x, scale, qmax), (x, scale)
+
+
+def _fq_bwd(qmax, res, g):
+    x, scale = res
+    s = jnp.maximum(scale, 1e-9).astype(x.dtype)
+    mask = (jnp.abs(x) <= s).astype(g.dtype)
+    # no gradient to the observer-updated scale
+    return g * mask, jnp.zeros(jnp.shape(scale), dtype=g.dtype)
+
+
+fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+class BaseObserver(Layer):
+    """Collects statistics on the tensors flowing through it; identity in
+    the forward graph (reference: base_observer.py BaseObserver)."""
+
+    def bit_length(self):
+        return 8
+
+    def quant_axis(self):
+        return -1
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return None
+
+    def cal_thresholds(self):
+        pass
+
+
+class BaseQuanter(BaseObserver):
+    """Applies fake quantization in forward (reference: base_quanter.py)."""
+
+
+class _Factory:
+    """Holds a quanter/observer class + kwargs; instantiated per wrapped
+    layer (reference: factory.py ObserverFactory/QuanterFactory)."""
+
+    def __init__(self, cls=None, **kwargs):
+        self._cls = cls or self._get_class()
+        self._kwargs = kwargs
+
+    def _get_class(self):
+        raise NotImplementedError
+
+    def _instance(self, layer):
+        return self._cls(layer, **self._kwargs)
+
+
+class ObserverFactory(_Factory):
+    pass
+
+
+class QuanterFactory(_Factory):
+    pass
+
+
+def quanter(name):
+    """Class decorator registering a quanter layer under a factory name
+    (reference: factory.py quanter). Returns the class unchanged and
+    exposes `<name>` as a factory in the class's module."""
+    def deco(cls):
+        import sys
+        mod = sys.modules[cls.__module__]
+
+        class _F(QuanterFactory):
+            def _get_class(self):
+                return cls
+        _F.__name__ = name
+        setattr(mod, name, _F)
+        return cls
+    return deco

@@ -1,0 +1,275 @@
+"""paddle.quantization tests: fake-quant STE numerics, QAT training,
+PTQ calibrate+convert, weight-only int8/int4 serving path."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import quantization as Q
+
+rng = np.random.RandomState(0)
+
+
+class TestFakeQuant:
+    def test_forward_matches_numpy(self):
+        x = rng.randn(16).astype(np.float32)
+        scale, qmax = 2.0, 127.0
+        got = np.asarray(Q.fake_quant(x, scale, qmax))
+        want = np.clip(np.round(x / scale * qmax), -qmax, qmax) / qmax * scale
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_ste_gradient_clips_out_of_range(self):
+        import jax
+        import jax.numpy as jnp
+        x = jnp.asarray([0.5, 3.0, -0.2, -5.0], jnp.float32)
+        g = jax.grad(lambda a: Q.fake_quant(a, 1.0, 127.0).sum())(x)
+        np.testing.assert_allclose(np.asarray(g), [1, 0, 1, 0], atol=1e-6)
+
+    def test_quanter_layer_updates_ema_scale(self):
+        qt = Q.FakeQuanterWithAbsMaxObserverLayer(moving_rate=0.5)
+        x = paddle.to_tensor(np.array([1.0, -4.0], np.float32))
+        qt(x)
+        s1 = float(qt.scales())
+        assert s1 > 0
+        qt(paddle.to_tensor(np.array([8.0, 0.0], np.float32)))
+        assert float(qt.scales()) > s1
+        qt.eval()
+        s_frozen = float(qt.scales())
+        qt(paddle.to_tensor(np.array([100.0], np.float32)))
+        assert float(qt.scales()) == s_frozen
+
+
+class TestQAT:
+    def _model(self):
+        paddle.seed(3)
+        return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+    def test_quantize_replaces_linears(self):
+        model = self._model()
+        cfg = Q.QuantConfig(activation=Q.FakeQuanterWithAbsMaxObserver(),
+                            weight=Q.FakeQuanterWithAbsMaxObserver())
+        qat = Q.QAT(cfg)
+        qmodel = qat.quantize(model)
+        kinds = [type(l).__name__ for l in qmodel.sublayers()]
+        assert kinds.count("QuantedLinear") == 2
+        # original model untouched (inplace=False)
+        assert all(type(l).__name__ != "QuantedLinear"
+                   for l in model.sublayers())
+
+    def test_qat_trains_and_tracks_float(self):
+        model = self._model()
+        cfg = Q.QuantConfig(activation=Q.FakeQuanterWithAbsMaxObserver(),
+                            weight=Q.FakeQuanterWithAbsMaxObserver())
+        qmodel = Q.QAT(cfg).quantize(model)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=qmodel.parameters())
+        x = rng.rand(32, 8).astype(np.float32)
+        w = rng.rand(8, 4).astype(np.float32)
+        y = x @ w
+        losses = []
+        for _ in range(40):
+            pred = qmodel(paddle.to_tensor(x))
+            loss = ((pred - paddle.to_tensor(y)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+    def test_convert_produces_int8_close_outputs(self):
+        model = self._model()
+        cfg = Q.QuantConfig(activation=None,
+                            weight=Q.FakeQuanterWithAbsMaxObserver())
+        qmodel = Q.QAT(cfg).quantize(model)
+        x = paddle.to_tensor(rng.rand(4, 8).astype(np.float32))
+        qmodel(x)  # populate scales
+        infer = Q.QAT(cfg).convert(qmodel)
+        kinds = [type(l).__name__ for l in infer.sublayers()]
+        assert kinds.count("QuantizedLinearInfer") == 2
+        import jax.numpy as jnp
+        for l in infer.sublayers():
+            if type(l).__name__ == "QuantizedLinearInfer":
+                assert l.qweight._data.dtype == jnp.int8
+        ref = model(x).numpy()
+        got = infer(x).numpy()
+        err = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert err < 0.05, err
+
+
+class TestPTQ:
+    def test_calibrate_then_convert(self):
+        paddle.seed(4)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        cfg = Q.QuantConfig(activation=Q.AbsmaxObserver(),
+                            weight=Q.PerChannelAbsmaxObserver(quant_axis=1))
+        ptq = Q.PTQ(cfg)
+        calib = ptq.quantize(model)
+        for _ in range(4):
+            calib(paddle.to_tensor(rng.rand(16, 8).astype(np.float32)))
+        infer = ptq.convert(calib)
+        kinds = [type(l).__name__ for l in infer.sublayers()]
+        assert kinds.count("QuantizedLinearInfer") == 2
+        x = paddle.to_tensor(rng.rand(4, 8).astype(np.float32))
+        ref = model(x).numpy()
+        got = infer(x).numpy()
+        err = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert err < 0.05, err
+
+    def test_hist_and_kl_observers(self):
+        data = rng.randn(4096).astype(np.float32)
+        data[0] = 50.0  # outlier the percentile threshold should ignore
+        h = Q.HistObserverLayer(percentile=0.999)
+        h(paddle.to_tensor(data))
+        s = float(h.scales())
+        assert 2.0 < s < 10.0, s
+        k = Q.KLObserverLayer()
+        k(paddle.to_tensor(data))
+        sk = float(k.scales())
+        assert 1.0 < sk < 51.0, sk
+
+
+class TestWeightOnly:
+    def test_int8_roundtrip_and_linear(self):
+        w = rng.randn(32, 16).astype(np.float32)
+        qw, s = Q.weight_quantize(paddle.to_tensor(w))
+        import jax.numpy as jnp
+        assert qw._data.dtype == jnp.int8
+        wd = Q.weight_dequantize(qw, s).numpy()
+        assert np.abs(wd - w).max() < np.abs(w).max() / 100
+        x = rng.randn(4, 32).astype(np.float32)
+        y = Q.weight_only_linear(paddle.to_tensor(x), qw,
+                                 weight_scale=s).numpy()
+        rel = np.abs(y - x @ w).max() / (np.abs(x @ w).max() + 1e-9)
+        assert rel < 0.02, rel
+
+    def test_int4_pack_roundtrip(self):
+        w = rng.randn(32, 8).astype(np.float32)
+        qw, s = Q.weight_quantize(paddle.to_tensor(w),
+                                  algo="weight_only_int4")
+        assert qw.shape == [16, 8]  # two nibbles per byte
+        wd = Q.weight_dequantize(qw, s, algo="weight_only_int4").numpy()
+        assert wd.shape == (32, 8)
+        rel = np.abs(wd - w).max() / np.abs(w).max()
+        assert rel < 0.2, rel
+        x = rng.randn(4, 32).astype(np.float32)
+        y = Q.weight_only_linear(paddle.to_tensor(x), qw, weight_scale=s,
+                                 weight_dtype="int4").numpy()
+        # exact vs the dequantized weights (packing correctness) ...
+        np.testing.assert_allclose(y, x @ wd, rtol=1e-4, atol=1e-4)
+        # ... and loosely tracks the float weights (4-bit quant loss)
+        rel = np.abs(y - x @ w).max() / (np.abs(x @ w).max() + 1e-9)
+        assert rel < 0.2, rel
+
+    def test_nn_quant_namespace(self):
+        from paddle_tpu.nn.quant import weight_only_linear as wol
+        assert wol is Q.weight_only_linear
+
+
+class TestReviewRegressions:
+    def test_name_config_selects_layers(self):
+        paddle.seed(6)
+        model = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 2))
+        cfg = Q.QuantConfig()
+        cfg.add_name_config("0", weight=Q.FakeQuanterWithAbsMaxObserver())
+        qmodel = Q.QAT(cfg).quantize(model)
+        kinds = [type(l).__name__ for l in qmodel.sublayers()]
+        assert kinds.count("QuantedLinear") == 1, kinds
+
+    def test_channelwise_qat_capture_then_convert(self):
+        paddle.seed(7)
+        model = nn.Sequential(nn.Linear(8, 4))
+        cfg = Q.QuantConfig(activation=None,
+                            weight=Q.FakeQuanterChannelWiseAbsMax(
+                                quant_axis=1))
+        qmodel = Q.QAT(cfg).quantize(model)
+        opt = paddle.optimizer.SGD(learning_rate=1e-2,
+                                   parameters=qmodel.parameters())
+
+        def step(x, y):
+            loss = ((qmodel(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        sstep = paddle.jit.to_static(step)
+        x = paddle.to_tensor(rng.rand(4, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.rand(4, 4).astype(np.float32))
+        sstep(x, y)
+        sstep(x, y)  # compiled replay — must not leak tracers into scales
+        infer = Q.QAT(cfg).convert(qmodel)
+        kinds = [type(l).__name__ for l in infer.sublayers()]
+        assert kinds.count("QuantizedLinearInfer") == 1
+        ref = qmodel(x).numpy()
+        np.testing.assert_allclose(infer(x).numpy(), ref, rtol=1e-2,
+                                   atol=1e-2)
+
+    def test_wrong_axis_per_channel_scales_raise(self):
+        with pytest.raises(ValueError, match="OUTPUT channel"):
+            Q.QuantizedLinearInfer.from_float(
+                paddle.to_tensor(rng.rand(4, 8).astype(np.float32)), None,
+                paddle.to_tensor(np.ones(4, np.float32)))  # in-axis scales
+
+    def test_conv2d_ptq_converts_to_int8(self):
+        paddle.seed(8)
+        model = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU())
+        cfg = Q.QuantConfig(activation=Q.AbsmaxObserver(),
+                            weight=Q.PerChannelAbsmaxObserver(quant_axis=0))
+        ptq = Q.PTQ(cfg)
+        calib = ptq.quantize(model)
+        x = paddle.to_tensor(rng.rand(2, 3, 8, 8).astype(np.float32))
+        calib(x)
+        infer = ptq.convert(calib)
+        kinds = [type(l).__name__ for l in infer.sublayers()]
+        assert kinds.count("QuantizedConv2DInfer") == 1, kinds
+        import jax.numpy as jnp
+        for l in infer.sublayers():
+            if type(l).__name__ == "QuantizedConv2DInfer":
+                assert l.qweight._data.dtype == jnp.int8
+        ref = model(x).numpy()
+        got = infer(x).numpy()
+        err = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert err < 0.05, err
+
+    def test_hist_observer_memory_is_bounded(self):
+        h = Q.HistObserverLayer(bins=64)
+        for i in range(5):
+            h(paddle.to_tensor((rng.rand(1000) * (i + 1)).astype(np.float32)))
+        assert h._hist.shape == (64,)
+        assert abs(h._hist.sum() - 5000) < 1.0  # re-binning conserves mass
+        s = float(h.scales())
+        assert 3.0 < s <= 5.0, s
+
+
+class TestQATCapture:
+    def test_qat_step_captures_to_static(self):
+        """The whole QAT train step (fake-quant + EMA scale updates) must
+        compile into one program via to_static and keep updating scales."""
+        paddle.seed(5)
+        model = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+        cfg = Q.QuantConfig(activation=Q.FakeQuanterWithAbsMaxObserver(),
+                            weight=Q.FakeQuanterWithAbsMaxObserver())
+        qmodel = Q.QAT(cfg).quantize(model)
+        opt = paddle.optimizer.SGD(learning_rate=1e-2,
+                                   parameters=qmodel.parameters())
+
+        def step(x, y):
+            loss = ((qmodel(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        sstep = paddle.jit.to_static(step)
+        x = paddle.to_tensor(rng.rand(8, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.rand(8, 2).astype(np.float32))
+        l0 = float(sstep(x, y))
+        quanters = [l for l in qmodel.sublayers()
+                    if type(l).__name__ == "FakeQuanterWithAbsMaxObserverLayer"]
+        assert quanters
+        s_before = [float(q.scales()) for q in quanters]
+        for _ in range(3):
+            l1 = float(sstep(x, y))
+        s_after = [float(q.scales()) for q in quanters]
+        assert l1 < l0
+        assert any(a != b for a, b in zip(s_before, s_after))
