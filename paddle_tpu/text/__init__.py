@@ -1,0 +1,87 @@
+"""paddle.text analog (reference: python/paddle/text — viterbi_decode.py +
+datasets/).
+
+TPU-native: Viterbi is a lax.scan over time with a dense [T, B, N] potential
+tensor — max-product forward pass + backtrace, one compiled program, no
+per-step host sync (the reference runs a phi viterbi_decode kernel)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply_op, unwrap
+from ..nn.layer.layers import Layer
+from . import datasets
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """reference: text/viterbi_decode.py:31.
+
+    potentials [B, T, N], transition_params [N, N], lengths [B] ->
+    (scores [B], paths [B, T] int64; positions past each length are 0)."""
+    def f(emit, trans, lens):
+        B, T, N = emit.shape
+        e = jnp.moveaxis(emit.astype(jnp.float32), 1, 0)     # [T, B, N]
+        tr = trans.astype(jnp.float32)
+        if include_bos_eos_tag:
+            # last row/col = BOS, second-to-last = EOS (reference contract)
+            alpha0 = e[0] + tr[-1][None, :]
+        else:
+            alpha0 = e[0]
+        steps = jnp.arange(1, T)
+
+        def body(alpha, inp):
+            et, t = inp
+            # alpha [B, N]; score of moving i->j
+            m = alpha[:, :, None] + tr[None, :, :]           # [B, N, N]
+            best = jnp.max(m, axis=1) + et                   # [B, N]
+            idx = jnp.argmax(m, axis=1)                      # [B, N]
+            # sequences already past their length keep alpha frozen
+            active = (t < lens)[:, None]
+            return jnp.where(active, best, alpha), idx
+
+        alphaT, backptrs = jax.lax.scan(body, alpha0, (e[1:], steps))
+        if include_bos_eos_tag:
+            # transition into EOS for each sequence's final state
+            alphaT = alphaT + tr[:, -2][None, :]
+        scores = jnp.max(alphaT, axis=-1)
+        last = jnp.argmax(alphaT, axis=-1)                   # [B]
+
+        # backtrace from each sequence's last valid position
+        def back(carry, inp):
+            tag, t = carry, inp[0]
+            ptr = inp[1]                                     # [B, N]
+            prev = jnp.take_along_axis(ptr, tag[:, None], 1)[:, 0]
+            active = (t < lens)
+            tag2 = jnp.where(active, prev, tag)
+            return tag2, tag
+
+        rev_steps = jnp.arange(T - 1, 0, -1)
+        rev_ptrs = backptrs[::-1]
+        tag0, tags_rev = jax.lax.scan(back, last, (rev_steps, rev_ptrs))
+        path = jnp.concatenate([tag0[None, :], tags_rev[::-1]], 0)  # [T, B]
+        path = jnp.moveaxis(path, 0, 1)                      # [B, T]
+        mask = jnp.arange(T)[None, :] < lens[:, None]
+        return scores, jnp.where(mask, path, 0).astype(jnp.int64)
+
+    return apply_op("viterbi_decode", f, potentials, transition_params,
+                    Tensor(jnp.asarray(unwrap(lengths)).astype(jnp.int32)))
+
+
+class ViterbiDecoder(Layer):
+    """reference: viterbi_decode.py:110 — holds the transition matrix."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else Tensor(jnp.asarray(np.asarray(transitions), jnp.float32))
+        self._include = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self._include)

@@ -1,0 +1,381 @@
+"""geometric / audio / text / vision.datasets / onnx package tests."""
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+import wave
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+rng = np.random.RandomState(0)
+
+
+class TestGeometric:
+    def test_segment_ops(self):
+        from paddle_tpu import geometric as G
+        data = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]],
+                                         np.float32))
+        ids = paddle.to_tensor(np.array([0, 0, 1]))
+        np.testing.assert_allclose(G.segment_sum(data, ids).numpy(),
+                                   [[4, 6], [5, 6]])
+        np.testing.assert_allclose(G.segment_mean(data, ids).numpy(),
+                                   [[2, 3], [5, 6]])
+        np.testing.assert_allclose(G.segment_max(data, ids).numpy(),
+                                   [[3, 4], [5, 6]])
+        np.testing.assert_allclose(G.segment_min(data, ids).numpy(),
+                                   [[1, 2], [5, 6]])
+
+    def test_send_u_recv_matches_manual(self):
+        from paddle_tpu import geometric as G
+        x = rng.rand(5, 3).astype(np.float32)
+        src = np.array([0, 1, 2, 3])
+        dst = np.array([1, 2, 1, 0])
+        out = G.send_u_recv(paddle.to_tensor(x), paddle.to_tensor(src),
+                            paddle.to_tensor(dst), reduce_op="sum").numpy()
+        want = np.zeros((5, 3), np.float32)
+        for s, d in zip(src, dst):
+            want[d] += x[s]
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+        # max on empty segments must be 0, not -inf
+        outm = G.send_u_recv(paddle.to_tensor(x), paddle.to_tensor(src),
+                             paddle.to_tensor(dst), reduce_op="max").numpy()
+        assert np.isfinite(outm).all()
+        assert (outm[4] == 0).all()
+
+    def test_send_ue_recv_and_uv(self):
+        from paddle_tpu import geometric as G
+        x = rng.rand(4, 2).astype(np.float32)
+        e = rng.rand(3, 2).astype(np.float32)
+        src = np.array([0, 1, 2])
+        dst = np.array([1, 0, 3])
+        out = G.send_ue_recv(paddle.to_tensor(x), paddle.to_tensor(e),
+                             paddle.to_tensor(src), paddle.to_tensor(dst),
+                             message_op="mul", reduce_op="sum").numpy()
+        want = np.zeros((4, 2), np.float32)
+        for i, (s, d) in enumerate(zip(src, dst)):
+            want[d] += x[s] * e[i]
+        np.testing.assert_allclose(out, want, rtol=1e-5)
+        uv = G.send_uv(paddle.to_tensor(x), paddle.to_tensor(x),
+                       paddle.to_tensor(src), paddle.to_tensor(dst),
+                       message_op="add").numpy()
+        np.testing.assert_allclose(uv, x[src] + x[dst], rtol=1e-6)
+
+    def test_segment_ops_differentiable(self):
+        from paddle_tpu import geometric as G
+        x = paddle.to_tensor(rng.rand(4, 2).astype(np.float32),
+                             stop_gradient=False)
+        ids = paddle.to_tensor(np.array([0, 1, 0, 1]))
+        G.segment_sum(x, ids).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones((4, 2)))
+
+
+class TestAuxRegressions:
+    def test_segment_minmax_empty_segments_zero(self):
+        from paddle_tpu import geometric as G
+        data = paddle.to_tensor(np.array([[1., 2.], [3., 4.]], np.float32))
+        ids = paddle.to_tensor(np.array([0, 2]))  # segment 1 empty
+        mx = G.segment_max(data, ids).numpy()
+        mn = G.segment_min(data, ids).numpy()
+        assert np.isfinite(mx).all() and np.isfinite(mn).all()
+        assert (mx[1] == 0).all() and (mn[1] == 0).all()
+
+    def test_sample_neighbors_varies_across_calls(self):
+        from paddle_tpu import geometric as G
+        # star graph: node 0 has 20 neighbors
+        row = paddle.to_tensor(np.arange(1, 21))
+        colptr = paddle.to_tensor(np.array([0, 20] + [20] * 20))
+        nodes = paddle.to_tensor(np.array([0]))
+        draws = {tuple(sorted(G.sample_neighbors(row, colptr, nodes,
+                                                 sample_size=5)[0]
+                             .numpy().tolist())) for _ in range(6)}
+        assert len(draws) > 1  # not the same sample every call
+
+    def test_audio_dataset_split_covers_all_classes(self, tmp_path):
+        import paddle_tpu.audio as A
+        from paddle_tpu.audio.datasets import TESS
+        for c in ("angry", "happy"):
+            os.makedirs(tmp_path / c)
+            for i in range(5):
+                sig = rng.rand(1, 160).astype(np.float32) * 0.1
+                A.save(str(tmp_path / c / f"{i}.wav"),
+                       paddle.to_tensor(sig), 16000)
+        tr = TESS(mode="train", data_dir=str(tmp_path))
+        te = TESS(mode="dev", data_dir=str(tmp_path))
+        assert sorted(set(tr._labels)) == [0, 1]
+        assert sorted(set(te._labels)) == [0, 1]
+        # spectrogram feat_type works (sr-independent feature)
+        sp = TESS(mode="train", data_dir=str(tmp_path),
+                  feat_type="spectrogram", n_fft=64, hop_length=32)
+        x, y = sp[0]
+        assert x.shape[1] == 33
+        assert sp._feature(16000) is sp._feature(16000)  # built once
+
+    def test_wav_8_and_32_bit_roundtrip(self, tmp_path):
+        import paddle_tpu.audio as A
+        sig = (0.25 * np.sin(2 * np.pi * 440 * np.arange(800) / 16000)
+               ).astype(np.float32)[None, :]
+        for bits, atol in ((8, 2e-2), (32, 1e-6)):
+            p = str(tmp_path / f"t{bits}.wav")
+            A.save(p, paddle.to_tensor(sig), 16000, bits_per_sample=bits)
+            assert A.info(p).bits_per_sample == bits
+            assert A.info(p).num_samples == 800
+            back, sr = A.load(p)
+            np.testing.assert_allclose(back.numpy(), sig, atol=atol)
+
+    def test_imdb_shared_vocab_across_modes(self, tmp_path):
+        from paddle_tpu.text.datasets import Imdb
+        import io as _io
+        tarp = str(tmp_path / "aclImdb.tar.gz")
+        reviews = {
+            "aclImdb/train/pos/0.txt": b"great movie wonderful " * 60,
+            "aclImdb/train/neg/0.txt": b"bad movie terrible " * 60,
+            "aclImdb/test/pos/0.txt": b"wonderful film great " * 60,
+            "aclImdb/test/neg/0.txt": b"terrible film bad " * 60,
+        }
+        with tarfile.open(tarp, "w:gz") as tf:
+            for name, data in reviews.items():
+                ti = tarfile.TarInfo(name)
+                ti.size = len(data)
+                tf.addfile(ti, _io.BytesIO(data))
+        tr = Imdb(data_file=tarp, mode="train", cutoff=50)
+        te = Imdb(data_file=tarp, mode="test", cutoff=50)
+        assert tr.word_idx == te.word_idx  # one shared vocabulary
+        assert len(tr) == 2 and len(te) == 2
+
+    def test_imikolov_missing_member_raises(self, tmp_path):
+        from paddle_tpu.text.datasets import Imikolov
+        import io as _io
+        tarp = str(tmp_path / "wrong.tgz")
+        with tarfile.open(tarp, "w:gz") as tf:
+            data = b"hello world\n"
+            ti = tarfile.TarInfo("./other/path.txt")
+            ti.size = len(data)
+            tf.addfile(ti, _io.BytesIO(data))
+        with pytest.raises(ValueError, match="no member"):
+            Imikolov(data_file=tarp, mode="train")
+
+
+class TestAudioFunctional:
+    def test_mel_hz_roundtrip(self):
+        from paddle_tpu.audio import functional as F
+        for htk in (False, True):
+            f = 440.0
+            assert abs(F.mel_to_hz(F.hz_to_mel(f, htk), htk) - f) < 1e-2
+
+    def test_fbank_matrix_rows_cover_band(self):
+        from paddle_tpu.audio import functional as F
+        fb = F.compute_fbank_matrix(16000, 512, n_mels=40).numpy()
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        assert (fb.sum(1) > 0).all()   # every filter has support
+
+    def test_windows_match_scipy(self):
+        import scipy.signal.windows as sw
+        from paddle_tpu.audio import functional as F
+        for name, sfn in [("hann", sw.hann), ("hamming", sw.hamming),
+                          ("blackman", sw.blackman),
+                          ("bartlett", sw.bartlett),
+                          ("nuttall", sw.nuttall), ("triang", sw.triang),
+                          ("bohman", sw.bohman)]:
+            got = F.get_window(name, 32, fftbins=True).numpy()
+            want = sfn(32, sym=False)
+            np.testing.assert_allclose(got, want, atol=1e-6, err_msg=name)
+        got = F.get_window(("kaiser", 12.0), 32, fftbins=True).numpy()
+        np.testing.assert_allclose(got, sw.kaiser(32, 12.0, sym=False),
+                                   atol=1e-6)
+        got = F.get_window(("gaussian", 7.0), 32, fftbins=True).numpy()
+        np.testing.assert_allclose(got, sw.gaussian(32, 7.0, sym=False),
+                                   atol=1e-6)
+
+    def test_power_to_db(self):
+        from paddle_tpu.audio import functional as F
+        s = paddle.to_tensor(np.array([1.0, 0.1, 1e-12], np.float32))
+        db = F.power_to_db(s, top_db=80.0).numpy()
+        assert abs(db[0]) < 1e-5 and abs(db[1] + 10) < 1e-4
+        assert db[2] >= db[0] - 80 - 1e-4
+
+    def test_create_dct_orthonormal(self):
+        from paddle_tpu.audio import functional as F
+        d = F.create_dct(8, 8).numpy()
+        np.testing.assert_allclose(d.T @ d, np.eye(8), atol=1e-5)
+
+
+class TestAudioFeatures:
+    def test_mel_pipeline_shapes_and_finite(self):
+        from paddle_tpu.audio.features import (Spectrogram, MelSpectrogram,
+                                               LogMelSpectrogram, MFCC)
+        wav = paddle.to_tensor(
+            np.sin(2 * np.pi * 440 * np.arange(8000) / 16000)
+            .astype(np.float32)[None, :])
+        spec = Spectrogram(n_fft=512, hop_length=160)(wav)
+        assert spec.shape[1] == 257
+        mel = MelSpectrogram(sr=16000, n_fft=512, hop_length=160,
+                             n_mels=40)(wav)
+        assert mel.shape[1] == 40
+        logmel = LogMelSpectrogram(sr=16000, n_fft=512, hop_length=160,
+                                   n_mels=40)(wav)
+        assert np.isfinite(logmel.numpy()).all()
+        mfcc = MFCC(sr=16000, n_mfcc=13, n_fft=512, hop_length=160,
+                    n_mels=40)(wav)
+        assert mfcc.shape[1] == 13
+
+    def test_spectrogram_peak_at_tone_bin(self):
+        from paddle_tpu.audio.features import Spectrogram
+        sr, f0 = 16000, 1000.0
+        wav = paddle.to_tensor(
+            np.sin(2 * np.pi * f0 * np.arange(sr) / sr)
+            .astype(np.float32)[None, :])
+        spec = Spectrogram(n_fft=512, hop_length=256)(wav).numpy()[0]
+        peak_bin = spec.mean(-1).argmax()
+        assert abs(peak_bin - round(f0 * 512 / sr)) <= 1
+
+
+class TestAudioIO:
+    def test_wav_save_load_roundtrip(self, tmp_path):
+        import paddle_tpu.audio as A
+        path = str(tmp_path / "t.wav")
+        sig = (0.5 * np.sin(2 * np.pi * 440 * np.arange(1600) / 16000)
+               ).astype(np.float32)[None, :]
+        A.save(path, paddle.to_tensor(sig), 16000)
+        back, sr = A.load(path)
+        assert sr == 16000
+        np.testing.assert_allclose(back.numpy(), sig, atol=1e-3)
+        meta = A.info(path)
+        assert meta.sample_rate == 16000 and meta.num_samples == 1600
+
+
+class TestViterbi:
+    def _brute(self, emit, trans, length):
+        T, N = emit.shape
+        best, path = -np.inf, None
+        import itertools
+        for seq in itertools.product(range(N), repeat=length):
+            s = emit[0, seq[0]] + sum(
+                trans[seq[i - 1], seq[i]] + emit[i, seq[i]]
+                for i in range(1, length))
+            if s > best:
+                best, path = s, seq
+        return best, list(path)
+
+    def test_matches_brute_force(self):
+        from paddle_tpu.text import viterbi_decode
+        B, T, N = 3, 5, 4
+        emit = rng.rand(B, T, N).astype(np.float32)
+        trans = rng.rand(N, N).astype(np.float32)
+        lens = np.array([5, 3, 4])
+        scores, paths = viterbi_decode(
+            paddle.to_tensor(emit), paddle.to_tensor(trans),
+            paddle.to_tensor(lens), include_bos_eos_tag=False)
+        scores, paths = scores.numpy(), paths.numpy()
+        for b in range(B):
+            want_s, want_p = self._brute(emit[b], trans, lens[b])
+            np.testing.assert_allclose(scores[b], want_s, rtol=1e-5)
+            assert paths[b, :lens[b]].tolist() == want_p
+            assert (paths[b, lens[b]:] == 0).all()
+
+    def test_decoder_layer(self):
+        from paddle_tpu.text import ViterbiDecoder
+        N = 3
+        dec = ViterbiDecoder(rng.rand(N + 2, N + 2).astype(np.float32),
+                             include_bos_eos_tag=True)
+        emit = paddle.to_tensor(rng.rand(2, 4, N + 2).astype(np.float32))
+        scores, paths = dec(emit, paddle.to_tensor(np.array([4, 2])))
+        assert scores.shape == [2] and paths.shape == [2, 4]
+
+
+class TestVisionDatasets:
+    def _write_idx(self, tmp, images, labels):
+        ip = os.path.join(tmp, "img.idx.gz")
+        lp = os.path.join(tmp, "lbl.idx.gz")
+        with gzip.open(ip, "wb") as f:
+            f.write(struct.pack(">IIII", 2051, *images.shape))
+            f.write(images.tobytes())
+        with gzip.open(lp, "wb") as f:
+            f.write(struct.pack(">II", 2049, len(labels)))
+            f.write(labels.tobytes())
+        return ip, lp
+
+    def test_mnist_idx_parsing(self, tmp_path):
+        from paddle_tpu.vision.datasets import MNIST
+        imgs = rng.randint(0, 255, (10, 28, 28)).astype(np.uint8)
+        lbls = rng.randint(0, 10, 10).astype(np.uint8)
+        ip, lp = self._write_idx(str(tmp_path), imgs, lbls)
+        ds = MNIST(image_path=ip, label_path=lp)
+        assert len(ds) == 10
+        x, y = ds[3]
+        np.testing.assert_allclose(x, imgs[3].astype(np.float32))
+        assert y == int(lbls[3])
+
+    def test_cifar10_tar_parsing(self, tmp_path):
+        from paddle_tpu.vision.datasets import Cifar10
+        data = rng.randint(0, 255, (8, 3072)).astype(np.uint8)
+        labels = rng.randint(0, 10, 8).tolist()
+        tarp = str(tmp_path / "cifar-10.tar.gz")
+        batch = {b"data": data, b"labels": labels}
+        import io as _io
+        with tarfile.open(tarp, "w:gz") as tf:
+            payload = pickle.dumps(batch)
+            ti = tarfile.TarInfo("cifar-10-batches-py/data_batch_1")
+            ti.size = len(payload)
+            tf.addfile(ti, _io.BytesIO(payload))
+        ds = Cifar10(data_file=tarp, mode="train")
+        assert len(ds) == 8
+        x, y = ds[0]
+        assert x.shape == (3, 32, 32)
+        assert y == labels[0]
+
+    def test_missing_file_raises_clearly(self):
+        from paddle_tpu.vision.datasets import MNIST
+        with pytest.raises(RuntimeError, match="cannot download"):
+            MNIST(image_path="/nonexistent", label_path="/nonexistent")
+
+    def test_dataset_folder(self, tmp_path):
+        from paddle_tpu.vision.datasets import DatasetFolder
+        for c in ("cat", "dog"):
+            os.makedirs(tmp_path / c)
+            for i in range(3):
+                np.save(tmp_path / c / f"{i}.npy",
+                        rng.rand(4, 4).astype(np.float32))
+        ds = DatasetFolder(str(tmp_path))
+        assert len(ds) == 6 and ds.classes == ["cat", "dog"]
+        x, y = ds[0]
+        assert x.shape == (4, 4) and y == 0
+
+
+class TestTextDatasets:
+    def test_uci_housing(self, tmp_path):
+        from paddle_tpu.text.datasets import UCIHousing
+        raw = rng.rand(50, 14).astype(np.float32)
+        p = str(tmp_path / "housing.data")
+        np.savetxt(p, raw)
+        tr = UCIHousing(data_file=p, mode="train")
+        te = UCIHousing(data_file=p, mode="test")
+        assert len(tr) == 40 and len(te) == 10
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+
+    def test_imikolov_ngrams(self, tmp_path):
+        from paddle_tpu.text.datasets import Imikolov
+        tarp = str(tmp_path / "simple-examples.tgz")
+        text = "the cat sat on the mat\nthe dog sat on the log\n" * 30
+        import io as _io
+        with tarfile.open(tarp, "w:gz") as tf:
+            data = text.encode()
+            ti = tarfile.TarInfo("./simple-examples/data/ptb.train.txt")
+            ti.size = len(data)
+            tf.addfile(ti, _io.BytesIO(data))
+        ds = Imikolov(data_file=tarp, window_size=3, mode="train",
+                      min_word_freq=10)
+        assert len(ds) > 0
+        assert ds[0].shape == (3,)
+
+
+class TestOnnxGate:
+    def test_export_gated(self):
+        import paddle_tpu.onnx as onnx_mod
+        with pytest.raises((ImportError, NotImplementedError)):
+            onnx_mod.export(None, "/tmp/x.onnx")
