@@ -31,6 +31,7 @@ class _State(threading.local):
         self.grad_enabled = True
         self.trace_ctx = None          # active program-capture context (jit/)
         self.amp_state = None          # active autocast state (amp/)
+        self.static_record = False     # static.program_guard replay recording
 
 
 _state = _State()
@@ -105,12 +106,31 @@ def apply_op(name: str, fn: Callable, *inputs, out_treedef_hint=None):
                 t._out_slot = i
             wrapped.append(t)
         node.set_outputs(wrapped)
+        if _state.static_record:
+            # the tape node already carries raw_fn/in_arrays; reuse it as
+            # the replay entry (non-float outputs have no _grad_node link)
+            for i, t in enumerate(wrapped):
+                t._replay_node = (node, i)
         return wrapped[0] if single else tuple(wrapped)
     else:
         outs = fn(*arrays)
-        if isinstance(outs, (tuple, list)):
-            return tuple(_wrap_out(o, True) for o in outs)
-        return _wrap_out(outs, True)
+        single = not isinstance(outs, (tuple, list))
+        wrapped = [_wrap_out(o, True)
+                   for o in ((outs,) if single else outs)]
+        if _state.static_record:
+            _attach_replay(name, fn, inputs, arrays, wrapped)
+        return wrapped[0] if single else tuple(wrapped)
+
+
+def _attach_replay(name, fn, inputs, arrays, wrapped):
+    """static.program_guard: record replay linkage on EVERY output (incl.
+    non-float/bool, which never get grad nodes) so Executor.run can re-execute
+    the full op graph — the jaxpr-analog of a static Program block."""
+    from ..autograd.node import GradNode
+    rnode = GradNode(name, None, inputs, [t._buf for t in wrapped],
+                     raw_fn=fn, in_arrays=arrays)
+    for i, t in enumerate(wrapped):
+        t._replay_node = (rnode, i)
 
 
 def _run_checked(name, fn, arrays, needs_grad, inputs):

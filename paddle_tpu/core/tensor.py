@@ -31,7 +31,8 @@ def _is_tracer(x) -> bool:
 class Tensor:
     __slots__ = (
         "_buf", "stop_gradient", "_grad_buf", "_grad_node", "_out_slot",
-        "name", "persistable", "_retain_grad", "_hooks", "__weakref__",
+        "name", "persistable", "_retain_grad", "_hooks", "_replay_node",
+        "__weakref__",
     )
 
     def __init__(self, data, stop_gradient: bool = True, name: Optional[str] = None,
@@ -41,6 +42,7 @@ class Tensor:
         self._grad_buf: Optional[Tensor] = None
         self._grad_node = None
         self._out_slot = 0
+        self._replay_node = None   # (node, slot) set under static recording
         self.name = name
         self.persistable = persistable
         self._retain_grad = False

@@ -16,6 +16,8 @@ from ..io import DataLoader, Dataset
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
+        self._inputs = inputs if inputs is None or isinstance(
+            inputs, (list, tuple)) else [inputs]
         self._optimizer = None
         self._loss = None
         self._metrics = []
@@ -135,13 +137,21 @@ class Model:
         return [outs]
 
     def save(self, path, training=True):
+        """training=True: params(+opt) checkpoint; training=False: inference
+        export via jit.save (StableHLO) using the Model's input specs
+        (reference: hapi/model.py Model.save -> _save_inference_model)."""
         from ..framework.io import save as psave
         if training:
             psave(self.network.state_dict(), path + ".pdparams")
             if self._optimizer is not None:
                 psave(self._optimizer.state_dict(), path + ".pdopt")
         else:
-            raise NotImplementedError("inference export: use paddle_tpu.jit.save")
+            if self._inputs is None:
+                raise ValueError(
+                    "Model.save(training=False) needs input specs: "
+                    "Model(net, inputs=[InputSpec(...)])")
+            from .. import jit
+            jit.save(self.network, path, input_spec=list(self._inputs))
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
         from ..framework.io import load as pload

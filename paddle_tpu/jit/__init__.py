@@ -89,8 +89,34 @@ def save(layer, path, input_spec=None, **configs):
 
     if input_spec is None:
         raise ValueError("jit.save requires input_spec (shape/dtype of inputs)")
-    examples = [(_example_from_spec(s) if isinstance(s, InputSpec) else s)._data
-                for s in input_spec]
+    # None/-1 dims export as jax.export symbolic dimensions, so the loaded
+    # program accepts any batch size (reference programs have -1 dims too).
+    # All dims must share ONE symbolic scope, so collect names first and make
+    # a single symbolic_shape call.
+    def _dyn(d):
+        return d is None or (isinstance(d, int) and d < 0)
+
+    # a dynamic LEADING dim is the batch and shares one symbol across all
+    # inputs (they must agree at call time — reference models batch this
+    # way); other dynamic dims get independent symbols
+    def _sym_name(i, j):
+        return "b" if j == 0 else f"d{i}_{j}"
+
+    dyn_names = sorted({_sym_name(i, j) for i, s in enumerate(input_spec)
+                        if isinstance(s, InputSpec) and s.shape is not None
+                        for j, d in enumerate(s.shape) if _dyn(d)})
+    syms = dict(zip(dyn_names, jexport.symbolic_shape(
+        ", ".join(dyn_names)))) if dyn_names else {}
+    examples = []
+    for i, s in enumerate(input_spec):
+        if isinstance(s, InputSpec) and s.shape is not None and any(
+                _dyn(d) for d in s.shape):
+            dims = tuple(syms[_sym_name(i, j)] if _dyn(d) else int(d)
+                         for j, d in enumerate(s.shape))
+            examples.append(jax.ShapeDtypeStruct(dims, np.dtype(s.dtype)))
+        else:
+            examples.append((_example_from_spec(s)
+                             if isinstance(s, InputSpec) else s)._data)
     param_arrays = [np.asarray(unwrap(t)) for t in tensors]
     exported = jexport.export(jax.jit(pure))(param_arrays, *examples)
     blob = exported.serialize()

@@ -169,6 +169,58 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
-    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12, name=None):
+    """W / sigma(W) via power iteration (reference: nn/layer/norm.py
+    SpectralNorm:1272; phi spectral_norm kernel). forward(weight) -> weight
+    normalized by its leading singular value; u/v persist as buffers and the
+    power iterations run under stop_gradient (matching the reference kernel,
+    which treats u/v as constants in the backward)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
+                 name=None, dtype="float32"):
         super().__init__()
-        raise NotImplementedError("SpectralNorm lands with the GAN model family")
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = epsilon
+        self._shape = list(weight_shape)
+        h = int(weight_shape[dim])
+        w = int(np.prod(weight_shape)) // h
+        from ...core.rng import next_key
+        import jax
+        ku, kv = jax.random.split(next_key())
+        dt = jnp.dtype(dtype)
+        u = jax.random.normal(ku, (h,), jnp.float32)
+        v = jax.random.normal(kv, (w,), jnp.float32)
+        self.register_buffer("weight_u",
+                             Tensor((u / jnp.linalg.norm(u)).astype(dt)))
+        self.register_buffer("weight_v",
+                             Tensor((v / jnp.linalg.norm(v)).astype(dt)))
+
+    def forward(self, x):
+        from ...core.dispatch import apply_op, unwrap
+        import jax
+        dim, iters, eps = self._dim, self._power_iters, self._eps
+
+        def f(w0, u0, v0):
+            perm = [dim] + [i for i in range(w0.ndim) if i != dim]
+            # iterate in f32 for stability; return in the weight's dtype
+            m = jnp.transpose(w0, perm).reshape(w0.shape[dim], -1) \
+                .astype(jnp.float32)
+            u, v = u0.astype(jnp.float32), v0.astype(jnp.float32)
+
+            def body(i, uv):
+                u, v = uv
+                v = m.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = m @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+                return (u, v)
+            u, v = jax.lax.fori_loop(0, iters, body, (u, v))
+            u, v = jax.lax.stop_gradient(u), jax.lax.stop_gradient(v)
+            sigma = (u @ (m @ v)).astype(w0.dtype)
+            return w0 / sigma, u.astype(u0.dtype), v.astype(v0.dtype)
+
+        out, u2, v2 = apply_op("spectral_norm", f, x, self.weight_u,
+                               self.weight_v)
+        self.weight_u._data = unwrap(u2)
+        self.weight_v._data = unwrap(v2)
+        return out

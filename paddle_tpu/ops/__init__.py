@@ -108,9 +108,9 @@ def _invert(self):
 
 
 def _cmp_method(jfn):
+    # through dispatch so capture and static replay record comparisons too
     def f(self, other):
-        o = unwrap(other) if isinstance(other, Tensor) else other
-        return Tensor(jfn(unwrap(self), o))
+        return apply_op(jfn.__name__, jfn, self, other)
     return f
 
 

@@ -9,8 +9,10 @@ from ..core.dispatch import apply_op, unwrap
 
 
 def _cmp(name, jfn):
+    # through dispatch (not raw jnp) so capture and static replay record it;
+    # bool outputs get stop_gradient=True automatically
     def op(x, y, name_=None):
-        return Tensor(jfn(unwrap(x), unwrap(y)))
+        return apply_op(name, jfn, x, y)
     op.__name__ = name
     return op
 
@@ -33,15 +35,15 @@ bitwise_right_shift = _cmp("bitwise_right_shift", jnp.right_shift)
 
 
 def logical_not(x, out=None, name=None):
-    return Tensor(jnp.logical_not(unwrap(x)))
+    return apply_op("logical_not", jnp.logical_not, x)
 
 
 def bitwise_not(x, out=None, name=None):
-    return Tensor(jnp.bitwise_not(unwrap(x)))
+    return apply_op("bitwise_not", jnp.bitwise_not, x)
 
 
 def equal_all(x, y, name=None):
-    return Tensor(jnp.array_equal(unwrap(x), unwrap(y)))
+    return apply_op("equal_all", jnp.array_equal, x, y)
 
 
 def is_empty(x, name=None):
@@ -50,12 +52,12 @@ def is_empty(x, name=None):
 
 def all(x, axis=None, keepdim=False, name=None):
     ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
-    return Tensor(jnp.all(unwrap(x), axis=ax, keepdims=keepdim))
+    return apply_op("all", lambda a: jnp.all(a, axis=ax, keepdims=keepdim), x)
 
 
 def any(x, axis=None, keepdim=False, name=None):
     ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
-    return Tensor(jnp.any(unwrap(x), axis=ax, keepdims=keepdim))
+    return apply_op("any", lambda a: jnp.any(a, axis=ax, keepdims=keepdim), x)
 
 
 def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):

@@ -30,7 +30,7 @@ NEG_INF = -1e30
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *, scale,
-                causal, nk):
+                causal, nk, bq, bk):
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -42,7 +42,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *, scale,
 
     run = True
     if causal:
-        run = (j * BK) <= (i * BQ + BQ - 1)
+        run = (j * bk) <= (i * bq + bq - 1)
 
     @pl.when(run if causal else True)
     def _compute():
@@ -51,8 +51,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *, scale,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            rows = i * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 0)
-            cols = j * BK + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 1)
+            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
         m_prev = m_s[:, 0]
         m_cur = jnp.max(s, axis=1)
@@ -74,10 +74,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *, scale,
             + jnp.zeros_like(lse_ref[0])
 
 
-def _check_divisible(Sq, Sk, D):
-    if Sq % BQ != 0 or Sk % BK != 0:
+def _check_divisible(Sq, Sk, D, bq=None, bk=None):
+    bq, bk = bq or BQ, bk or BK
+    if Sq % bq != 0 or Sk % bk != 0:
         raise ValueError(
-            f"flash attention requires seq lengths divisible by {BQ} "
+            f"flash attention requires seq lengths divisible by ({bq}, {bk}) "
             f"(got q {Sq}, kv {Sk}); pad or use the XLA fallback")
     if D % 64 != 0:
         raise ValueError(f"flash attention requires head_dim % 64 == 0, got {D}")
@@ -95,35 +96,36 @@ def _kv_index(nh, nhk):
     return index
 
 
-def _flash_fwd(q3, k3, v3, scale, causal, nh, nhk):
+def _flash_fwd(q3, k3, v3, scale, causal, nh, nhk, bq=BQ, bk=BK):
     """q3 [B*nh, Sq, D], k3/v3 [B*nhk, Sk, D] -> (o [B*nh, Sq, D],
     lse [B*nh, Sq, 128])."""
     BH, Sq, D = q3.shape
     Sk = k3.shape[1]
-    _check_divisible(Sq, Sk, D)
-    nq, nk = Sq // BQ, Sk // BK
+    _check_divisible(Sq, Sk, D, bq, bk)
+    nq, nk = Sq // bq, Sk // bk
     kvix = _kv_index(nh, nhk)
-    kern = functools.partial(_fwd_kernel, scale=scale, causal=causal, nk=nk)
+    kern = functools.partial(_fwd_kernel, scale=scale, causal=causal, nk=nk,
+                             bq=bq, bk=bk)
     o, lse = pl.pallas_call(
         kern,
         grid=(BH, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, BK, D), kvix),
-            pl.BlockSpec((1, BK, D), kvix),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), kvix),
+            pl.BlockSpec((1, bk, D), kvix),
         ],
         out_specs=[
-            pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, BQ, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, Sq, D), q3.dtype),
             jax.ShapeDtypeStruct((BH, Sq, 128), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((BQ, 128), jnp.float32),
-            pltpu.VMEM((BQ, 128), jnp.float32),
-            pltpu.VMEM((BQ, D), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
         ],
         interpret=_interpret(),
     )(q3, k3, v3)
@@ -131,7 +133,7 @@ def _flash_fwd(q3, k3, v3, scale, causal, nh, nhk):
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, dq_s, *,
-               scale, causal, nk):
+               scale, causal, nk, bq, bk):
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -141,7 +143,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, dq_s, *,
 
     run = True
     if causal:
-        run = (j * BK) <= (i * BQ + BQ - 1)
+        run = (j * bk) <= (i * bq + bq - 1)
 
     @pl.when(run if causal else True)
     def _compute():
@@ -153,8 +155,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, dq_s, *,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            rows = i * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 0)
-            cols = j * BK + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 1)
+            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
@@ -172,7 +174,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, dq_s, *,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref,
-                dk_s, dv_s, *, scale, causal, nq, nt):
+                dk_s, dv_s, *, scale, causal, nq, nt, bq, bk):
     j = pl.program_id(1)  # k block
     t = pl.program_id(2)  # combined (group q-head, q block) axis, sequential —
     i = t % nq            # dk/dv accumulate across the GQA group's q heads
@@ -184,7 +186,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref,
 
     run = True
     if causal:
-        run = (j * BK) <= (i * BQ + BQ - 1)
+        run = (j * bk) <= (i * bq + bq - 1)
 
     @pl.when(run if causal else True)
     def _compute():
@@ -196,8 +198,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            rows = i * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 0)
-            cols = j * BK + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 1)
+            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
         dv_s[:] = dv_s[:] + jax.lax.dot_general(
@@ -216,27 +218,29 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref,
         dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, nh, nhk):
+def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, nh, nhk, bq=BQ,
+               bk=BK):
     BH, Sq, D = q3.shape
     Sk = k3.shape[1]
-    _check_divisible(Sq, Sk, D)
-    nq, nk = Sq // BQ, Sk // BK
+    _check_divisible(Sq, Sk, D, bq, bk)
+    nq, nk = Sq // bq, Sk // bk
     rep = nh // nhk
     kvix = _kv_index(nh, nhk)
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal, nk=nk),
+        functools.partial(_dq_kernel, scale=scale, causal=causal, nk=nk,
+                          bq=bq, bk=bk),
         grid=(BH, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, BK, D), kvix),
-            pl.BlockSpec((1, BK, D), kvix),
-            pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, BQ, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), kvix),
+            pl.BlockSpec((1, bk, D), kvix),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q3.dtype),
-        scratch_shapes=[pltpu.VMEM((BQ, D), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=_interpret(),
     )(q3, k3, v3, do3, o3, lse)
 
@@ -250,57 +254,102 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, nh, nhk):
         return (b // nhk) * nh + (b % nhk) * rep + t // nq, t % nq, 0
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal, nq=nq, nt=nt),
+        functools.partial(_dkv_kernel, scale=scale, causal=causal, nq=nq,
+                          nt=nt, bq=bq, bk=bk),
         grid=(BHk, nk, nt),
         in_specs=[
-            pl.BlockSpec((1, BQ, D), qix),
-            pl.BlockSpec((1, BK, D), lambda b, j, t: (b, j, 0)),
-            pl.BlockSpec((1, BK, D), lambda b, j, t: (b, j, 0)),
-            pl.BlockSpec((1, BQ, D), qix),
-            pl.BlockSpec((1, BQ, D), qix),
-            pl.BlockSpec((1, BQ, 128), lambda b, j, t: qix(b, j, t)[:2] + (0,)),
+            pl.BlockSpec((1, bq, D), qix),
+            pl.BlockSpec((1, bk, D), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), qix),
+            pl.BlockSpec((1, bq, D), qix),
+            pl.BlockSpec((1, bq, 128), lambda b, j, t: qix(b, j, t)[:2] + (0,)),
         ],
         out_specs=[
-            pl.BlockSpec((1, BK, D), lambda b, j, t: (b, j, 0)),
-            pl.BlockSpec((1, BK, D), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, t: (b, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BHk, Sk, D), k3.dtype),
             jax.ShapeDtypeStruct((BHk, Sk, D), v3.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((BK, D), jnp.float32),
-            pltpu.VMEM((BK, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
         ],
         interpret=_interpret(),
     )(q3, k3, v3, do3, o3, lse)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash3(q3, k3, v3, scale, causal, nh, nhk):
-    o, _ = _flash_fwd(q3, k3, v3, scale, causal, nh, nhk)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash3(q3, k3, v3, scale, causal, nh, nhk, bq, bk):
+    o, _ = _flash_fwd(q3, k3, v3, scale, causal, nh, nhk, bq, bk)
     return o
 
 
-def _flash3_fwd(q3, k3, v3, scale, causal, nh, nhk):
-    o, lse = _flash_fwd(q3, k3, v3, scale, causal, nh, nhk)
+def _flash3_fwd(q3, k3, v3, scale, causal, nh, nhk, bq, bk):
+    o, lse = _flash_fwd(q3, k3, v3, scale, causal, nh, nhk, bq, bk)
     return o, (q3, k3, v3, o, lse)
 
 
-def _flash3_bwd(scale, causal, nh, nhk, res, do):
+def _flash3_bwd(scale, causal, nh, nhk, bq, bk, res, do):
     q3, k3, v3, o, lse = res
-    dq, dk, dv = _flash_bwd(q3, k3, v3, o, lse, do, scale, causal, nh, nhk)
+    dq, dk, dv = _flash_bwd(q3, k3, v3, o, lse, do, scale, causal, nh, nhk,
+                            bq, bk)
     return dq, dk, dv
 
 
 _flash3.defvjp(_flash3_fwd, _flash3_bwd)
 
 
+_BLOCK_CANDIDATES = [(128, 128), (256, 128), (128, 256), (256, 256),
+                     (512, 128), (128, 512), (512, 256), (256, 512)]
+
+
+def _block_candidates(Sq, Sk, D, dtype):
+    """Valid (bq, bk) choices: divisibility + a VMEM budget estimate
+    (q/o/dq blocks bq*D, k/v bk*D, lse/m/l bq*128; f32 scratch; ~2x for
+    pipelining double-buffering; keep under ~12MB of the 16MB/core VMEM)."""
+    out = []
+    for bq, bk in _BLOCK_CANDIDATES:
+        if Sq % bq or Sk % bk:
+            continue
+        vmem = (3 * bq * D + 2 * bk * D + 3 * bq * 128) * 4 * 2
+        if vmem <= 12 * 1024 * 1024:
+            out.append((bq, bk))
+    return out or [(BQ, BK)]
+
+
+def _pick_blocks(q3, k3, v3, causal):
+    """Autotuned (bq, bk) for this shape (reference: autotune/switch_autotune
+    picking conv/matmul algos). Tunes the forward kernel only — bwd shares
+    the blocking — and only on concrete arrays outside any jit trace."""
+    from .. import autotune as at
+    BH, Sq, D = q3.shape
+    Sk = k3.shape[1]
+    cands = _block_candidates(Sq, Sk, D, q3.dtype)
+    if len(cands) == 1:
+        return cands[0]
+    key = at.cache_key("flash_fwd", BH, Sq, Sk, D, q3.dtype, causal)
+
+    def build(cfg):
+        bq, bk = cfg
+
+        def run(q, k, v):
+            nh = nhk = 1  # timing proxy: head mapping doesn't affect blocking
+            return _flash_fwd(q, k, v, 1.0, causal, nh, nhk, bq, bk)[0]
+        return run
+
+    # time on single-head views so tuning cost stays low
+    return tuple(at.tune(key, cands, build, (q3[:1], k3[:1], v3[:1])))
+
+
 def flash_attention_bshd(q, k, v, causal=True, scale=None):
     """[B, S, H, D] flash attention. GQA indexes kv-head = q-head // group in
     the kernel's BlockSpecs — K/V are never repeated in HBM (at Llama-3-8B's
-    32q/8kv that repeat would be 4x KV memory)."""
+    32q/8kv that repeat would be 4x KV memory). Block sizes come from the
+    autotuner cache when FLAGS_use_autotune is set."""
     B, Sq, H, D = q.shape
     Hk = k.shape[2]
     if H % Hk != 0:
@@ -309,7 +358,8 @@ def flash_attention_bshd(q, k, v, causal=True, scale=None):
     q3 = jnp.moveaxis(q, 2, 1).reshape(B * H, Sq, D)
     k3 = jnp.moveaxis(k, 2, 1).reshape(B * Hk, k.shape[1], D)
     v3 = jnp.moveaxis(v, 2, 1).reshape(B * Hk, v.shape[1], D)
-    o3 = _flash3(q3, k3, v3, s, causal, H, Hk)
+    bq, bk = _pick_blocks(q3, k3, v3, causal)
+    o3 = _flash3(q3, k3, v3, s, causal, H, Hk, bq, bk)
     return jnp.moveaxis(o3.reshape(B, H, Sq, D), 1, 2)
 
 

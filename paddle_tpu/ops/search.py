@@ -15,29 +15,42 @@ from ..core.dispatch import apply_op, unwrap
 
 def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
     from ..core.dtype import convert_dtype
+    from ..core.dispatch import apply_op
+    dt = convert_dtype(dtype)
+
     def f(a):
         if axis is None:
             out = jnp.argmax(a.reshape(-1))
-            return out.reshape((1,) * a.ndim) if keepdim else out
-        out = jnp.argmax(a, axis=axis, keepdims=keepdim)
-        return out
-    return Tensor(f(unwrap(x)).astype(convert_dtype(dtype)))
+            out = out.reshape((1,) * a.ndim) if keepdim else out
+        else:
+            out = jnp.argmax(a, axis=axis, keepdims=keepdim)
+        return out.astype(dt)
+    return apply_op("argmax", f, x)
 
 
 def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
     from ..core.dtype import convert_dtype
+    from ..core.dispatch import apply_op
+    dt = convert_dtype(dtype)
+
     def f(a):
         if axis is None:
             out = jnp.argmin(a.reshape(-1))
-            return out.reshape((1,) * a.ndim) if keepdim else out
-        return jnp.argmin(a, axis=axis, keepdims=keepdim)
-    return Tensor(f(unwrap(x)).astype(convert_dtype(dtype)))
+            out = out.reshape((1,) * a.ndim) if keepdim else out
+        else:
+            out = jnp.argmin(a, axis=axis, keepdims=keepdim)
+        return out.astype(dt)
+    return apply_op("argmin", f, x)
 
 
 def argsort(x, axis=-1, descending=False, stable=False, name=None):
-    a = unwrap(x)
-    out = jnp.argsort(-a if descending else a, axis=axis, stable=stable or descending)
-    return Tensor(out.astype(jnp.int64))
+    from ..core.dispatch import apply_op
+
+    def f(a):
+        out = jnp.argsort(-a if descending else a, axis=axis,
+                          stable=stable or descending)
+        return out.astype(jnp.int64)
+    return apply_op("argsort", f, x)
 
 
 def sort(x, axis=-1, descending=False, stable=False, name=None):

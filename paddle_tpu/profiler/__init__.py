@@ -168,7 +168,30 @@ class Profiler:
         return out
 
     def export(self, path=None, format="json"):
-        return self._dir
+        """Write host events + step times as a chrome-trace JSON; the XLA
+        XPlane dump (TensorBoard/Perfetto) lives in self._dir. Returns the
+        written path (reference: profiler.py export)."""
+        if path is None:
+            return self._dir
+        import json
+        events = []
+        t0 = 0.0
+        for name, times in _host_events.items():
+            for dur in times:
+                events.append({"name": name, "ph": "X", "pid": 0, "tid": 0,
+                               "ts": t0 * 1e6, "dur": dur * 1e6,
+                               "cat": "host"})
+                t0 += dur
+        t1 = 0.0
+        for i, dur in enumerate(self._step_times):
+            events.append({"name": f"step {i}", "ph": "X", "pid": 0,
+                           "tid": 1, "ts": t1 * 1e6, "dur": dur * 1e6,
+                           "cat": "step"})
+            t1 += dur
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "xplane_dir": self._dir or ""}, f)
+        return path
 
     def __enter__(self):
         self.start()
@@ -179,5 +202,35 @@ class Profiler:
         return False
 
 
+class ProfilerResult:
+    """Parsed chrome-trace (reference: profiler.py ProfilerResult)."""
+
+    def __init__(self, events, xplane_dir=""):
+        self.events = events
+        self.xplane_dir = xplane_dir
+
+    def time_range_summary(self):
+        agg = defaultdict(lambda: [0, 0.0])
+        for e in self.events:
+            agg[e["name"]][0] += 1
+            agg[e["name"]][1] += e.get("dur", 0.0) / 1e6
+        return {k: {"calls": v[0], "total_s": v[1]} for k, v in agg.items()}
+
+    def summary(self):
+        lines = ["--------- loaded profile ---------"]
+        for name, s in sorted(self.time_range_summary().items(),
+                              key=lambda kv: -kv[1]["total_s"]):
+            lines.append(f"{name:40s} calls={s['calls']:6d} "
+                         f"total={s['total_s']*1000:10.3f}ms")
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+
 def load_profiler_result(filename):
-    raise NotImplementedError("open the XPlane trace in TensorBoard/Perfetto")
+    """Load a Profiler.export JSON back (reference: profiler.py
+    load_profiler_result)."""
+    import json
+    with open(filename) as f:
+        d = json.load(f)
+    return ProfilerResult(d.get("traceEvents", []), d.get("xplane_dir", ""))

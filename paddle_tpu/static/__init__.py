@@ -1,22 +1,52 @@
 """paddle.static compat surface (reference: python/paddle/static/).
 
 The reference's Program/Executor static graph collapses into to_static capture
-(jaxpr/StableHLO is the program IR). These shims keep static-style user code
-importable; InputSpec is the real, shared spec type.
+(jaxpr/StableHLO is the program IR). Here the static feed/fetch pattern is
+REAL: `data()` makes named placeholder Tensors, eager user code builds the op
+tape (dispatch records raw_fn per node), and `Executor.run` replays the tape
+from fetch targets with feed values substituted — a mini interpreter over the
+same graph autograd uses (reference: StandaloneExecutor over PIR).
 """
 from __future__ import annotations
 
 import contextlib
+import weakref
 
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import _state as _dispatch_state
 from ..jit import InputSpec  # noqa: F401
 from ..jit.to_static import StaticFunction  # noqa: F401
 
+# id(tensor) -> weakref of every placeholder ever made by data()
+_placeholder_regs: "weakref.WeakValueDictionary[int, Tensor]" = \
+    weakref.WeakValueDictionary()
+
+
+def _is_placeholder(t):
+    return _placeholder_regs.get(id(t)) is t
+
+
+def enable_static():
+    """Record replay linkage for every dispatched op (reference:
+    paddle.enable_static). program_guard enables this automatically."""
+    _dispatch_state.static_record = True
+
+
+def disable_static():
+    _dispatch_state.static_record = False
+
 
 class Program:
-    """Placeholder Program: captured programs are jaxprs inside StaticFunction."""
+    """Holds the named placeholders created under its guard; ops live on the
+    dispatch tape (jaxpr analog), not in a separate block structure."""
 
     def __init__(self):
-        self._sf = None
+        # weak: a placeholder the user dropped shouldn't be pinned forever
+        # by the module-global default program
+        self._placeholders = weakref.WeakValueDictionary()
 
     def global_block(self):
         return self
@@ -25,17 +55,29 @@ class Program:
         return self
 
 
+_default_main = Program()
+_default_startup = Program()
+_current: list[Program] = [_default_main]
+
+
 def default_main_program():
-    return Program()
+    return _current[-1]
 
 
 def default_startup_program():
-    return Program()
+    return _default_startup
 
 
 @contextlib.contextmanager
 def program_guard(main_program, startup_program=None):
-    yield
+    _current.append(main_program)
+    prev = _dispatch_state.static_record
+    _dispatch_state.static_record = True
+    try:
+        yield
+    finally:
+        _dispatch_state.static_record = prev
+        _current.pop()
 
 
 @contextlib.contextmanager
@@ -44,17 +86,81 @@ def name_scope(prefix=None):
 
 
 def data(name, shape, dtype="float32", lod_level=0):
-    return InputSpec(shape, dtype, name)
+    """Named placeholder; stop_gradient=False so every downstream op records
+    on the tape for Executor replay (reference: static/input.py data)."""
+    shp = [1 if (s is None or (isinstance(s, int) and s < 0)) else int(s)
+           for s in shape]
+    t = Tensor(jnp.zeros(shp, dtype), stop_gradient=False, name=name)
+    _current[-1]._placeholders[name] = t
+    _placeholder_regs[id(t)] = t
+    return t
 
 
 class Executor:
+    """Replays the op tape under fetch targets, substituting feed arrays for
+    placeholders (reference: executor.py Executor over StandaloneExecutor)."""
+
     def __init__(self, place=None):
         self.place = place
 
     def run(self, program=None, feed=None, fetch_list=None, **kwargs):
-        raise NotImplementedError(
-            "static Executor.run: use paddle_tpu.jit.to_static capture instead "
-            "(the PIR/StandaloneExecutor path is subsumed by XLA)")
+        feed = feed or {}
+        if not fetch_list:
+            return []   # startup program: params already eagerly initialized
+        cache = {}      # id(replay node) -> tuple of output arrays
+
+        def entry(t):
+            """(node, slot) to replay t, or None if t is a leaf."""
+            if t._replay_node is not None:
+                return t._replay_node
+            n = t._grad_node
+            if n is not None and n.raw_fn is not None:
+                return (n, t._out_slot)
+            return None
+
+        def leaf_value(t):
+            if _is_placeholder(t):
+                if t.name not in feed:
+                    raise ValueError(
+                        f"static placeholder '{t.name}' reached by fetch "
+                        f"but missing from feed={sorted(feed)}")
+                return jnp.asarray(np.asarray(feed[t.name]), t._buf.dtype)
+            return t._buf   # parameter / constant: current live value
+
+        def ev(root):
+            if not isinstance(root, Tensor):
+                return jnp.asarray(root)
+            # iterative post-order (graphs can be 1000s of ops deep)
+            stack = [(root, False)]
+            while stack:
+                t, expanded = stack.pop()
+                e = None if _is_placeholder(t) else entry(t)
+                if e is None or id(e[0]) in cache:
+                    continue
+                node = e[0]
+                if expanded:
+                    args = []
+                    for inp, arr in zip(node.inputs, node.in_arrays):
+                        if inp is None:
+                            args.append(arr)
+                        else:
+                            e2 = None if _is_placeholder(inp) else entry(inp)
+                            args.append(leaf_value(inp) if e2 is None
+                                        else cache[id(e2[0])][e2[1]])
+                    out = node.raw_fn(*args)
+                    cache[id(node)] = out if isinstance(out, (tuple, list)) \
+                        else (out,)
+                else:
+                    stack.append((t, True))
+                    for inp in node.inputs:
+                        if inp is not None:
+                            stack.append((inp, False))
+            e = None if _is_placeholder(root) else entry(root)
+            if e is None:
+                return leaf_value(root)
+            return cache[id(e[0])][e[1]]
+
+        return [np.asarray(ev(t)) for t in fetch_list]
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):

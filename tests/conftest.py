@@ -8,6 +8,11 @@ and, if a TPU backend already initialized, clear it.  Tests hard-assert the
 silently testing less (round-1 failure mode).
 """
 import os
+import tempfile
+
+# hermetic autotune cache: don't read/write the user's on-disk cache
+os.environ["PADDLE_TPU_AUTOTUNE_CACHE"] = os.path.join(
+    tempfile.gettempdir(), f"paddle_tpu_autotune_test_{os.getpid()}.json")
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:

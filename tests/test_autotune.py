@@ -1,0 +1,121 @@
+"""Autotune subsystem (reference: phi/kernels/autotune)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import autotune as at
+
+rng = np.random.RandomState(0)
+
+
+class TestAutotuneCore:
+    def setup_method(self, _):
+        at.clear()
+        paddle.set_flags({"FLAGS_use_autotune": True})
+
+    def teardown_method(self, _):
+        at.clear()
+        paddle.set_flags({"FLAGS_use_autotune": False})
+
+    def test_tune_picks_and_caches(self):
+        calls = []
+
+        def build(cfg):
+            def run(x):
+                calls.append(cfg)
+                import time
+                if cfg == "slow":
+                    time.sleep(0.01)
+                return x * 2
+            return run
+
+        import jax.numpy as jnp
+        args = (jnp.ones(4),)
+        key = at.cache_key("op", 4, "float32")
+        best = at.tune(key, ["slow", "fast"], build, args, iters=2)
+        assert best == "fast"
+        # cached: no further timing calls
+        n = len(calls)
+        again = at.tune(key, ["slow", "fast"], build, args)
+        assert again == "fast" and len(calls) == n
+        assert at.lookup(key) == "fast"
+
+    def test_disabled_returns_default(self):
+        paddle.set_flags({"FLAGS_use_autotune": False})
+        import jax.numpy as jnp
+        got = at.tune(at.cache_key("op2", 1), ["default", "other"],
+                      lambda c: (lambda x: x), (jnp.ones(2),))
+        assert got == "default"
+        assert at.lookup(at.cache_key("op2", 1)) is None  # nothing cached
+
+    def test_never_tunes_on_tracers(self):
+        import jax
+        import jax.numpy as jnp
+        timed = []
+
+        def build(cfg):
+            def run(x):
+                timed.append(cfg)
+                return x
+            return run
+
+        def f(x):
+            cfg = at.tune(at.cache_key("op3", 2), ["a", "b"], build, (x,))
+            assert cfg == "a"   # default under trace
+            return x
+
+        jax.jit(f)(jnp.ones(3))
+        assert timed == []
+
+    def test_failing_candidate_skipped(self):
+        import jax.numpy as jnp
+
+        def build(cfg):
+            if cfg == "bad":
+                def boom(x):
+                    raise RuntimeError("invalid config")
+                return boom
+            return lambda x: x + 1
+        best = at.tune(at.cache_key("op4", 3), ["bad", "good"], build,
+                       (jnp.ones(2),))
+        assert best == "good"
+
+
+class TestFlashBlocks:
+    def test_candidates_respect_divisibility(self):
+        from paddle_tpu.ops.pallas.flash_attention import _block_candidates
+        import jax.numpy as jnp
+        c = _block_candidates(256, 256, 128, jnp.float32)
+        assert (128, 128) in c and (256, 256) in c
+        assert all(256 % bq == 0 and 256 % bk == 0 for bq, bk in c)
+        c2 = _block_candidates(128, 128, 128, jnp.float32)
+        assert c2 == [(128, 128)]
+
+    def test_flash_matches_reference_with_tuned_blocks(self):
+        at.clear()
+        paddle.set_flags({"FLAGS_use_autotune": True})
+        try:
+            from paddle_tpu.ops.pallas.flash_attention import \
+                flash_attention_bshd
+            from paddle_tpu.nn.functional.attention import _sdpa_ref
+            import jax.numpy as jnp
+            B, S, H, D = 1, 256, 2, 128
+            q = jnp.asarray(rng.rand(B, S, H, D).astype(np.float32))
+            k = jnp.asarray(rng.rand(B, S, H, D).astype(np.float32))
+            v = jnp.asarray(rng.rand(B, S, H, D).astype(np.float32))
+            out = flash_attention_bshd(q, k, v, causal=True)
+            ref = _sdpa_ref(q, k, v, causal=True)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=2e-2, atol=2e-3)
+            # a tuned entry landed in the cache
+            assert any(key.startswith("flash_fwd|") for key in at._cache)
+        finally:
+            paddle.set_flags({"FLAGS_use_autotune": False})
+            at.clear()
+
+    def test_flash_default_blocks_unchanged_when_disabled(self):
+        from paddle_tpu.ops.pallas import flash_attention as fa
+        import jax.numpy as jnp
+        q3 = jnp.zeros((2, 256, 128), jnp.float32)
+        assert fa._pick_blocks(q3, q3, q3, True) in fa._block_candidates(
+            256, 256, 128, jnp.float32)

@@ -1,0 +1,185 @@
+"""Round-2 partial-row fills: SpectralNorm, static Executor feed/fetch,
+Model inference export, profiler result round-trip."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+rng = np.random.RandomState(0)
+
+
+class TestSpectralNorm:
+    def test_normalizes_leading_singular_value(self):
+        paddle.seed(0)
+        sn = nn.SpectralNorm([6, 4], dim=0, power_iters=20)
+        w = rng.randn(6, 4).astype(np.float32)
+        out = sn(paddle.to_tensor(w)).numpy()
+        sigma = np.linalg.svd(w, compute_uv=False)[0]
+        np.testing.assert_allclose(np.linalg.svd(out, compute_uv=False)[0],
+                                   1.0, rtol=1e-3)
+        np.testing.assert_allclose(out, w / sigma, rtol=1e-3, atol=1e-4)
+
+    def test_power_iteration_warms_up_buffers(self):
+        paddle.seed(1)
+        sn = nn.SpectralNorm([5, 3], power_iters=1)
+        w = paddle.to_tensor(rng.randn(5, 3).astype(np.float32))
+        u0 = sn.weight_u.numpy().copy()
+        for _ in range(30):   # u/v persist, so repeated calls converge
+            out = sn(w)
+        assert not np.allclose(sn.weight_u.numpy(), u0)
+        sigma = np.linalg.svd(w.numpy(), compute_uv=False)[0]
+        np.testing.assert_allclose(
+            np.linalg.svd(out.numpy(), compute_uv=False)[0] * sigma,
+            sigma, rtol=1e-3)
+
+    def test_conv_weight_4d(self):
+        paddle.seed(2)
+        sn = nn.SpectralNorm([8, 3, 3, 3], dim=0, power_iters=15)
+        w = rng.randn(8, 3, 3, 3).astype(np.float32)
+        out = sn(paddle.to_tensor(w)).numpy()
+        m = w.reshape(8, -1)
+        sigma = np.linalg.svd(m, compute_uv=False)[0]
+        np.testing.assert_allclose(out, w / sigma, rtol=1e-3, atol=1e-4)
+
+    def test_gradient_flows(self):
+        sn = nn.SpectralNorm([4, 4], power_iters=5)
+        w = paddle.to_tensor(rng.randn(4, 4).astype(np.float32),
+                             stop_gradient=False)
+        sn(w).sum().backward()
+        assert w.grad is not None and np.isfinite(w.grad.numpy()).all()
+
+
+class TestStaticExecutor:
+    def test_feed_fetch_replay(self):
+        ps = paddle.static
+        main = ps.Program()
+        with ps.program_guard(main):
+            x = ps.data("x", [None, 4], "float32")
+            w = paddle.to_tensor(rng.rand(4, 3).astype(np.float32),
+                                 stop_gradient=False)
+            y = paddle.matmul(x, w)
+            z = paddle.nn.functional.relu(y) * 2.0
+        exe = ps.Executor()
+        exe.run(ps.default_startup_program())
+        xv = rng.rand(5, 4).astype(np.float32)
+        out, = exe.run(main, feed={"x": xv}, fetch_list=[z])
+        want = np.maximum(xv @ w.numpy(), 0) * 2.0
+        np.testing.assert_allclose(out, want, rtol=1e-5)
+        # run again with different feed — replay, not cached result
+        xv2 = rng.rand(2, 4).astype(np.float32)
+        out2, = exe.run(main, feed={"x": xv2}, fetch_list=[z])
+        np.testing.assert_allclose(out2, np.maximum(xv2 @ w.numpy(), 0) * 2,
+                                   rtol=1e-5)
+
+    def test_fetch_intermediate_and_multiple(self):
+        ps = paddle.static
+        main = ps.Program()
+        with ps.program_guard(main):
+            a = ps.data("a", [3], "float32")
+            b = a + 1.0
+            c = b * b
+        exe = ps.Executor()
+        av = np.array([1.0, 2.0, 3.0], np.float32)
+        bv, cv = exe.run(main, feed={"a": av}, fetch_list=[b, c])
+        np.testing.assert_allclose(bv, av + 1)
+        np.testing.assert_allclose(cv, (av + 1) ** 2)
+
+
+class TestStaticExecutorRegressions:
+    def test_bool_int_ops_replay(self):
+        ps = paddle.static
+        main = ps.Program()
+        with ps.program_guard(main):
+            x = ps.data("x", [4], "float32")
+            mask = paddle.cast(x > 0, "float32")
+        out, = ps.Executor().run(main, feed={"x": np.array(
+            [-1, 2, -3, 4], np.float32)}, fetch_list=[mask])
+        np.testing.assert_allclose(out, [0, 1, 0, 1])
+
+    def test_missing_feed_raises(self):
+        ps = paddle.static
+        main = ps.Program()
+        with ps.program_guard(main):
+            x = ps.data("x", [2], "float32")
+            y = x * 2.0
+        with pytest.raises(ValueError, match="missing from feed"):
+            ps.Executor().run(main, feed={}, fetch_list=[y])
+
+    def test_deep_graph_no_recursion_error(self):
+        ps = paddle.static
+        main = ps.Program()
+        with ps.program_guard(main):
+            z = ps.data("z", [2], "float32")
+            out = z
+            for _ in range(2000):
+                out = out + 1.0
+        got, = ps.Executor().run(
+            main, feed={"z": np.zeros(2, np.float32)}, fetch_list=[out])
+        np.testing.assert_allclose(got, [2000.0, 2000.0])
+
+
+class TestMultiDynamicExport:
+    def test_two_dynamic_inputs_share_scope(self, tmp_path):
+        from paddle_tpu.jit import InputSpec
+        paddle.seed(4)
+
+        class Net(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = paddle.nn.Linear(4, 4)
+
+            def forward(self, a, b):
+                return self.lin(a) + b
+
+        net = Net()
+        path = str(tmp_path / "two_dyn")
+        paddle.jit.save(net, path, input_spec=[
+            InputSpec([None, 4], "float32", "a"),
+            InputSpec([None, 4], "float32", "b")])
+        loaded = paddle.jit.load(path)
+        for batch in (2, 5):
+            av = rng.rand(batch, 4).astype(np.float32)
+            bv = rng.rand(batch, 4).astype(np.float32)
+            got = loaded(paddle.to_tensor(av), paddle.to_tensor(bv)).numpy()
+            want = net(paddle.to_tensor(av), paddle.to_tensor(bv)).numpy()
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+class TestModelExport:
+    def test_inference_export_roundtrip(self, tmp_path):
+        from paddle_tpu.jit import InputSpec
+        paddle.seed(3)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m = paddle.Model(net, inputs=[InputSpec([None, 4], "float32", "x")])
+        path = str(tmp_path / "infer")
+        m.save(path, training=False)
+        loaded = paddle.jit.load(path)
+        xv = rng.rand(3, 4).astype(np.float32)
+        got = loaded(paddle.to_tensor(xv)).numpy()
+        want = net(paddle.to_tensor(xv)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_export_without_specs_raises(self):
+        m = paddle.Model(nn.Linear(2, 2))
+        with pytest.raises(ValueError, match="input specs"):
+            m.save("/tmp/x", training=False)
+
+
+class TestProfilerRoundtrip:
+    def test_export_and_load(self, tmp_path):
+        import paddle_tpu.profiler as prof
+        p = prof.Profiler(timer_only=True)
+        p.start()
+        with prof.RecordEvent("my_region"):
+            _ = (paddle.to_tensor(np.ones(4, np.float32)) * 2).numpy()
+        p.step()
+        p.step()
+        p.stop()
+        path = str(tmp_path / "trace.json")
+        assert p.export(path) == path
+        res = prof.load_profiler_result(path)
+        summ = res.time_range_summary()
+        assert "my_region" in summ
+        assert summ["my_region"]["calls"] >= 1
+        assert any(e["cat"] == "step" for e in res.events)
