@@ -1,0 +1,59 @@
+"""Lazy native-code builder: compile a .cc beside this package into a cached
+.so and load it via ctypes (reference equivalent: the paddle build links
+phi's C++ runtime; here native pieces compile on first use and every caller
+has a pure-Python fallback, so a missing toolchain never breaks the wheel).
+
+Cache: $PADDLE_TPU_NATIVE_CACHE or ~/.cache/paddle_tpu/native/<name>-<hash>.so
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_lock = threading.Lock()
+_loaded: dict = {}
+
+
+def _cache_dir():
+    return os.environ.get(
+        "PADDLE_TPU_NATIVE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                     "native"))
+
+
+def load(name: str, source_file: str, extra_flags=()):
+    """Compile+load <dir of build.py>/<source_file> as a shared lib.
+    Returns ctypes.CDLL, or None when no toolchain / compile error
+    (callers fall back to their Python implementation)."""
+    with _lock:
+        if name in _loaded:
+            return _loaded[name]
+        src = os.path.join(os.path.dirname(__file__), source_file)
+        try:
+            with open(src, "rb") as f:
+                digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        except OSError:
+            _loaded[name] = None
+            return None
+        out = os.path.join(_cache_dir(), f"{name}-{digest}.so")
+        if not os.path.exists(out):
+            os.makedirs(_cache_dir(), exist_ok=True)
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                   "-pthread", src, "-o", out + ".tmp", *extra_flags]
+            try:
+                r = subprocess.run(cmd, capture_output=True, timeout=120)
+                if r.returncode != 0:
+                    _loaded[name] = None
+                    return None
+                os.replace(out + ".tmp", out)
+            except (OSError, subprocess.TimeoutExpired):
+                _loaded[name] = None
+                return None
+        try:
+            _loaded[name] = ctypes.CDLL(out)
+        except OSError:
+            _loaded[name] = None
+        return _loaded[name]

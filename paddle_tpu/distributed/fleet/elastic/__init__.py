@@ -1,0 +1,119 @@
+"""Elastic training manager (reference: python/paddle/distributed/fleet/
+elastic/manager.py:125 ElasticManager — ETCD heartbeats, scale in/out
+detection, restart decisions; launch integrates via --max_restarts).
+
+TPU framing: the heartbeat plane is TCPStore (native C++ daemon when
+available) instead of ETCD; the manager watches per-rank heartbeats,
+reports the alive world, and decides restart vs wait. The launch CLI's
+restart loop (launch/main.py --max_restarts) is the actuator."""
+from __future__ import annotations
+
+import threading
+import time
+
+from ...store import TCPStore
+
+__all__ = ["ElasticManager", "ElasticStatus"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"        # waiting for ranks (scale event in progress)
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Heartbeat + membership over the store.
+
+    Each rank calls start() (spawns a heartbeat thread) and the supervisor
+    polls watch(): READY when np_min <= alive <= np_max and stable, HOLD
+    while members are joining, RESTART when a previously-alive rank went
+    silent past `timeout` (the reference restarts the job group on ETCD
+    watch events)."""
+
+    def __init__(self, rank, store=None, host="127.0.0.1", port=0,
+                 np_min=1, np_max=None, heartbeat_interval=1.0,
+                 timeout=10.0, job_id="default"):
+        self.rank = rank
+        self.np_min = np_min
+        self.np_max = np_max
+        self.interval = heartbeat_interval
+        self.timeout = timeout
+        self.prefix = f"elastic/{job_id}"
+        self.store = store if store is not None else TCPStore(
+            host=host, port=port, is_master=(rank == 0))
+        self._stop = threading.Event()
+        self._thread = None
+
+    # -- heartbeat plane ------------------------------------------------------
+    def _beat_key(self, rank):
+        return f"{self.prefix}/beat/{rank}"
+
+    def _beat(self):
+        while not self._stop.is_set():
+            self.store.set(self._beat_key(self.rank), time.time())
+            self._stop.wait(self.interval)
+
+    def start(self):
+        self.store.set(self._beat_key(self.rank), time.time())
+        self.store.set(f"{self.prefix}/seen/{self.rank}", 1)
+        self._thread = threading.Thread(target=self._beat, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- membership -----------------------------------------------------------
+    def _probe(self, world):
+        """(ranks expected alive, ranks with a fresh heartbeat). A rank that
+        called mark_finished() completed cleanly — it is excluded from both,
+        so a finished member never reads as a fault."""
+        now = time.time()
+        seen, alive = [], []
+        for r in range(world):
+            try:
+                self.store.get(f"{self.prefix}/seen/{r}", timeout=0.05)
+            except Exception:
+                continue
+            try:
+                self.store.get(f"{self.prefix}/finished/{r}", timeout=0.05)
+                continue   # clean exit, not a member anymore
+            except Exception:
+                pass
+            seen.append(r)
+            try:
+                t = self.store.get(self._beat_key(r), timeout=0.05)
+                if now - float(t) <= self.timeout:
+                    alive.append(r)
+            except Exception:
+                pass
+        return seen, alive
+
+    def alive_ranks(self, world_hint=None):
+        world = world_hint or (self.np_max or self.np_min)
+        return self._probe(world)[1]
+
+    def watch(self, world_hint=None):
+        """One membership observation -> ElasticStatus."""
+        world = world_hint or (self.np_max or self.np_min)
+        seen, alive = self._probe(world)
+        if seen and not alive:
+            return ElasticStatus.ERROR
+        if len(seen) > len(alive):
+            # someone was here and went silent -> group must restart
+            # (a rejoining rank refreshes its beat and clears this);
+            # takes priority over HOLD: a dead member is a fault, not a
+            # not-yet-joined member
+            return ElasticStatus.RESTART
+        if len(alive) < self.np_min:
+            return ElasticStatus.HOLD
+        if self.np_max is not None and len(alive) > self.np_max:
+            return ElasticStatus.HOLD
+        return ElasticStatus.COMPLETED
+
+    def mark_finished(self):
+        self.store.set(f"{self.prefix}/finished/{self.rank}", 1)

@@ -1,0 +1,144 @@
+"""Parameter-server mode (reference: the reference's PS stack —
+python/paddle/distributed/fleet runtime with pslib/the_one_ps servers —
+reduced to its TPU-relevant core).
+
+TPU framing: dense training scales via data/model parallelism on XLA
+collectives, so the PS here serves the genuinely PS-shaped workload the
+reference keeps PS for: host-resident sparse embedding tables too large for
+HBM. Servers hold named numpy tables sharded by row-hash; trainers pull rows
+/ push sparse row gradients over the RPC agent (control-plane sockets)."""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from . import rpc
+
+__all__ = ["ParameterServer", "PsClient", "row_shard"]
+
+_tables: dict[str, "_Table"] = {}
+_tables_lock = threading.Lock()
+
+
+class _Table:
+    def __init__(self, rows, dim, initializer="zeros", lr=0.1,
+                 optimizer="sgd"):
+        if initializer == "zeros":
+            self.data = np.zeros((rows, dim), np.float32)
+        else:
+            rng = np.random.RandomState(0)
+            self.data = (rng.rand(rows, dim).astype(np.float32) - 0.5) * 0.02
+        self.lr = lr
+        self.optimizer = optimizer
+        self.accum = np.zeros((rows,), np.float32) if optimizer == "adagrad" \
+            else None
+        self.lock = threading.Lock()
+
+
+# ---- server-side handlers (execute on the PS rank via rpc) ------------------
+def _ps_create(name, rows, dim, initializer, lr, optimizer):
+    with _tables_lock:
+        if name not in _tables:
+            _tables[name] = _Table(rows, dim, initializer, lr, optimizer)
+    return True
+
+
+def _ps_pull(name, row_ids):
+    t = _tables[name]
+    with t.lock:
+        return t.data[np.asarray(row_ids)]
+
+
+def _ps_push(name, row_ids, grads):
+    """Sparse update: rows row_ids -= lr * grads (duplicate ids accumulate)."""
+    t = _tables[name]
+    ids = np.asarray(row_ids)
+    g = np.asarray(grads, np.float32)
+    with t.lock:
+        if t.optimizer == "adagrad":
+            sq = np.zeros_like(t.accum)
+            np.add.at(sq, ids, (g * g).mean(-1))
+            t.accum += sq
+            scale = t.lr / (np.sqrt(t.accum[ids]) + 1e-8)
+            upd = np.zeros_like(t.data)
+            np.add.at(upd, ids, g * scale[:, None])
+        else:
+            upd = np.zeros_like(t.data)
+            np.add.at(upd, ids, t.lr * g)
+        t.data -= upd
+    return True
+
+
+def _ps_stats(name):
+    t = _tables[name]
+    with t.lock:
+        return {"shape": list(t.data.shape), "norm": float(
+            np.linalg.norm(t.data))}
+
+
+def row_shard(row_ids, num_servers):
+    """row id -> server index (hash sharding, reference table sharding)."""
+    return np.asarray(row_ids) % num_servers
+
+
+class ParameterServer:
+    """The PS rank: just keeps the process alive serving RPC handlers
+    (reference: fleet.init_server()/run_server())."""
+
+    def run(self):
+        return  # the rpc agent thread serves; nothing else to do
+
+
+class PsClient:
+    """Trainer-side handle to a set of PS ranks (reference: fleet PS client
+    via _communicator; pull/push sparse)."""
+
+    def __init__(self, server_names):
+        self.servers = list(server_names)
+
+    def create_table(self, name, rows, dim, initializer="uniform", lr=0.1,
+                     optimizer="sgd"):
+        for s in self.servers:
+            rpc.rpc_sync(s, _ps_create, args=(name, rows, dim, initializer,
+                                              lr, optimizer))
+
+    def _split(self, row_ids):
+        ids = np.asarray(row_ids)
+        shard = row_shard(ids, len(self.servers))
+        parts = []
+        for si in range(len(self.servers)):
+            mask = shard == si
+            parts.append((si, np.nonzero(mask)[0], ids[mask]))
+        return parts
+
+    def pull(self, name, row_ids, dim=None):
+        ids = np.asarray(row_ids)
+        out = None
+        futs = []
+        for si, pos, sub in self._split(ids):
+            if len(sub) == 0:
+                continue
+            futs.append((pos, rpc.rpc_async(self.servers[si], _ps_pull,
+                                            args=(name, sub))))
+        for pos, f in futs:
+            rows = f.result()
+            if out is None:
+                out = np.zeros((len(ids), rows.shape[1]), np.float32)
+            out[pos] = rows
+        return out
+
+    def push(self, name, row_ids, grads):
+        futs = []
+        g = np.asarray(grads, np.float32)
+        for si, pos, sub in self._split(row_ids):
+            if len(sub) == 0:
+                continue
+            futs.append(rpc.rpc_async(self.servers[si], _ps_push,
+                                      args=(name, sub, g[pos])))
+        for f in futs:
+            f.result()
+
+    def stats(self, name):
+        return [rpc.rpc_sync(s, _ps_stats, args=(name,))
+                for s in self.servers]

@@ -1,0 +1,224 @@
+"""paddle.distributed.rpc analog (reference: python/paddle/distributed/rpc/
+rpc.py — init_rpc/rpc_sync/rpc_async/shutdown over a brpc C++ agent).
+
+TPU-native framing: RPC is host-side control-plane (parameter-server
+coordination, elastic orchestration, user-defined remote calls) — tensor
+traffic stays on XLA collectives. The agent is a thread-per-connection
+socket server; discovery and the shutdown barrier ride TCPStore (whose
+daemon is the native C++ one when available)."""
+from __future__ import annotations
+
+import concurrent.futures
+import pickle
+import socket
+import struct
+import threading
+import time
+
+from ..store import TCPStore
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos",
+           "get_current_worker_info", "WorkerInfo"]
+
+
+class WorkerInfo:
+    """reference: rpc.py WorkerInfo(name, rank, ip, port)."""
+
+    def __init__(self, name, rank, ip, port):
+        self.name, self.rank, self.ip, self.port = name, rank, ip, port
+
+    def __repr__(self):
+        return (f"WorkerInfo(name={self.name}, rank={self.rank}, "
+                f"ip={self.ip}, port={self.port})")
+
+
+_state = threading.local()
+_srv = None
+_store = None
+_infos: dict[str, WorkerInfo] = {}
+_self_info: WorkerInfo | None = None
+_conns: dict[str, socket.socket] = {}
+_conn_locks: dict[str, threading.Lock] = {}
+_conn_lock = threading.Lock()     # guards the two dicts, never held over IO
+_pool = None
+
+
+def _send_blob(sock, data):
+    sock.sendall(struct.pack("!I", len(data)) + data)
+
+
+def _recv_blob(sock):
+    hdr = b""
+    while len(hdr) < 4:
+        c = sock.recv(4 - len(hdr))
+        if not c:
+            raise ConnectionError("rpc connection closed")
+        hdr += c
+    (n,) = struct.unpack("!I", hdr)
+    buf = b""
+    while len(buf) < n:
+        c = sock.recv(min(1 << 20, n - len(buf)))
+        if not c:
+            raise ConnectionError("rpc connection closed")
+        buf += c
+    return buf
+
+
+class _Agent(threading.Thread):
+    """Serves incoming calls: recv (fn, args, kwargs) -> send (ok, result)."""
+
+    def __init__(self):
+        super().__init__(daemon=True)
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("0.0.0.0", 0))
+        self._srv.listen(64)
+        self.port = self._srv.getsockname()[1]
+
+    def run(self):
+        while True:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                fn, args, kwargs = pickle.loads(_recv_blob(conn))
+                try:
+                    out = (True, fn(*args, **kwargs))
+                except Exception as e:       # deliver remote exceptions
+                    out = (False, e)
+                _send_blob(conn, pickle.dumps(out))
+        except (ConnectionError, EOFError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def close(self):
+        self._srv.close()
+
+
+def _local_ip(master_host):
+    """The interface IP that actually routes to the master (UDP-connect
+    trick) — gethostbyname(hostname) is wrong in containers."""
+    if master_host in ("127.0.0.1", "localhost", "0.0.0.0"):
+        return "127.0.0.1"
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect((master_host, 1))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """reference: rpc.py:85. Starts the agent, registers name->endpoint in
+    the store, blocks until all world_size workers registered."""
+    global _srv, _store, _self_info
+    from ..env import get_rank, get_world_size
+    rank = get_rank() if rank is None else rank
+    world_size = get_world_size() if world_size is None else world_size
+    master_endpoint = master_endpoint or "127.0.0.1:0"
+    host, port = master_endpoint.rsplit(":", 1)
+    _srv = _Agent()
+    _srv.start()
+    _store = TCPStore(host=host, port=int(port), is_master=(rank == 0),
+                      world_size=world_size)
+    ip = _local_ip(host)
+    _self_info = WorkerInfo(name, rank, ip, _srv.port)
+    _store.set(f"rpc/worker/{rank}", (name, rank, ip, _srv.port))
+    _store.wait([f"rpc/worker/{r}" for r in range(world_size)])
+    for r in range(world_size):
+        n, rk, wip, wport = _store.get(f"rpc/worker/{r}")
+        _infos[n] = WorkerInfo(n, rk, wip, wport)
+    return _store.port
+
+
+def _connect(to):
+    info = _infos.get(to)
+    if info is None:
+        raise ValueError(f"unknown rpc worker {to!r} "
+                         f"(known: {sorted(_infos)})")
+    with _conn_lock:
+        lock = _conn_locks.setdefault(to, threading.Lock())
+        sock = _conns.get(to)
+    if sock is None:
+        with lock:
+            with _conn_lock:
+                sock = _conns.get(to)
+            if sock is None:
+                sock = socket.create_connection((info.ip, info.port),
+                                                timeout=30)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                with _conn_lock:
+                    _conns[to] = sock
+    return sock, lock
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=-1):
+    """reference: rpc.py:160 — blocking remote call, returns the result.
+    Per-destination locking: calls to different peers run concurrently and
+    one hung peer can't wedge calls to the others."""
+    sock, lock = _connect(to)
+    with lock:
+        sock.settimeout(None if timeout in (-1, None) else timeout)
+        _send_blob(sock, pickle.dumps((fn, tuple(args or ()),
+                                       dict(kwargs or {}))))
+        ok, out = pickle.loads(_recv_blob(sock))
+    if not ok:
+        raise out
+    return out
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=-1):
+    """reference: rpc.py:206 — returns a future with .wait()."""
+    global _pool
+    if _pool is None:
+        _pool = concurrent.futures.ThreadPoolExecutor(max_workers=8)
+    fut = _pool.submit(rpc_sync, to, fn, args, kwargs, timeout)
+    fut.wait = fut.result   # paddle futures use .wait()
+    return fut
+
+
+def shutdown():
+    """reference: rpc.py shutdown — barrier then teardown."""
+    global _srv, _store, _self_info, _pool
+    if _store is None:
+        return
+    n = _store.add("rpc/shutdown", 1)
+    world = len(_infos)
+    deadline = time.time() + 300
+    while _store.add("rpc/shutdown", 0) < world:
+        if time.time() > deadline:
+            raise TimeoutError("rpc shutdown barrier timed out")
+        time.sleep(0.02)
+    with _conn_lock:
+        for s in _conns.values():
+            s.close()
+        _conns.clear()
+        _conn_locks.clear()
+    if _srv is not None:
+        _srv.close()
+    if _pool is not None:
+        _pool.shutdown(wait=False)
+    _srv = _store = _self_info = _pool = None
+    _infos.clear()
+
+
+def get_worker_info(name):
+    return _infos[name]
+
+
+def get_all_worker_infos():
+    return list(_infos.values())
+
+
+def get_current_worker_info():
+    return _self_info

@@ -1,43 +1,59 @@
 """TCPStore — rendezvous/control-plane key-value store (reference:
-phi/core/distributed/store/tcp_store.h:121 + tcp_utils; python surface
-paddle.distributed.TCPStore).
+phi/core/distributed/store/tcp_store.h:121 MasterDaemon + tcp_utils; python
+surface paddle.distributed.TCPStore).
 
-The master rank hosts a tiny threaded socket server; every rank (master
-included) connects as a client. Values are opaque bytes; `get` blocks until
-the key exists (the reference's Wait semantics). This is the control plane
-only — bulk tensor traffic rides XLA collectives, not this store."""
+Like the reference, the daemon is NATIVE C++ (core/native/store.cc, compiled
+on first use): the master rank hosts it in-process and every rank (master
+included) connects as a client speaking a tiny length-prefixed binary
+protocol. A pure-Python server with the identical protocol is the fallback
+when no toolchain is available. Values are opaque bytes (objects pickle
+transparently in the client); `get` blocks until the key exists (the
+reference's Wait semantics). This is the control plane only — bulk tensor
+traffic rides XLA collectives, not this store.
+
+Wire protocol (see store.cc):
+  request : u8 cmd | u32 klen | key | u32 vlen | val | f64 timeout   (BE)
+  response: u8 status (0 ok, 1 timeout, 2 bad) | u32 vlen | val
+  cmds: 1 SET  2 GET  3 ADD (val = i64 BE)  4 DELETE  5 WAIT ('\n'-joined)
+"""
 from __future__ import annotations
 
+import os
 import pickle
 import socket
 import struct
 import threading
 import time
 
-
-def _send_msg(sock, obj):
-    data = pickle.dumps(obj)
-    sock.sendall(struct.pack("!I", len(data)) + data)
+_SET, _GET, _ADD, _DELETE, _WAIT = 1, 2, 3, 4, 5
 
 
-def _recv_msg(sock):
-    hdr = b""
-    while len(hdr) < 4:
-        chunk = sock.recv(4 - len(hdr))
-        if not chunk:
-            raise ConnectionError("store connection closed")
-        hdr += chunk
-    (n,) = struct.unpack("!I", hdr)
+def _pack_req(cmd, key, val, timeout):
+    k = key.encode() if isinstance(key, str) else key
+    return (struct.pack("!B", cmd) + struct.pack("!I", len(k)) + k +
+            struct.pack("!I", len(val)) + val + struct.pack("!d", timeout))
+
+
+def _read_exact(sock, n):
     buf = b""
     while len(buf) < n:
-        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        chunk = sock.recv(n - len(buf))
         if not chunk:
             raise ConnectionError("store connection closed")
         buf += chunk
-    return pickle.loads(buf)
+    return buf
 
 
-class _StoreServer(threading.Thread):
+def _read_reply(sock):
+    status = _read_exact(sock, 1)[0]
+    (n,) = struct.unpack("!I", _read_exact(sock, 4))
+    val = _read_exact(sock, n) if n else b""
+    return status, val
+
+
+class _PyStoreServer(threading.Thread):
+    """Python fallback daemon speaking the same binary protocol."""
+
     def __init__(self, host, port):
         super().__init__(daemon=True)
         self._kv = {}
@@ -57,16 +73,25 @@ class _StoreServer(threading.Thread):
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
+    def _reply(self, conn, status, val=b""):
+        conn.sendall(struct.pack("!B", status) + struct.pack("!I", len(val))
+                     + val)
+
     def _serve(self, conn):
         try:
             while True:
-                cmd, key, val, timeout = _recv_msg(conn)
-                if cmd == "set":
+                cmd = _read_exact(conn, 1)[0]
+                (kn,) = struct.unpack("!I", _read_exact(conn, 4))
+                key = _read_exact(conn, kn).decode()
+                (vn,) = struct.unpack("!I", _read_exact(conn, 4))
+                val = _read_exact(conn, vn) if vn else b""
+                (timeout,) = struct.unpack("!d", _read_exact(conn, 8))
+                if cmd == _SET:
                     with self._cv:
                         self._kv[key] = val
                         self._cv.notify_all()
-                    _send_msg(conn, ("ok", None))
-                elif cmd == "get":
+                    self._reply(conn, 0)
+                elif cmd == _GET:
                     deadline = time.time() + timeout
                     with self._cv:
                         while key not in self._kv:
@@ -75,58 +100,82 @@ class _StoreServer(threading.Thread):
                                 break
                             self._cv.wait(left)
                         if key in self._kv:
-                            _send_msg(conn, ("ok", self._kv[key]))
+                            self._reply(conn, 0, self._kv[key])
                         else:
-                            _send_msg(conn, ("timeout", None))
-                elif cmd == "add":
+                            self._reply(conn, 1)
+                elif cmd == _ADD:
+                    (delta,) = struct.unpack("!q", val)
                     with self._cv:
-                        cur = int(self._kv.get(key, 0)) + int(val)
-                        self._kv[key] = cur
+                        cur = int(self._kv.get(key, b"0")) + delta
+                        self._kv[key] = str(cur).encode()
                         self._cv.notify_all()
-                    _send_msg(conn, ("ok", cur))
-                elif cmd == "delete":
+                    self._reply(conn, 0, struct.pack("!q", cur))
+                elif cmd == _DELETE:
                     with self._cv:
                         existed = self._kv.pop(key, None) is not None
                         self._cv.notify_all()
-                    _send_msg(conn, ("ok", existed))
-                elif cmd == "wait":
+                    self._reply(conn, 0, b"1" if existed else b"0")
+                elif cmd == _WAIT:
                     deadline = time.time() + timeout
                     ok = True
                     with self._cv:
-                        for k in key:       # key is a list here
+                        for k in key.split("\n") if key else []:
                             while k not in self._kv:
                                 left = deadline - time.time()
                                 if left <= 0:
                                     ok = False
                                     break
                                 self._cv.wait(left)
-                    _send_msg(conn, ("ok" if ok else "timeout", None))
+                            if not ok:
+                                break
+                    self._reply(conn, 0 if ok else 1)
                 else:
-                    _send_msg(conn, ("badcmd", None))
-        except (ConnectionError, EOFError, OSError):
+                    self._reply(conn, 2)
+        except (ConnectionError, EOFError, OSError, struct.error):
             pass
         finally:
             conn.close()
 
 
+def _start_server(host, port):
+    """Prefer the native C++ daemon; fall back to the Python thread.
+    Returns (bound_port, server_kind)."""
+    if os.environ.get("PADDLE_TPU_PURE_PY_STORE") != "1":
+        from ..core.native.build import load
+        lib = load("pt_store", "store.cc")
+        if lib is not None:
+            import ctypes
+            lib.pt_store_start.restype = ctypes.c_int
+            lib.pt_store_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
+            bound = lib.pt_store_start(host.encode(), port)
+            if bound > 0:
+                return bound, "native"
+    srv = _PyStoreServer(host, port)
+    srv.start()
+    return srv.port, "python"
+
+
 class TCPStore:
-    """Client handle; rank `is_master` also hosts the server in-process."""
+    """Client handle; rank `is_master` also hosts the daemon in-process."""
 
     def __init__(self, host="127.0.0.1", port=0, is_master=False,
                  world_size=1, timeout=300.0):
         self.timeout = timeout
-        self._server = None
+        self.server_kind = None
         if is_master:
-            self._server = _StoreServer(host if host != "127.0.0.1" else
-                                        "0.0.0.0", port)
-            self._server.start()
-            port = self._server.port
+            bind = "0.0.0.0" if host == "127.0.0.1" else host
+            port, self.server_kind = _start_server(bind, port)
         self.host, self.port = host, port
         deadline = time.time() + timeout
         last = None
         while True:
             try:
                 self._sock = socket.create_connection((host, port), timeout=5)
+                self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY,
+                                      1)
+                # blocking get/wait time out SERVER-side (protocol timeout
+                # field); the connect timeout must not cap recv
+                self._sock.settimeout(None)
                 break
             except OSError as e:
                 last = e
@@ -136,28 +185,41 @@ class TCPStore:
                 time.sleep(0.1)
         self._lock = threading.Lock()
 
-    def _rpc(self, cmd, key, val=None, timeout=None):
+    def _rpc(self, cmd, key, val=b"", timeout=None):
+        t = self.timeout if timeout is None else timeout
         with self._lock:
-            _send_msg(self._sock, (cmd, key, val,
-                                   self.timeout if timeout is None else timeout))
-            status, out = _recv_msg(self._sock)
-        if status == "timeout":
-            raise TimeoutError(f"TCPStore {cmd}({key!r}) timed out")
-        if status != "ok":
-            raise RuntimeError(f"TCPStore error: {status}")
+            # the server enforces t; the socket deadline is a dead-server
+            # backstop with generous grace
+            self._sock.settimeout(t + 30)
+            self._sock.sendall(_pack_req(cmd, key, val, t))
+            status, out = _read_reply(self._sock)
+        if status == 1:
+            raise TimeoutError(f"TCPStore cmd {cmd} ({key!r}) timed out")
+        if status != 0:
+            raise RuntimeError(f"TCPStore error status {status}")
         return out
 
     def set(self, key, value):
-        self._rpc("set", key, value)
+        self._rpc(_SET, key, pickle.dumps(value))
 
     def get(self, key, timeout=None):
-        return self._rpc("get", key, timeout=timeout)
+        raw = self._rpc(_GET, key, timeout=timeout)
+        try:
+            return pickle.loads(raw)
+        except Exception:
+            # keys written by add() hold ASCII decimal (the C++ daemon does
+            # arithmetic on them); surface those as ints like the reference
+            try:
+                return int(raw)
+            except ValueError:
+                return raw
 
     def add(self, key, amount=1):
-        return self._rpc("add", key, amount)
+        out = self._rpc(_ADD, key, struct.pack("!q", int(amount)))
+        return struct.unpack("!q", out)[0]
 
     def delete_key(self, key):
-        return self._rpc("delete", key)
+        return self._rpc(_DELETE, key) == b"1"
 
     def wait(self, keys, timeout=None):
-        self._rpc("wait", list(keys), timeout=timeout)
+        self._rpc(_WAIT, "\n".join(keys), timeout=timeout)

@@ -1,0 +1,164 @@
+"""paddle.distributed.rpc / parameter server / elastic manager."""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+
+def _run(code, timeout=180):
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=timeout,
+                       env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    return r
+
+
+class TestRpc:
+    def test_self_rpc_and_remote_exception(self):
+        code = """
+import operator
+from paddle_tpu.distributed import rpc
+
+rpc.init_rpc("w0", rank=0, world_size=1, master_endpoint="127.0.0.1:0")
+assert rpc.rpc_sync("w0", operator.add, args=(2, 3)) == 5
+fut = rpc.rpc_async("w0", operator.mul, args=(4, 5))
+assert fut.wait() == 20
+info = rpc.get_current_worker_info()
+assert info.name == "w0" and info.rank == 0
+assert [w.name for w in rpc.get_all_worker_infos()] == ["w0"]
+try:
+    rpc.rpc_sync("w0", operator.truediv, args=(1, 0))
+    raise SystemExit("no remote exception")
+except ZeroDivisionError:
+    pass
+rpc.shutdown()
+print("RPC_OK")
+"""
+        r = _run(code)
+        assert "RPC_OK" in r.stdout, r.stderr[-2000:]
+
+    def test_two_process_rpc(self, tmp_path):
+        # real usage: all ranks know the master endpoint up front
+        import socket as _s
+        srv = _s.socket()
+        srv.bind(("127.0.0.1", 0))
+        port = srv.getsockname()[1]
+        srv.close()
+        master = f"""
+import operator
+from paddle_tpu.distributed import rpc
+rpc.init_rpc("master", rank=0, world_size=2,
+             master_endpoint="127.0.0.1:{port}")
+assert rpc.rpc_sync("worker", operator.add, args=(10, 20)) == 30
+rpc.shutdown()
+print("MASTER_OK")
+"""
+        worker = f"""
+from paddle_tpu.distributed import rpc
+rpc.init_rpc("worker", rank=1, world_size=2,
+             master_endpoint="127.0.0.1:{port}")
+rpc.shutdown()
+print("WORKER_OK")
+"""
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        pm = subprocess.Popen([sys.executable, "-c", master],
+                              stdout=subprocess.PIPE, text=True, env=env)
+        pw = subprocess.Popen([sys.executable, "-c", worker],
+                              stdout=subprocess.PIPE, text=True, env=env)
+        om, _ = pm.communicate(timeout=180)
+        ow, _ = pw.communicate(timeout=180)
+        assert "MASTER_OK" in om and "WORKER_OK" in ow
+
+
+class TestParameterServer:
+    def test_pull_push_sharded(self):
+        code = """
+import numpy as np
+from paddle_tpu.distributed import rpc, ps
+
+rpc.init_rpc("trainer", rank=0, world_size=1,
+             master_endpoint="127.0.0.1:0")
+client = ps.PsClient(["trainer"])   # 1-server world: PS colocated
+client.create_table("emb", rows=64, dim=8, initializer="zeros", lr=0.5)
+rows = np.array([3, 10, 3])
+vals = client.pull("emb", rows)
+assert vals.shape == (3, 8) and (vals == 0).all()
+g = np.ones((3, 8), np.float32)
+client.push("emb", rows, g)         # duplicate row 3 accumulates
+after = client.pull("emb", np.array([3, 10, 5]))
+np.testing.assert_allclose(after[0], -1.0)   # 2 grads * lr 0.5
+np.testing.assert_allclose(after[1], -0.5)
+np.testing.assert_allclose(after[2], 0.0)
+stats = client.stats("emb")
+assert stats[0]["shape"] == [64, 8]
+rpc.shutdown()
+print("PS_OK")
+"""
+        r = _run(code)
+        assert "PS_OK" in r.stdout, r.stderr[-2000:]
+
+
+class TestElastic:
+    def test_membership_lifecycle(self):
+        from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                          ElasticStatus)
+        m0 = ElasticManager(rank=0, np_min=2, np_max=2, timeout=1.0,
+                            heartbeat_interval=0.2, job_id="t1")
+        assert m0.watch(world_hint=2) == ElasticStatus.HOLD  # nobody yet
+        m0.start()
+        assert m0.watch(world_hint=2) == ElasticStatus.HOLD  # 1 < np_min
+        m1 = ElasticManager(rank=1, store=m0.store, np_min=2, np_max=2,
+                            timeout=1.0, heartbeat_interval=0.2, job_id="t1")
+        m1.start()
+        assert m0.watch(world_hint=2) == ElasticStatus.COMPLETED
+        assert m0.alive_ranks(world_hint=2) == [0, 1]
+        # rank 1 dies -> heartbeats stop -> RESTART decision
+        m1.stop()
+        time.sleep(1.5)
+        assert m0.watch(world_hint=2) == ElasticStatus.RESTART
+        # rank 1 rejoins -> COMPLETED again
+        m1b = ElasticManager(rank=1, store=m0.store, np_min=2, np_max=2,
+                             timeout=1.0, heartbeat_interval=0.2,
+                             job_id="t1")
+        m1b.start()
+        assert m0.watch(world_hint=2) == ElasticStatus.COMPLETED
+        m1b.stop()
+        m0.stop()
+
+    def test_finished_rank_is_not_a_fault(self):
+        from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                          ElasticStatus)
+        m0 = ElasticManager(rank=0, np_min=1, np_max=2, timeout=1.0,
+                            heartbeat_interval=0.2, job_id="t2")
+        m0.start()
+        m1 = ElasticManager(rank=1, store=m0.store, np_min=1, np_max=2,
+                            timeout=1.0, heartbeat_interval=0.2, job_id="t2")
+        m1.start()
+        assert m0.watch(world_hint=2) == ElasticStatus.COMPLETED
+        # rank 1 completes CLEANLY: no restart storm
+        m1.mark_finished()
+        m1.stop()
+        time.sleep(1.5)
+        assert m0.watch(world_hint=2) == ElasticStatus.COMPLETED
+        m0.stop()
+
+    def test_launch_elastic_restart(self, tmp_path):
+        # worker fails on first attempt, succeeds on second (restart loop)
+        marker = tmp_path / "tried"
+        script = tmp_path / "train.py"
+        script.write_text(f"""
+import os, sys, pathlib
+m = pathlib.Path({str(marker)!r})
+if not m.exists():
+    m.write_text("1")
+    sys.exit(3)
+print("second attempt ok")
+""")
+        from paddle_tpu.distributed.launch.main import launch
+        rc = launch(["--nproc_per_node=1", "--max_restarts=2", "--backend=cpu",
+                     f"--log_dir={tmp_path}/log", str(script)])
+        assert rc == 0
+        log = (tmp_path / "log" / "workerlog.0").read_bytes().decode()
+        assert "second attempt ok" in log
