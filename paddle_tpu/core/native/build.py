@@ -14,7 +14,13 @@ import subprocess
 import threading
 
 _lock = threading.Lock()
-_loaded: dict = {}
+_loaded: dict = {}     # (name, source digest) -> CDLL | None
+_errors: dict = {}     # name -> last failure diagnostic
+
+
+def last_error(name: str):
+    """Diagnostic from the most recent failed load() of `name`."""
+    return _errors.get(name)
 
 
 def _cache_dir():
@@ -29,15 +35,19 @@ def load(name: str, source_file: str, extra_flags=()):
     Returns ctypes.CDLL, or None when no toolchain / compile error
     (callers fall back to their Python implementation)."""
     with _lock:
-        if name in _loaded:
-            return _loaded[name]
-        src = os.path.join(os.path.dirname(__file__), source_file)
+        src = source_file if os.path.isabs(source_file) else \
+            os.path.join(os.path.dirname(__file__), source_file)
         try:
             with open(src, "rb") as f:
                 digest = hashlib.sha256(f.read()).hexdigest()[:16]
-        except OSError:
-            _loaded[name] = None
+        except OSError as e:
+            _errors[name] = f"cannot read {src}: {e}"
             return None
+        # memo keyed by content digest: fixing the source and re-calling
+        # load() in the same process retries instead of replaying a failure
+        memo = (name, digest)
+        if memo in _loaded:
+            return _loaded[memo]
         out = os.path.join(_cache_dir(), f"{name}-{digest}.so")
         if not os.path.exists(out):
             os.makedirs(_cache_dir(), exist_ok=True)
@@ -46,14 +56,17 @@ def load(name: str, source_file: str, extra_flags=()):
             try:
                 r = subprocess.run(cmd, capture_output=True, timeout=120)
                 if r.returncode != 0:
-                    _loaded[name] = None
+                    _errors[name] = r.stderr.decode(errors="replace")[-4000:]
+                    _loaded[memo] = None
                     return None
-                os.replace(out + ".tmp", out)
-            except (OSError, subprocess.TimeoutExpired):
-                _loaded[name] = None
+            except (OSError, subprocess.TimeoutExpired) as e:
+                _errors[name] = f"g++ unavailable or timed out: {e}"
+                _loaded[memo] = None
                 return None
+            os.replace(out + ".tmp", out)
         try:
-            _loaded[name] = ctypes.CDLL(out)
-        except OSError:
-            _loaded[name] = None
-        return _loaded[name]
+            _loaded[memo] = ctypes.CDLL(out)
+        except OSError as e:
+            _errors[name] = f"dlopen failed: {e}"
+            _loaded[memo] = None
+        return _loaded[memo]

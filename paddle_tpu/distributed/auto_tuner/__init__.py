@@ -1,0 +1,154 @@
+"""Distributed auto-tuner (reference: python/paddle/distributed/auto_tuner —
+tuner.py AutoTuner search_once/add_cfg, prune.py prune_by_mp/pp/mbs,
+recorder.py history, search.py grid search).
+
+TPU framing: candidates are hybrid-mesh layouts (dp, mp, pp, sharding, plus
+micro-batch size) factorizing the chip count; pruning encodes TPU realities
+(mp wants to stay inside a node's ICI domain, pp bounded by layer count,
+global batch divisibility). The runner measures a real candidate by jitting
+one train step on the mesh and timing it — the reference launches whole
+trial jobs; on TPU one-process GSPMD makes in-process trials possible."""
+from __future__ import annotations
+
+import csv
+import itertools
+import os
+import time
+
+__all__ = ["AutoTuner", "candidate_configs", "Recorder"]
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def candidate_configs(num_devices, num_layers=None, max_mp=8, max_pp=None,
+                      global_batch=None, micro_batches=(1, 2, 4, 8)):
+    """All (dp, mp, pp, sharding, mbs) with dp*mp*pp*sharding == devices,
+    pruned (reference prune.py rules, TPU-flavored)."""
+    out = []
+    for mp, pp, sharding in itertools.product(_divisors(num_devices),
+                                              repeat=3):
+        rest = num_devices // (mp * pp * sharding)
+        if mp * pp * sharding * rest != num_devices or rest < 1:
+            continue
+        dp = rest
+        if mp > max_mp:                      # prune_by_mp: ICI domain bound
+            continue
+        if max_pp is not None and pp > max_pp:
+            continue
+        if num_layers is not None and pp > 1 and num_layers % pp != 0:
+            continue                         # prune_by_pp: uneven stages
+        for mbs in micro_batches:
+            if global_batch is not None:
+                if global_batch % (dp * sharding) != 0:
+                    continue
+                local = global_batch // (dp * sharding)
+                if local % mbs != 0:         # prune_by_mbs
+                    continue
+            cfg = {"dp_degree": dp, "mp_degree": mp, "pp_degree": pp,
+                   "sharding_degree": sharding, "micro_batch_size": mbs}
+            if cfg not in out:
+                out.append(cfg)
+    # search order: less model-splitting first (reference sorts candidates)
+    out.sort(key=lambda c: (c["pp_degree"], c["mp_degree"],
+                            c["sharding_degree"], -c["micro_batch_size"]))
+    return out
+
+
+class Recorder:
+    """History of (config, metric) trials (reference: recorder.py)."""
+
+    def __init__(self):
+        self.history = []
+
+    def add_cfg(self, cfg, metric=None, error=None):
+        self.history.append({**cfg, "metric": metric, "error": error})
+
+    def sort_metric(self, direction="Maximize"):
+        ok = [h for h in self.history if h.get("metric") is not None]
+        ok.sort(key=lambda h: h["metric"], reverse=(direction == "Maximize"))
+        return ok
+
+    def store_history(self, path="./history.csv"):
+        if not self.history:
+            return path
+        keys = list(self.history[0])
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=keys)
+            w.writeheader()
+            w.writerows(self.history)
+        return path
+
+    def load_history(self, path="./history.csv"):
+        with open(path, newline="") as f:
+            for row in csv.DictReader(f):
+                self.history.append({
+                    k: (None if v == "" else
+                        float(v) if k == "metric" else
+                        int(v) if v.lstrip("-").isdigit() else v)
+                    for k, v in row.items()})
+
+
+class AutoTuner:
+    """reference: tuner.py:21 — iterate search_once()/add_cfg until
+    candidates are exhausted, then best_cfg."""
+
+    def __init__(self, tuner_cfg):
+        self.cfg = dict(tuner_cfg)
+        self.recorder = Recorder()
+        self._candidates = candidate_configs(
+            num_devices=self.cfg.get("num_devices") or
+            self.cfg.get("num_gpus", 1),
+            num_layers=self.cfg.get("num_layers"),
+            max_mp=self.cfg.get("max_mp_degree", 8),
+            max_pp=self.cfg.get("max_pp_degree"),
+            global_batch=self.cfg.get("global_batch_size"),
+            micro_batches=tuple(self.cfg.get("micro_batches", (1, 2, 4, 8))))
+        self._idx = 0
+        self.direction = self.cfg.get("direction", "Maximize")
+
+    @property
+    def search_space_size(self):
+        return len(self._candidates)
+
+    def search_once(self):
+        if self._idx >= len(self._candidates):
+            return None
+        cfg = self._candidates[self._idx]
+        self._idx += 1
+        return cfg
+
+    def add_cfg(self, cfg, metric=None, error=None):
+        self.recorder.add_cfg(cfg, metric=metric, error=error)
+
+    def best_cfg(self):
+        ranked = self.recorder.sort_metric(self.direction)
+        return ranked[0] if ranked else None
+
+    # -- in-process trial runner (TPU one-process GSPMD) ----------------------
+    def run_trials(self, make_step, warmup=1, iters=3, log=None):
+        """make_step(cfg) -> zero-arg callable running ONE train step on the
+        cfg's mesh (raises on invalid layouts). Times each candidate and
+        records steps/sec."""
+        while True:
+            cfg = self.search_once()
+            if cfg is None:
+                break
+            try:
+                step = make_step(cfg)
+                for _ in range(warmup):
+                    step()
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    step()
+                dt = (time.perf_counter() - t0) / iters
+                self.add_cfg(cfg, metric=1.0 / dt)
+                if log:
+                    log(f"trial {cfg}: {1.0 / dt:.2f} steps/s")
+            except Exception as e:          # OOM / invalid layout: record
+                self.add_cfg(cfg, error=str(e))
+                if log:
+                    log(f"trial {cfg}: failed ({e})")
+        return self.best_cfg()

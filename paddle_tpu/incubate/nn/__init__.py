@@ -1,4 +1,180 @@
-"""incubate.nn fused layers: on TPU, 'fused' == XLA-fused; these re-export the
-standard layers whose dispatch already fuses under jit (SURVEY §2.1 fused ops)."""
-from ...nn.layer.transformer import MultiHeadAttention as FusedMultiHeadAttention  # noqa: F401
-from ...nn.layer.transformer import TransformerEncoderLayer as FusedTransformerEncoderLayer  # noqa: F401
+"""incubate.nn fused layers (reference: python/paddle/incubate/nn —
+FusedMultiHeadAttention/layer.py, FusedFeedForward, FusedTransformerEncoderLayer,
+FusedLinear).
+
+TPU-native: each layer drives the fused functional ops (one dispatched body
+per block; attention rides the flash kernel) instead of aliasing the unfused
+layers."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...nn.layer.layers import Layer
+from ...nn.initializer import XavierUniform, Constant
+from . import functional as incubate_F
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer", "FusedLinear"]
+
+
+class FusedLinear(Layer):
+    """reference: incubate/nn/layer/fused_linear.py."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        shape = [out_features, in_features] if transpose_weight else \
+            [in_features, out_features]
+        self.weight = self.create_parameter(
+            shape, attr=weight_attr, default_initializer=XavierUniform())
+        self.bias = self.create_parameter(
+            [out_features], attr=bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self._transpose = transpose_weight
+
+    def forward(self, x):
+        return incubate_F.fused_linear(x, self.weight, self.bias,
+                                       self._transpose)
+
+
+class FusedMultiHeadAttention(Layer):
+    """reference: incubate/nn/layer/fused_transformer.py
+    FusedMultiHeadAttention:121 — packed [3, H, D, E] qkv weight."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        if embed_dim % num_heads:
+            raise ValueError(
+                f"num_heads ({num_heads}) must divide embed_dim "
+                f"({embed_dim})")
+        self.embed_dim, self.num_heads = embed_dim, num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self._epsilon = epsilon
+        H, D, E = num_heads, self.head_dim, embed_dim
+        self.qkv_weight = self.create_parameter(
+            [3, H, D, E], attr=qkv_weight_attr,
+            default_initializer=XavierUniform())
+        self.qkv_bias = self.create_parameter(
+            [3 * E], attr=qkv_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.linear_weight = self.create_parameter(
+            [E, E], attr=linear_weight_attr,
+            default_initializer=XavierUniform())
+        self.linear_bias = self.create_parameter(
+            [E], attr=linear_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.pre_ln_scale = self.create_parameter(
+            [E], attr=pre_ln_scale_attr, default_initializer=Constant(1.0))
+        self.pre_ln_bias = self.create_parameter(
+            [E], attr=pre_ln_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.ln_scale = self.create_parameter(
+            [E], attr=ln_scale_attr, default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            [E], attr=ln_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        # like the reference FusedMultiHeadAttention: self-attention only
+        # (raise rather than silently attending over query alone)
+        if key is not None and key is not query:
+            raise NotImplementedError(
+                "FusedMultiHeadAttention supports self-attention only "
+                "(reference contract); use nn.MultiHeadAttention for "
+                "cross-attention")
+        if cache is not None:
+            raise NotImplementedError(
+                "cache/generation: use the KV-cache decode path in "
+                "models (KVCache)")
+        return incubate_F.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            pre_ln_epsilon=self._epsilon, qkv_bias=self.qkv_bias,
+            linear_bias=self.linear_bias, attn_mask=attn_mask,
+            dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate,
+            ln_epsilon=self._epsilon, training=self.training)
+
+
+class FusedFeedForward(Layer):
+    """reference: fused_transformer.py FusedFeedForward:531."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self._act = activation
+        self._dropout = dropout_rate
+        self._act_dropout = dropout_rate if act_dropout_rate is None else \
+            act_dropout_rate
+        self._normalize_before = normalize_before
+        self._epsilon = epsilon
+        self.linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward], attr=linear1_weight_attr,
+            default_initializer=XavierUniform())
+        self.linear1_bias = self.create_parameter(
+            [dim_feedforward], attr=linear1_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model], attr=linear2_weight_attr,
+            default_initializer=XavierUniform())
+        self.linear2_bias = self.create_parameter(
+            [d_model], attr=linear2_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.ln1_scale = self.create_parameter(
+            [d_model], attr=ln1_scale_attr, default_initializer=Constant(1.0))
+        self.ln1_bias = self.create_parameter(
+            [d_model], attr=ln1_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.ln2_scale = self.create_parameter(
+            [d_model], attr=ln2_scale_attr, default_initializer=Constant(1.0))
+        self.ln2_bias = self.create_parameter(
+            [d_model], attr=ln2_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+
+    def forward(self, src, cache=None):
+        return incubate_F.fused_feedforward(
+            src, self.linear1_weight, self.linear2_weight, self.linear1_bias,
+            self.linear2_bias, self.ln1_scale, self.ln1_bias, self.ln2_scale,
+            self.ln2_bias, dropout1_rate=self._act_dropout,
+            dropout2_rate=self._dropout, activation=self._act,
+            ln1_epsilon=self._epsilon, ln2_epsilon=self._epsilon,
+            pre_layer_norm=self._normalize_before, training=self.training)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """reference: fused_transformer.py FusedTransformerEncoderLayer:864 —
+    fused attention block + fused FFN block."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False, name=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=dropout_rate if attn_dropout_rate is None
+            else attn_dropout_rate, normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))

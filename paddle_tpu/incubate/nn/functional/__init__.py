@@ -1,0 +1,144 @@
+"""incubate.nn.functional fused ops (reference: python/paddle/incubate/nn/
+functional — fused_multi_head_attention, fused_feedforward, fused_rms_norm,
+fused_rotary_position_embedding, swiglu, fused_linear, fused_dropout_add).
+
+TPU-native: 'fused' means ONE dispatched op whose body XLA/Pallas fuses —
+attention rides the flash kernel; the rest are single apply_op bodies so the
+whole epilogue chain compiles into one fusion instead of N kernel launches."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.dispatch import apply_op
+from ....nn import functional as F
+from ....nn.functional.activation import swiglu  # noqa: F401
+from ....nn.functional.norm import rms_norm as fused_rms_norm  # noqa: F401
+from ....nn.functional.rope import (  # noqa: F401
+    fused_rotary_position_embedding)
+
+__all__ = ["fused_multi_head_attention", "fused_feedforward",
+           "fused_rms_norm", "fused_rotary_position_embedding", "swiglu",
+           "fused_linear", "fused_dropout_add", "fused_bias_act"]
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """reference: incubate/nn/functional/fused_linear.py (matmul+bias in one
+    op; the MXU epilogue applies the bias)."""
+    def f(a, w, *b):
+        w2 = w.T if transpose_weight else w
+        y = a @ w2
+        return y + b[0] if b else y
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply_op("fused_linear", f, *args)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", name=None):
+    """reference: fused_bias_act_kernel — bias + activation one fusion."""
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+           "silu": jax.nn.silu, "swiglu": None}[act_method]
+
+    def f(a, *b):
+        h = a + b[0] if b else a
+        if act_method == "swiglu":
+            u, v = jnp.split(h, 2, axis=-1)
+            return jax.nn.silu(u) * v
+        return act(h)
+    args = (x,) + ((bias,) if bias is not None else ())
+    return apply_op("fused_bias_act", f, *args)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """reference: fused_dropout_add.py — dropout(x) + y in one op."""
+    if not training or p == 0.0:
+        return x + y
+    from ....core.rng import next_key
+    key = next_key()
+
+    def f(a, b):
+        keep = jax.random.bernoulli(key, 1.0 - p, jnp.shape(a))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0) + b
+        return jnp.where(keep, a, 0.0) + b
+    return apply_op("fused_dropout_add", f, x, y)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None,
+                               ln_scale=None, ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.0,
+                               attn_dropout_rate=0.0, ln_epsilon=1e-5,
+                               training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, num_heads=None,
+                               name=None):
+    """reference: incubate/nn/functional/fused_transformer.py
+    fused_multi_head_attention:345 — ln -> qkv -> attention -> proj ->
+    dropout -> residual (+ln). Attention runs the flash path."""
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, x.shape[-1:], pre_ln_scale, pre_ln_bias,
+                         pre_ln_epsilon)
+    B, S, E = x.shape
+    # qkv_weight [3, H, D, E] (reference layout) or [E, 3E]
+    qw = qkv_weight
+    if qw.ndim == 4:
+        H = qw.shape[1]
+        D = qw.shape[2]
+
+        def qkv_f(a, w, *b):
+            y = jnp.einsum("bse,thde->bsthd", a, w)
+            if b:
+                y = y + b[0].reshape(3, H, D)[None, None]
+            return y
+        args = (x, qw) + ((qkv_bias,) if qkv_bias is not None else ())
+        qkv = apply_op("fused_qkv", qkv_f, *args)
+        q, k, v = (qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+    else:
+        if num_heads is None:
+            raise ValueError(
+                "fused_multi_head_attention with a 2D qkv weight needs "
+                "num_heads= (cannot be inferred from [E, 3E])")
+        H = num_heads
+        D = E // H
+        y = fused_linear(x, qw, qkv_bias)
+        q, k, v = [t.reshape([B, S, H, D]) for t in y.chunk(3, axis=-1)]
+    out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                         dropout_p=attn_dropout_rate if
+                                         training else 0.0, is_causal=False)
+    out = out.reshape([B, S, H * D])
+    out = fused_linear(out, linear_weight, linear_bias)
+    if dropout_rate and training:
+        out = F.dropout(out, p=dropout_rate, mode=mode)
+    if add_residual:
+        out = out + residual
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1:], ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode="upscale_in_train",
+                      ring_id=-1, add_residual=True, name=None):
+    """reference: fused_transformer.py fused_feedforward:121 —
+    ln -> linear1 -> act -> dropout -> linear2 -> dropout -> residual."""
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, x.shape[-1:], ln1_scale, ln1_bias, ln1_epsilon)
+    h = fused_linear(x, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    if dropout1_rate and training:
+        h = F.dropout(h, p=dropout1_rate, mode=mode)
+    h = fused_linear(h, linear2_weight, linear2_bias)
+    if dropout2_rate and training:
+        h = F.dropout(h, p=dropout2_rate, mode=mode)
+    if add_residual:
+        h = h + residual
+    if not pre_layer_norm:
+        h = F.layer_norm(h, h.shape[-1:], ln2_scale, ln2_bias, ln2_epsilon)
+    return h

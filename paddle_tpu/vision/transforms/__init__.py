@@ -101,3 +101,255 @@ def normalize(img, mean, std, data_format="CHW", to_rgb=False):
 
 def resize(img, size, interpolation="bilinear"):
     return Resize(size, interpolation)(img)
+
+
+# ---- round-2 additions: the rest of the reference transform set -------------
+def _axes(arr):
+    """(h_axis, w_axis, chw?) for a 2D/3D image array."""
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+    return (1, 2, True) if chw else (0, 1, False)
+
+
+def hflip(img):
+    arr = np.asarray(img)
+    h, w, chw = _axes(arr)
+    return np.flip(arr, axis=w).copy()
+
+
+def vflip(img):
+    arr = np.asarray(img)
+    h, w, chw = _axes(arr)
+    return np.flip(arr, axis=h).copy()
+
+
+def crop(img, top, left, height, width):
+    arr = np.asarray(img)
+    h, w, chw = _axes(arr)
+    sl = [slice(None)] * arr.ndim
+    sl[h] = slice(top, top + height)
+    sl[w] = slice(left, left + width)
+    return arr[tuple(sl)]
+
+
+def center_crop(img, output_size):
+    size = output_size if isinstance(output_size, (list, tuple)) else \
+        (output_size, output_size)
+    arr = np.asarray(img)
+    h, w, chw = _axes(arr)
+    th, tw = size
+    top = max(0, (arr.shape[h] - th) // 2)
+    left = max(0, (arr.shape[w] - tw) // 2)
+    return crop(arr, top, left, th, tw)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = np.asarray(img)
+    if isinstance(padding, int):
+        pl = pr = pt = pb = padding
+    elif len(padding) == 2:         # (left/right, top/bottom)
+        pl = pr = padding[0]
+        pt = pb = padding[1]
+    else:
+        pl, pt, pr, pb = padding
+    h, w, chw = _axes(arr)
+    pads = [(0, 0)] * arr.ndim
+    pads[h] = (pt, pb)
+    pads[w] = (pl, pr)
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(arr, pads, mode=mode, **kw)
+
+
+def _value_range(img):
+    """Max representable value from DTYPE (not data): integers use their
+    type's range, floats are 0..1 by convention (PIL/reference)."""
+    dt = np.asarray(img).dtype
+    return float(np.iinfo(dt).max) if np.issubdtype(dt, np.integer) else 1.0
+
+
+def _restore_dtype(out, like):
+    dt = np.asarray(like).dtype
+    return out.astype(dt) if np.issubdtype(dt, np.integer) else out
+
+
+def adjust_brightness(img, brightness_factor):
+    hi = _value_range(img)
+    arr = np.asarray(img, np.float32)
+    return _restore_dtype(np.clip(arr * brightness_factor, 0, hi), img)
+
+
+def adjust_contrast(img, contrast_factor):
+    hi = _value_range(img)
+    arr = np.asarray(img, np.float32)
+    mean = arr.mean()
+    return _restore_dtype(
+        np.clip((arr - mean) * contrast_factor + mean, 0, hi), img)
+
+
+_GRAY_WGT = np.array([0.299, 0.587, 0.114], np.float32)
+
+
+def _luminance(arr, chw):
+    """Weighted gray over the channel axis; 1-chan passes through, RGBA uses
+    the RGB channels."""
+    c_ax = 0 if chw else -1
+    nc = arr.shape[c_ax]
+    if nc == 1:
+        return np.take(arr, 0, axis=c_ax)
+    rgb = np.take(arr, [0, 1, 2], axis=c_ax) if nc == 4 else arr
+    if chw:
+        return np.tensordot(_GRAY_WGT, rgb, axes=([0], [0]))
+    return rgb @ _GRAY_WGT
+
+
+def adjust_saturation(img, saturation_factor):
+    hi = _value_range(img)
+    arr = np.asarray(img, np.float32)
+    if arr.ndim == 2:
+        return _restore_dtype(arr, img)
+    h, w, chw = _axes(arr)
+    gray = np.expand_dims(_luminance(arr, chw), 0 if chw else -1)
+    return _restore_dtype(
+        np.clip(gray + (arr - gray) * saturation_factor, 0, hi), img)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = np.asarray(img, np.float32)
+    h, w, chw = _axes(arr)
+    g = arr if arr.ndim == 2 else _luminance(arr, chw)
+    g = _restore_dtype(g, img)
+    if num_output_channels == 1:
+        return g[None] if chw or arr.ndim == 2 else g[..., None]
+    rep = [g] * num_output_channels
+    return np.stack(rep, axis=0 if (chw or arr.ndim == 2) else -1)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    from scipy import ndimage
+    arr = np.asarray(img, np.float32)
+    h, w, chw = _axes(arr)
+    order = {"nearest": 0, "bilinear": 1}[interpolation]
+    return ndimage.rotate(arr, -angle, axes=(w, h), reshape=expand,
+                          order=order, cval=fill)
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = size
+
+    def __call__(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        return vflip(img) if np.random.rand() < self.prob else img
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.args = (padding, fill, padding_mode)
+
+    def __call__(self, img):
+        return pad(img, *self.args)
+
+
+class Grayscale:
+    def __init__(self, num_output_channels=1):
+        self.n = num_output_channels
+
+    def __call__(self, img):
+        return to_grayscale(img, self.n)
+
+
+class BrightnessTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        f = 1 + np.random.uniform(-self.value, self.value)
+        return adjust_brightness(img, f)
+
+
+class ContrastTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        f = 1 + np.random.uniform(-self.value, self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        f = 1 + np.random.uniform(-self.value, self.value)
+        return adjust_saturation(img, f)
+
+
+class ColorJitter:
+    """brightness/contrast/saturation jitter (reference transforms.ColorJitter;
+    hue omitted: needs HSV round-trip the reference does via PIL)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.ts = [BrightnessTransform(brightness),
+                   ContrastTransform(contrast),
+                   SaturationTransform(saturation)]
+
+    def __call__(self, img):
+        for t in np.random.permutation(self.ts):
+            img = t(img)
+        return img
+
+
+class RandomRotation:
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0):
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else tuple(degrees)
+        self.kw = dict(interpolation=interpolation, expand=expand,
+                       center=center, fill=fill)
+
+    def __call__(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, **self.kw)
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+        self.scale, self.ratio = scale, ratio
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h, w, chw = _axes(arr)
+        H, W = arr.shape[h], arr.shape[w]
+        area = H * W
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if cw <= W and ch <= H:
+                top = np.random.randint(0, H - ch + 1)
+                left = np.random.randint(0, W - cw + 1)
+                patch = crop(arr, top, left, ch, cw)
+                return Resize(self.size, self.interpolation)(patch)
+        return Resize(self.size, self.interpolation)(center_crop(
+            arr, (min(H, W), min(H, W))))

@@ -1,0 +1,171 @@
+"""incubate.nn fused layers + vision.transforms round-2 additions."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+rng = np.random.RandomState(0)
+
+
+class TestFusedLayers:
+    def test_fused_linear_matches_linear(self):
+        from paddle_tpu.incubate.nn import FusedLinear
+        paddle.seed(0)
+        fl = FusedLinear(8, 4)
+        x = paddle.to_tensor(rng.rand(2, 8).astype(np.float32))
+        want = x.numpy() @ fl.weight.numpy() + fl.bias.numpy()
+        np.testing.assert_allclose(fl(x).numpy(), want, rtol=1e-5)
+
+    def test_fused_mha_matches_unfused_math(self):
+        from paddle_tpu.incubate.nn import FusedMultiHeadAttention
+        paddle.seed(1)
+        E, H = 16, 4
+        mha = FusedMultiHeadAttention(E, H, dropout_rate=0.0,
+                                      attn_dropout_rate=0.0,
+                                      normalize_before=True)
+        mha.eval()
+        x = paddle.to_tensor(rng.rand(2, 6, E).astype(np.float32))
+        out = mha(x)
+        assert out.shape == [2, 6, E]
+        # manual recompute
+        import paddle_tpu.nn.functional as F
+        xn = F.layer_norm(x, [E], mha.pre_ln_scale, mha.pre_ln_bias, 1e-5)
+        qkv = np.einsum("bse,thde->bsthd", xn.numpy(), mha.qkv_weight.numpy())
+        qkv = qkv + mha.qkv_bias.numpy().reshape(3, H, E // H)[None, None]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        s = np.einsum("bshd,bthd->bhst", q, k) / np.sqrt(E // H)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        att = np.einsum("bhst,bthd->bshd", p, v).reshape(2, 6, E)
+        want = att @ mha.linear_weight.numpy() + mha.linear_bias.numpy() \
+            + x.numpy()
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-3, atol=1e-4)
+
+    def test_fused_ffn_trains(self):
+        from paddle_tpu.incubate.nn import FusedFeedForward
+        paddle.seed(2)
+        ffn = FusedFeedForward(8, 16, dropout_rate=0.0,
+                               normalize_before=False)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=ffn.parameters())
+        x = paddle.to_tensor(rng.rand(4, 5, 8).astype(np.float32))
+        losses = []
+        for _ in range(10):
+            loss = (ffn(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_fused_encoder_layer_shape(self):
+        from paddle_tpu.incubate.nn import FusedTransformerEncoderLayer
+        paddle.seed(3)
+        layer = FusedTransformerEncoderLayer(16, 4, 32, dropout_rate=0.0)
+        layer.eval()
+        x = paddle.to_tensor(rng.rand(2, 7, 16).astype(np.float32))
+        assert layer(x).shape == [2, 7, 16]
+
+    def test_mha_guards(self):
+        from paddle_tpu.incubate.nn import FusedMultiHeadAttention
+        from paddle_tpu.incubate.nn import functional as IF
+        with pytest.raises(ValueError, match="must divide embed_dim"):
+            FusedMultiHeadAttention(10, 4)
+        mha = FusedMultiHeadAttention(16, 4)
+        q = paddle.to_tensor(rng.rand(1, 3, 16).astype(np.float32))
+        k = paddle.to_tensor(rng.rand(1, 3, 16).astype(np.float32))
+        with pytest.raises(NotImplementedError, match="self-attention"):
+            mha(q, key=k)
+        # 2D qkv weight without num_heads must raise, not guess 8
+        with pytest.raises(ValueError, match="num_heads"):
+            IF.fused_multi_head_attention(
+                q, paddle.to_tensor(rng.rand(16, 48).astype(np.float32)),
+                paddle.to_tensor(rng.rand(16, 16).astype(np.float32)))
+
+    def test_functional_fused_ops(self):
+        from paddle_tpu.incubate.nn import functional as IF
+        x = paddle.to_tensor(rng.rand(3, 8).astype(np.float32),
+                             stop_gradient=False)
+        y = paddle.to_tensor(rng.rand(3, 8).astype(np.float32))
+        out = IF.fused_dropout_add(x, y, p=0.0)
+        np.testing.assert_allclose(out.numpy(), x.numpy() + y.numpy())
+        b = paddle.to_tensor(np.zeros(8, np.float32))
+        act = IF.fused_bias_act(x, b, act_method="relu")
+        np.testing.assert_allclose(act.numpy(), np.maximum(x.numpy(), 0))
+        # swiglu via fused_bias_act
+        g = IF.fused_bias_act(x, None, act_method="swiglu")
+        assert g.shape == [3, 4]
+
+
+class TestTransforms:
+    def _img(self):
+        return (rng.rand(3, 12, 10) * 255).astype(np.float32)
+
+    def test_center_crop_and_pad(self):
+        import paddle_tpu.vision.transforms as T
+        img = self._img()
+        c = T.CenterCrop(8)(img)
+        assert c.shape == (3, 8, 8)
+        p = T.Pad(2)(img)
+        assert p.shape == (3, 16, 14)
+        np.testing.assert_allclose(p[:, 2:-2, 2:-2], img)
+
+    def test_flips(self):
+        import paddle_tpu.vision.transforms as T
+        img = self._img()
+        np.testing.assert_allclose(T.hflip(img), img[:, :, ::-1])
+        np.testing.assert_allclose(T.vflip(img), img[:, ::-1, :])
+        assert T.RandomVerticalFlip(prob=1.0)(img).shape == img.shape
+
+    def test_grayscale_and_color(self):
+        import paddle_tpu.vision.transforms as T
+        img = self._img()
+        g = T.Grayscale()(img)
+        assert g.shape == (1, 12, 10)
+        g3 = T.Grayscale(3)(img)
+        assert g3.shape == (3, 12, 10)
+        # float images use the 0..1 convention; uint8 use 0..255 (by DTYPE)
+        f01 = img / 255.0
+        np.testing.assert_allclose(T.adjust_brightness(f01, 0.5), f01 * 0.5)
+        u8 = img.astype(np.uint8)
+        b = T.adjust_brightness(u8, 1.5)
+        assert b.dtype == np.uint8 and int(b.max()) > int(u8.max())
+        # dark uint8 image is NOT clipped at 1 (regression: dtype not data)
+        dark = np.ones((3, 4, 4), np.uint8)
+        np.testing.assert_allclose(T.adjust_brightness(dark, 50.0),
+                                   np.full((3, 4, 4), 50, np.uint8))
+        c = T.adjust_contrast(f01, 1.5)
+        assert c.shape == img.shape and np.isfinite(c).all()
+        # saturation-0 equals weighted luminance (consistent w/ to_grayscale)
+        sat0 = T.adjust_saturation(f01, 0.0)
+        np.testing.assert_allclose(sat0, np.broadcast_to(
+            T.Grayscale()(f01), sat0.shape), atol=1e-6)
+        # 1- and 4-channel grayscale don't crash
+        assert T.to_grayscale(np.zeros((1, 8, 8), np.float32)).shape == \
+            (1, 8, 8)
+        assert T.to_grayscale(np.zeros((8, 8, 4), np.float32)).shape == \
+            (8, 8, 1)
+        j = T.ColorJitter(0.2, 0.2, 0.2)(f01)
+        assert j.shape == img.shape
+
+    def test_rotation(self):
+        import paddle_tpu.vision.transforms as T
+        img = self._img()
+        r = T.rotate(img, 90)
+        assert r.shape == img.shape
+        rr = T.RandomRotation(30)(img)
+        assert rr.shape == img.shape
+
+    def test_random_resized_crop(self):
+        import paddle_tpu.vision.transforms as T
+        out = T.RandomResizedCrop(8)(self._img())
+        assert out.shape == (3, 8, 8)
+
+    def test_compose_pipeline(self):
+        import paddle_tpu.vision.transforms as T
+        pipe = T.Compose([T.Resize(16), T.CenterCrop(12),
+                          T.RandomHorizontalFlip(0.5),
+                          T.Normalize(mean=127.5, std=127.5)])
+        out = pipe(self._img())
+        assert out.shape == (3, 12, 12)
+        assert abs(float(out.mean())) < 1.5
