@@ -14,8 +14,13 @@ def _rotate_half(x):
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                                     position_ids=None, use_neox_rotary_style=True,
-                                    time_major=False, rotary_emb_base=10000.0):
-    """Apply RoPE to q/k/v ([B, S, H, D]). Returns (q', k', v') like the reference."""
+                                    time_major=False, rotary_emb_base=10000.0,
+                                    max_position=None):
+    """Apply RoPE to q/k/v ([B, S, H, D]). Returns (q', k', v') like the
+    reference. `max_position` bounds the sin/cos table STATICALLY — required
+    when position_ids is traced (jit decode), where a data-dependent table
+    size is impossible and an undersized table would gather out-of-bounds
+    (jnp fill mode -> NaN)."""
     sin_a, cos_a = unwrap(sin), unwrap(cos)
     pos = unwrap(position_ids) if position_ids is not None else None
 
@@ -24,10 +29,18 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
             s, c = sin_a, cos_a
         else:
             if pos is not None:
-                try:                      # decode: table must reach max position
-                    seq_len = max(seq_len, int(pos.max()) + 1)
-                except Exception:         # tracer: caller guarantees coverage
-                    pass
+                if max_position is not None:
+                    seq_len = max(seq_len, int(max_position))
+                else:
+                    try:                  # decode: table must reach max pos
+                        seq_len = max(seq_len, int(pos.max()) + 1)
+                    except Exception:
+                        # traced position_ids: fall back to the seq-len table
+                        # (correct whenever positions < seq_len, i.e. every
+                        # training/eval forward); decode callers whose traced
+                        # positions exceed seq_len MUST pass max_position or
+                        # the gather goes out of bounds (NaN fill)
+                        pass
             inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
             t = jnp.arange(seq_len, dtype=jnp.float32)
             freqs = jnp.outer(t, inv)

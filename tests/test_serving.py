@@ -56,16 +56,20 @@ class TestPagedAttention:
 
 class TestKVCache:
     def test_update_and_prefix(self):
+        # fixed-shape contract (jit decode): update returns the FULL cache
+        # and a traced scalar offset tracks the valid prefix
         cache = KVCache(2, 16, 4, 8)
         k1 = paddle.to_tensor(np.ones((2, 3, 4, 8), np.float32))
         v1 = paddle.to_tensor(np.full((2, 3, 4, 8), 2.0, np.float32))
         kk, vv = cache.update(k1, v1)
-        assert cache.offset == 3 and kk.shape == [2, 3, 4, 8]
+        assert int(np.asarray(cache.offset._data)) == 3
+        assert kk.shape == [2, 16, 4, 8]
         k2 = paddle.to_tensor(np.full((2, 1, 4, 8), 5.0, np.float32))
         kk, vv = cache.update(k2, k2)
-        assert cache.offset == 4
+        assert int(np.asarray(cache.offset._data)) == 4
         np.testing.assert_allclose(kk.numpy()[:, :3], 1.0)
         np.testing.assert_allclose(kk.numpy()[:, 3], 5.0)
+        np.testing.assert_allclose(kk.numpy()[:, 4:], 0.0)  # untouched tail
 
 
 class TestGenerate:
@@ -83,6 +87,36 @@ class TestGenerate:
         a = self.model.generate(self.x, max_new_tokens=6, use_cache=True)
         b = self.model.generate(self.x, max_new_tokens=6, use_cache=False)
         np.testing.assert_array_equal(np.asarray(a._data), np.asarray(b._data))
+
+    def test_gen_state_reuse_and_eviction(self):
+        m = self.model
+        a1 = m.generate(self.x, max_new_tokens=4)
+        states = m._gen_states
+        assert len(states) == 1
+        key = next(iter(states))
+        entry = states[key]
+        assert entry["busy"] is False
+        # same geometry: reuse (same entry object), identical result
+        a2 = m.generate(self.x, max_new_tokens=4)
+        assert states[key] is entry
+        np.testing.assert_array_equal(np.asarray(a1._data),
+                                      np.asarray(a2._data))
+        # different batch: second entry
+        m.generate(self.x[:1], max_new_tokens=4)
+        assert len(m._gen_states) == 2
+
+    def test_generate_reentrant_uses_private_state(self):
+        m = self.model
+        m.generate(self.x, max_new_tokens=2)
+        entry = next(iter(m._gen_states.values()))
+        entry["busy"] = True   # simulate an in-flight generate
+        try:
+            out = m.generate(self.x, max_new_tokens=2)
+            assert out.shape == [2, 10]
+            # in-flight entry untouched, no overwrite
+            assert next(iter(m._gen_states.values())) is entry
+        finally:
+            entry["busy"] = False
 
     def test_top_p_and_top_k_decode(self):
         tp = self.model.generate(self.x, max_new_tokens=4, do_sample=True,
