@@ -68,6 +68,9 @@ def on_change(name: str, hook: Callable[[Any], None]):
 
 # Core flags (subset of common/flags.cc relevant on TPU)
 define_flag("check_nan_inf", False, "scan op outputs for nan/inf (debug dispatch path)")
+define_flag("use_autotune", False,
+            "time Pallas launch-config candidates and cache the best "
+            "(ops/autotune.py)")
 define_flag("check_nan_inf_level", 0, "0: error on nan/inf; >=1: log only")
 define_flag("low_precision_op_list", 0, "audit ops running in low precision")
 define_flag("use_stride_kernel", True, "allow view/stride shortcuts where possible")

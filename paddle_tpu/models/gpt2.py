@@ -24,7 +24,9 @@ class GPT2Config:
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
                  num_heads=12, intermediate_size=None, max_position_embeddings=1024,
                  hidden_dropout_prob=0.1, attention_dropout_prob=0.1,
-                 layer_norm_epsilon=1e-5, initializer_range=0.02):
+                 layer_norm_epsilon=1e-5, initializer_range=0.02,
+                 use_recompute=False):
+        self.use_recompute = use_recompute
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -118,8 +120,11 @@ class GPT2Model(Layer):
             position_ids = ops.arange(s, dtype="int64").unsqueeze(0)
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
+        remat = self.config.use_recompute and self.training
+        if remat:
+            from ..distributed.fleet.recompute import recompute
         for block in self.blocks:
-            x = block(x)
+            x = recompute(block, x) if remat else block(x)
         return self.ln_f(x)
 
 

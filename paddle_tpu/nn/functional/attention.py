@@ -58,6 +58,11 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     if dropout_p > 0.0 and training:
         from ...core.rng import next_key
         rng_key = next_key()
+    qa, ka, va = unwrap(query), unwrap(key), unwrap(value)
+    if m is None and rng_key is None and _use_pallas(qa, ka):
+        from ...ops.pallas.flash_attention import warm_autotune
+        warm_autotune(qa, ka, va, causal=is_causal)
+
     def f(q, k, v):
         if m is None and rng_key is None and _use_pallas(q, k):
             from ...ops.pallas.flash_attention import flash_attention_bshd
@@ -76,6 +81,11 @@ def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax
     if dropout > 0.0 and training:
         from ...core.rng import next_key
         rng_key = next_key()
+    qa, ka, va = unwrap(query), unwrap(key), unwrap(value)
+    if rng_key is None and _use_pallas(qa, ka):
+        from ...ops.pallas.flash_attention import warm_autotune
+        warm_autotune(qa, ka, va, causal=causal)
+
     def f(q, k, v):
         if rng_key is None and _use_pallas(q, k):
             from ...ops.pallas.flash_attention import flash_attention_bshd

@@ -20,8 +20,10 @@ import jax
 
 from ..core import flags
 
-flags.define_flag("use_autotune", False,
-                  "time Pallas launch-config candidates and cache the best")
+if "use_autotune" not in flags._registry:   # normally defined in core/flags
+    flags.define_flag("use_autotune", False,
+                      "time Pallas launch-config candidates and cache the "
+                      "best")
 
 _lock = threading.Lock()
 _cache: dict[str, dict] = {}

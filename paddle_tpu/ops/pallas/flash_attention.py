@@ -345,6 +345,27 @@ def _pick_blocks(q3, k3, v3, causal):
     return tuple(at.tune(key, cands, build, (q3[:1], k3[:1], v3[:1])))
 
 
+def warm_autotune(q, k, v, causal=True):
+    """Tune blocks for this [B, S, H, D] geometry from CONCRETE arrays.
+
+    Dispatch wrappers call this before entering apply_op: inside apply_op the
+    kernel only ever sees jax.vjp tracers, where tuning is impossible — but
+    the cache lookup in _pick_blocks keys on static shapes, so one concrete
+    warm call makes every traced call use the tuned blocks."""
+    from .. import autotune as at
+    if not at.enabled() or isinstance(q, jax.core.Tracer):
+        return
+    B, Sq, H, D = q.shape
+    Hk = k.shape[2]
+    try:
+        q3 = jnp.moveaxis(q, 2, 1).reshape(B * H, Sq, D)
+        k3 = jnp.moveaxis(k, 2, 1).reshape(B * Hk, k.shape[1], D)
+        v3 = jnp.moveaxis(v, 2, 1).reshape(B * Hk, v.shape[1], D)
+        _pick_blocks(q3, k3, v3, causal)
+    except Exception:   # tuning is best-effort, never fails the op
+        pass
+
+
 def flash_attention_bshd(q, k, v, causal=True, scale=None):
     """[B, S, H, D] flash attention. GQA indexes kv-head = q-head // group in
     the kernel's BlockSpecs — K/V are never repeated in HBM (at Llama-3-8B's
