@@ -127,16 +127,37 @@ class RowParallelLinear(Layer):
 
 
 class ParallelCrossEntropy(Layer):
-    """reference mp_layers.py:744 (c_softmax_with_cross_entropy over the vocab
-    shard). GSPMD handles the sharded softmax reduction from the plain op."""
+    """reference mp_layers.py:744 (c_softmax_with_cross_entropy over the
+    vocab shard).
+
+    The logits stay VOCAB-SHARDED end to end: the stable log-sum-exp's max
+    and sum reductions over the sharded axis lower to psums on ICI, and the
+    label term is a one-hot contraction (shard-local multiply + the same
+    reduction) rather than a gather — so no [B, S, V] replicated tensor is
+    ever materialized (the reference's c_softmax_with_cross_entropy does the
+    identical two-allreduce dance by hand)."""
 
     def __init__(self, mp_group=None, name=None, ignore_index=-100):
         super().__init__()
         self._ignore_index = ignore_index
 
     def forward(self, input, label):
-        return F.cross_entropy(input, label, reduction="none",
-                               ignore_index=self._ignore_index)
+        ignore = self._ignore_index
+        input = _constrain(
+            input, P(*([None] * (input.ndim - 1) + ["mp"])))
+
+        def f(x, y):
+            xf = x.astype(jnp.float32)
+            m = jnp.max(xf, axis=-1, keepdims=True)
+            lse = jnp.log(jnp.sum(jnp.exp(xf - m), axis=-1,
+                                  keepdims=True)) + m
+            oh = jax.nn.one_hot(y, x.shape[-1], dtype=xf.dtype)
+            picked = jnp.sum(xf * oh, axis=-1)
+            loss = lse[..., 0] - picked
+            if ignore is not None:
+                loss = jnp.where(y == ignore, 0.0, loss)
+            return loss
+        return apply_op("parallel_cross_entropy", f, input, label)
 
 
 class RNGStatesTracker:
