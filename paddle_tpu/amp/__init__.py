@@ -47,6 +47,21 @@ def amp_state():
     return _state.amp_state
 
 
+# FLAGS_low_precision_op_list audit (reference: common/flags.cc:55 +
+# paddle.amp.debugging collect_operator_stats): {op_name: low-precision runs}
+_low_precision_ops: dict = {}
+
+
+def low_precision_op_list():
+    """Ops that ran with inputs cast to the low dtype while the
+    FLAGS_low_precision_op_list flag was non-zero."""
+    return dict(_low_precision_ops)
+
+
+def clear_low_precision_op_list():
+    _low_precision_ops.clear()
+
+
 def maybe_cast_inputs(op_name, arrays):
     """Called by dispatch: cast float arrays per autocast policy."""
     st = _state.amp_state
@@ -64,6 +79,11 @@ def maybe_cast_inputs(op_name, arrays):
             target = None  # follow inputs
     if target is None:
         return arrays
+    if target == low:
+        from ..core import flags as _flags
+        if _flags.flag("low_precision_op_list"):
+            _low_precision_ops[op_name] = _low_precision_ops.get(op_name,
+                                                                 0) + 1
     out = []
     for a in arrays:
         if hasattr(a, "dtype") and dtypes.is_floating_point(a.dtype) \

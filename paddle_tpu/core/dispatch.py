@@ -92,7 +92,11 @@ def apply_op(name: str, fn: Callable, *inputs, out_treedef_hint=None):
 
     if needs_grad:
         from ..autograd.node import GradNode
-        outs, vjp_fn = jax.vjp(fn, *arrays)
+        try:
+            outs, vjp_fn = jax.vjp(fn, *arrays)
+        except Exception as e:   # op-attributed errors (ref error summary)
+            e.add_note(_op_error_note(name, arrays))
+            raise
         single = not isinstance(outs, (tuple, list))
         outs_t = (outs,) if single else tuple(outs)
         node = GradNode(name, vjp_fn, inputs, outs_t, raw_fn=fn,
@@ -113,13 +117,27 @@ def apply_op(name: str, fn: Callable, *inputs, out_treedef_hint=None):
                 t._replay_node = (node, i)
         return wrapped[0] if single else tuple(wrapped)
     else:
-        outs = fn(*arrays)
+        try:
+            outs = fn(*arrays)
+        except Exception as e:
+            e.add_note(_op_error_note(name, arrays))
+            raise
         single = not isinstance(outs, (tuple, list))
         wrapped = [_wrap_out(o, True)
                    for o in ((outs,) if single else outs)]
         if _state.static_record:
             _attach_replay(name, fn, inputs, arrays, wrapped)
         return wrapped[0] if single else tuple(wrapped)
+
+
+def _op_error_note(name, arrays):
+    """One-line op attribution appended to dispatch failures (analog of the
+    reference's error summary with op name + input metas)."""
+    metas = ", ".join(
+        f"{getattr(a, 'shape', ())}:{getattr(a, 'dtype', type(a).__name__)}"
+        for a in arrays[:6])
+    more = "..." if len(arrays) > 6 else ""
+    return f"[paddle_tpu] raised while dispatching op '{name}' ({metas}{more})"
 
 
 def _attach_replay(name, fn, inputs, arrays, wrapped):

@@ -73,26 +73,34 @@ class Model:
         loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
             train_data, batch_size=batch_size, shuffle=shuffle, drop_last=drop_last,
             num_workers=num_workers)
+        from .callbacks import CallbackList
+        cbks = CallbackList(callbacks, model=self,
+                            params={"epochs": epochs, "verbose": verbose})
         history = []
         it = 0
+        cbks.on_train_begin()
         for epoch in range(epochs):
             for m in self._metrics:
                 m.reset()
+            cbks.on_epoch_begin(epoch)
             epoch_losses = []
             t0 = time.time()
             for step, batch in enumerate(loader):
                 data, label = (batch[0], batch[1]) if isinstance(batch, (list, tuple)) \
                     and len(batch) >= 2 else (batch, None)
+                cbks.on_train_batch_begin(step)
                 out = self.train_batch(data, label)
                 loss = out[0] if isinstance(out, tuple) else out
                 epoch_losses.append(loss[0])
                 it += 1
+                cbks.on_train_batch_end(step, {"loss": float(loss[0])})
                 if verbose and step % log_freq == 0:
                     print(f"Epoch {epoch + 1}/{epochs} step {step} "
                           f"loss {loss[0]:.4f}")
                 if num_iters is not None and it >= num_iters:
                     break
             history.append(float(np.mean(epoch_losses)))
+            cbks.on_epoch_end(epoch, {"loss": history[-1]})
             if verbose:
                 print(f"Epoch {epoch + 1}: mean loss {history[-1]:.4f} "
                       f"({time.time() - t0:.1f}s)")
@@ -102,6 +110,10 @@ class Model:
                 self.save(os.path.join(save_dir, f"epoch_{epoch}"))
             if num_iters is not None and it >= num_iters:
                 break
+            if cbks.stop_training:
+                self.stop_training = True
+                break
+        cbks.on_train_end()
         return history
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,

@@ -183,6 +183,7 @@ _DELEGATED = [
     "sinc", "signbit", "gammaln", "gammainc", "gammaincc", "multigammaln",
     "polygamma", "diff", "sgn", "frexp", "trapezoid", "cumulative_trapezoid",
     "vander", "renorm", "isin", "histogram_bin_edges", "reduce_as",
+    "vecdot", "combinations", "pdist",
     # manip_extra
     "reverse", "less", "bitwise_invert", "tensor_split", "hsplit", "vsplit",
     "dsplit", "unstack", "take", "unflatten", "as_strided", "view_as",

@@ -156,3 +156,46 @@ def reduce_as(x, target, name=None):
 def block_diag(inputs, name=None):
     return apply_op("block_diag",
                     lambda *arrs: jax.scipy.linalg.block_diag(*arrs), *inputs)
+
+
+def vecdot(x, y, axis=-1, name=None):
+    """reference: paddle.linalg.vecdot (ops.yaml vecdot)."""
+    def f(a, b):
+        return jnp.sum(a * b, axis=axis)
+    return apply_op("vecdot", f, x, y)
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """reference: paddle.combinations (itertools semantics over a 1-D
+    tensor). Index set is static (host-side), the gather is device-side."""
+    import itertools
+    n = x.shape[0]
+    idx = list(itertools.combinations_with_replacement(range(n), r)
+               if with_replacement else itertools.combinations(range(n), r))
+    if not idx:
+        import numpy as _np
+        return Tensor(jnp.zeros((0, r), unwrap(x).dtype))
+    ix = jnp.asarray(idx)
+
+    def f(a):
+        return a[ix]
+    return apply_op("combinations", f, x)
+
+
+def pdist(x, p=2.0, name=None):
+    """reference: paddle.pdist — condensed pairwise distances of [N, D]."""
+    n = x.shape[0]
+    iu = jnp.triu_indices(n, k=1)
+
+    def f(a):
+        # gather the i<j pairs FIRST: the full [n, n] matrix has sqrt(0) on
+        # the diagonal whose vjp is inf -> 0*inf = NaN even though discarded
+        d = jnp.abs(a[iu[0]] - a[iu[1]])       # [npairs, D]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(d * d, -1))
+        if p == 0:
+            return jnp.sum(d != 0, -1).astype(a.dtype)
+        if p == float("inf"):
+            return jnp.max(d, -1)
+        return jnp.sum(d ** p, -1) ** (1.0 / p)
+    return apply_op("pdist", f, x)

@@ -282,6 +282,15 @@ g("reduce_as", None, lambda: [U(3, 4)], "math", kind="smoke",
   kwargs={"target": np.zeros((4,), np.float32)})
 g("frexp", lambda x: (np.frexp(x)[0], np.frexp(x)[1].astype(np.float32)),
   lambda: [POS(3, 4)], "math")
+g("vecdot", lambda x, y: np.sum(x * y, -1),
+  lambda: [U(3, 4), U(3, 4, seed=1)], "math", grad=True)
+g("combinations",
+  lambda x: np.array(list(__import__("itertools").combinations(x, 2))),
+  lambda: [U(5)], "math")
+g("pdist",
+  lambda x: __import__("scipy.spatial.distance",
+                       fromlist=["pdist"]).pdist(x),
+  lambda: [U(5, 3)], "math", grad=True)
 g("block_diag", None, lambda: [[U(2, 2), U(3, 3, seed=1)]], "math",
   kind="smoke")
 

@@ -183,3 +183,61 @@ class TestProfilerRoundtrip:
         assert "my_region" in summ
         assert summ["my_region"]["calls"] >= 1
         assert any(e["cat"] == "step" for e in res.events)
+
+
+class TestHapiCallbacks:
+    def test_callbacks_fire_and_early_stop(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.hapi.callbacks import (Callback, EarlyStopping,
+                                               ModelCheckpoint)
+        from paddle_tpu.io import TensorDataset
+
+        seen = []
+
+        class Spy(Callback):
+            def on_epoch_begin(self, epoch, logs=None):
+                seen.append(("begin", epoch))
+
+            def on_train_batch_end(self, step, logs=None):
+                seen.append(("batch", step, logs["loss"]))
+
+            def on_epoch_end(self, epoch, logs=None):
+                seen.append(("end", epoch, logs["loss"]))
+
+        paddle.seed(0)
+        x = rng.rand(8, 4).astype(np.float32)
+        yv = rng.rand(8, 1).astype(np.float32)
+        ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(yv)])
+        m = paddle.Model(nn.Linear(4, 1))
+        m.prepare(optimizer=paddle.optimizer.SGD(
+            learning_rate=1e9, parameters=m.parameters()),   # diverges
+            loss=nn.MSELoss())
+        stopper = EarlyStopping(monitor="loss", mode="min", patience=0,
+                                verbose=0)
+        m.fit(ds, batch_size=4, epochs=10, verbose=0,
+              callbacks=[Spy(), stopper,
+                         ModelCheckpoint(save_dir=str(tmp_path))])
+        assert any(e[0] == "begin" for e in seen)
+        assert any(e[0] == "batch" for e in seen)
+        epochs_run = max(e[1] for e in seen if e[0] == "end") + 1
+        assert epochs_run < 10            # early stopping fired
+        assert (tmp_path / "0.pdparams").exists()
+
+
+class TestLowPrecisionAudit:
+    def test_audit_records_low_precision_ops(self):
+        import paddle_tpu.amp as amp
+        import paddle_tpu.nn as nn
+        paddle.set_flags({"FLAGS_low_precision_op_list": 1})
+        amp.clear_low_precision_op_list()
+        try:
+            lin = nn.Linear(4, 4)
+            x = paddle.to_tensor(rng.rand(2, 4).astype(np.float32))
+            with amp.auto_cast(level="O1"):
+                lin(x)
+            ops_seen = amp.low_precision_op_list()
+            assert any("linear" in k or "matmul" in k for k in ops_seen), \
+                ops_seen
+        finally:
+            paddle.set_flags({"FLAGS_low_precision_op_list": 0})
+            amp.clear_low_precision_op_list()
