@@ -1,0 +1,70 @@
+"""Device-mesh construction helpers (SURVEY §5 comm-backend note: the TPU
+control plane needs DCN-aware mesh construction for multi-slice jobs —
+reference equivalent: fleet topology ordering ranks so NCCL rings stay
+intra-node, topology.py:199).
+
+`create_mesh` builds a jax Mesh whose FAST axes ride ICI (within a slice)
+and whose slow axes span DCN (across slices/hosts), using
+jax.experimental.mesh_utils so device order respects the physical torus."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+__all__ = ["create_mesh", "create_hybrid_mesh"]
+
+
+def create_mesh(axis_shapes, axis_names=None, devices=None):
+    """Single-slice mesh: axis_shapes like {'dp': 2, 'mp': 4} or a tuple.
+    Uses mesh_utils.create_device_mesh so the axis order maps onto the ICI
+    torus instead of raw device enumeration."""
+    if isinstance(axis_shapes, dict):
+        names = list(axis_shapes)
+        shape = [axis_shapes[n] for n in names]
+    else:
+        shape = list(axis_shapes)
+        names = list(axis_names or [f"d{i}" for i in range(len(shape))])
+    devices = list(devices if devices is not None else jax.devices())
+    n = int(np.prod(shape))
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    try:
+        from jax.experimental import mesh_utils
+        dev_array = mesh_utils.create_device_mesh(tuple(shape),
+                                                  devices=devices[:n])
+    except Exception:   # non-TPU backends: plain reshape is fine
+        dev_array = np.array(devices[:n]).reshape(shape)
+    return jax.sharding.Mesh(dev_array, tuple(names))
+
+
+def create_hybrid_mesh(dcn_axis_shapes, ici_axis_shapes, axis_names=None,
+                       devices=None):
+    """Multi-slice mesh: leading axes span DCN (one entry per slice), the
+    rest ride ICI inside each slice. Put dp/pp on the DCN axes and mp/sep on
+    ICI — collectives on the fast axes then never cross the data-center
+    network (the scaling-book mesh recipe; reference ranks order dp slowest
+    for the same reason)."""
+    dcn = list(dcn_axis_shapes.values()) if isinstance(dcn_axis_shapes, dict) \
+        else list(dcn_axis_shapes)
+    ici = list(ici_axis_shapes.values()) if isinstance(ici_axis_shapes, dict) \
+        else list(ici_axis_shapes)
+    if axis_names is None:
+        dn = list(dcn_axis_shapes) if isinstance(dcn_axis_shapes, dict) else \
+            [f"dcn{i}" for i in range(len(dcn))]
+        im = list(ici_axis_shapes) if isinstance(ici_axis_shapes, dict) else \
+            [f"ici{i}" for i in range(len(ici))]
+        axis_names = dn + im
+    devices = list(devices if devices is not None else jax.devices())
+    try:
+        from jax.experimental import mesh_utils
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            tuple(ici), tuple(dcn), devices=devices,
+            allow_split_physical_axes=True)
+        # hybrid helper returns [dcn..., ici...]-shaped array
+        dev_array = dev_array.reshape(tuple(dcn) + tuple(ici))
+    except Exception:
+        n = int(np.prod(dcn + ici))
+        if n > len(devices):
+            raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+        dev_array = np.array(devices[:n]).reshape(tuple(dcn) + tuple(ici))
+    return jax.sharding.Mesh(dev_array, tuple(axis_names))

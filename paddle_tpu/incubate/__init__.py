@@ -1,3 +1,3 @@
-"""paddle.incubate (reference: python/paddle/incubate) — fused layers + MoE.
-Fused transformer/MoE surfaces land with the parallel layer library."""
+"""paddle.incubate (reference: python/paddle/incubate) — fused layers + MoE."""
 from . import nn  # noqa: F401
+from . import distributed  # noqa: F401

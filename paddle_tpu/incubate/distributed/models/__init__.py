@@ -1,0 +1,2 @@
+"""paddle.incubate.distributed.models (reference namespace)."""
+from . import moe  # noqa: F401

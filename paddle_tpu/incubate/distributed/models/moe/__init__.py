@@ -1,0 +1,6 @@
+"""paddle.incubate.distributed.models.moe (reference:
+python/paddle/incubate/distributed/models/moe/moe_layer.py) — the in-core
+TPU MoE (GShard dispatch over the ep mesh axis) IS this API."""
+from .....parallel.moe import MoELayer, ExpertMLP, top2_gating  # noqa: F401
+
+__all__ = ["MoELayer", "ExpertMLP", "top2_gating"]
