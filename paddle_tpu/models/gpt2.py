@@ -25,8 +25,9 @@ class GPT2Config:
                  num_heads=12, intermediate_size=None, max_position_embeddings=1024,
                  hidden_dropout_prob=0.1, attention_dropout_prob=0.1,
                  layer_norm_epsilon=1e-5, initializer_range=0.02,
-                 use_recompute=False):
+                 use_recompute=False, loss_chunk_size=0):
         self.use_recompute = use_recompute
+        self.loss_chunk_size = loss_chunk_size
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -128,6 +129,54 @@ class GPT2Model(Layer):
         return self.ln_f(x)
 
 
+def _chunked_lm_loss(hidden, wte, labels, chunk):
+    """Tied-head LM loss WITHOUT materializing [B*S, V] logits: lax.scan over
+    token chunks, each chunk jax.checkpoint'ed so the backward recomputes its
+    [chunk, V] logits instead of keeping them — peak memory drops from
+    O(B*S*V) to O(chunk*V), buying back batch on HBM-tight chips (same trick
+    as the reference's c_softmax_with_cross_entropy streaming)."""
+    from ..core.dispatch import apply_op
+    import jax
+    import jax.numpy as jnp
+
+    def f(h, w, y):
+        B, S, H = h.shape
+        flat_h = h.reshape(B * S, H)
+        flat_y = y.reshape(B * S)
+        n = flat_h.shape[0]
+        c = min(chunk, n)
+        pad = (-n) % c
+        if pad:
+            flat_h = jnp.pad(flat_h, ((0, pad), (0, 0)))
+            flat_y = jnp.pad(flat_y, (0, pad))
+        hs = flat_h.reshape(-1, c, H)
+        ys = flat_y.reshape(-1, c)
+
+        @jax.checkpoint
+        def one(hc, yc):
+            logits = (hc @ w.T).astype(jnp.float32)
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            picked = jnp.take_along_axis(
+                logits, yc[:, None].astype(jnp.int32), axis=1)[:, 0]
+            return lse - picked
+
+        def body(carry, xs):
+            hc, yc = xs
+            return carry + jnp.sum(one(hc, yc)), None
+
+        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hs, ys))
+        if pad:
+            # padded rows contribute lse(logits of zero-vector h) - logits[0];
+            # with h=0 logits are the zero vector + ... not zero in general
+            # (w.T has no bias): recompute their exact contribution and drop
+            zpad = one(jnp.zeros((pad, H), flat_h.dtype),
+                       jnp.zeros((pad,), flat_y.dtype))
+            total = total - jnp.sum(zpad)
+        return total / n
+
+    return apply_op("chunked_lm_loss", f, hidden, wte, labels)
+
+
 class GPT2ForCausalLM(Layer):
     """LM head ties wte weights (standard GPT-2)."""
 
@@ -138,6 +187,10 @@ class GPT2ForCausalLM(Layer):
 
     def forward(self, input_ids, labels=None, position_ids=None):
         hidden = self.gpt2(input_ids, position_ids)
+        if labels is not None and self.config.loss_chunk_size:
+            loss = _chunked_lm_loss(hidden, self.gpt2.wte.weight, labels,
+                                    self.config.loss_chunk_size)
+            return None, loss
         logits = ops.matmul(hidden, self.gpt2.wte.weight, transpose_y=True)
         if labels is not None:
             loss = F.cross_entropy(

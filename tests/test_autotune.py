@@ -174,3 +174,26 @@ class TestGPT2Recompute:
             return losses
 
         np.testing.assert_allclose(run(False), run(True), rtol=1e-5)
+
+
+class TestChunkedLMLoss:
+    def test_parity_with_dense_loss_and_grads(self):
+        from paddle_tpu.models.gpt2 import GPT2Config, GPT2ForCausalLM
+        ids = rng.randint(0, 256, (2, 33)).astype(np.int32)
+
+        def run(chunk):
+            paddle.seed(3)
+            cfg = GPT2Config.tiny(hidden_dropout_prob=0.0,
+                                  attention_dropout_prob=0.0,
+                                  loss_chunk_size=chunk)
+            m = GPT2ForCausalLM(cfg)
+            x = paddle.to_tensor(ids[:, :-1])
+            y = paddle.to_tensor(ids[:, 1:])
+            _, loss = m(x, labels=y)
+            loss.backward()
+            return float(loss), float((m.gpt2.wte.weight.grad ** 2).sum())
+
+        l0, g0 = run(0)
+        l1, g1 = run(17)   # non-dividing chunk exercises the padding path
+        np.testing.assert_allclose(l1, l0, rtol=1e-5)
+        np.testing.assert_allclose(g1, g0, rtol=1e-3)
