@@ -27,7 +27,8 @@ class LlamaConfig:
                  num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
                  max_position_embeddings=8192, rms_norm_eps=1e-5, rope_theta=500000.0,
                  tie_word_embeddings=False, initializer_range=0.02,
-                 num_experts=0, num_experts_per_tok=2, moe_intermediate_size=None):
+                 num_experts=0, num_experts_per_tok=2, moe_intermediate_size=None,
+                 sep_backend="ring"):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -40,6 +41,7 @@ class LlamaConfig:
         self.tie_word_embeddings = tie_word_embeddings
         self.initializer_range = initializer_range
         self.num_experts = num_experts
+        self.sep_backend = sep_backend
         self.num_experts_per_tok = num_experts_per_tok
         self.moe_intermediate_size = moe_intermediate_size
 
@@ -141,6 +143,7 @@ class LlamaAttention(Layer):
         self.num_kv_heads = config.num_key_value_heads
         self.head_dim = h // self.num_heads
         self.rope_theta = config.rope_theta
+        self.sep_backend = getattr(config, "sep_backend", "ring")
         init = Normal(std=config.initializer_range)
         self.q_proj = Linear(h, self.num_heads * self.head_dim, weight_attr=init,
                              bias_attr=False)
@@ -157,7 +160,6 @@ class LlamaAttention(Layer):
         k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
         v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
         if kv_cache is not None and position_ids is None:
-            from .. import ops
             # static arange + traced offset: shape stays [1, s] under jit
             pos = ops.arange(0, s, dtype="int64").reshape([1, s]) + \
                 kv_cache.offset.astype("int64")
@@ -172,14 +174,24 @@ class LlamaAttention(Layer):
             out = _cached_sdpa(q, kk, vv, q_offset)
             return self.o_proj(out.reshape([b, s, self.num_heads * self.head_dim]))
         from ..distributed.fleet.topology import get_hybrid_communicate_group
-        if get_hybrid_communicate_group().get_sep_parallel_world_size() > 1:
-            # context parallelism: sequence sharded on 'sep', ring attention
-            from ..parallel.ring_attention import ring_flash_attention
+        hcg_sep = get_hybrid_communicate_group().get_sep_parallel_world_size()
+        if hcg_sep > 1:
+            # context parallelism: sequence sharded on 'sep'; ring attention
+            # by default, Ulysses all-to-all when configured and head counts
+            # divide (S >> H regime where ring's per-hop latency dominates)
             rep = self.num_heads // self.num_kv_heads
             if rep > 1:
                 k = ops.repeat_interleave(k, rep, axis=2)
                 v = ops.repeat_interleave(v, rep, axis=2)
-            out = ring_flash_attention(q, k, v, causal=True, axis_name="sep")
+            if getattr(self, "sep_backend", "ring") == "ulysses" and \
+                    self.num_heads % hcg_sep == 0:
+                from ..parallel.ulysses import ulysses_attention
+                out = ulysses_attention(q, k, v, causal=True,
+                                        axis_name="sep")
+            else:
+                from ..parallel.ring_attention import ring_flash_attention
+                out = ring_flash_attention(q, k, v, causal=True,
+                                           axis_name="sep")
         else:
             out, _ = F.flash_attention(q, k, v, causal=True)
         return self.o_proj(out.reshape([b, s, self.num_heads * self.head_dim]))

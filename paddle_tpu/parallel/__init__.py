@@ -12,6 +12,7 @@ from .sequence_parallel import (ColumnSequenceParallelLinear,  # noqa: F401
                                 register_sequence_parallel_allreduce_hooks)
 from .moe import MoELayer, ExpertMLP, top2_gating  # noqa: F401
 from .ring_attention import ring_flash_attention  # noqa: F401
+from .ulysses import ulysses_attention  # noqa: F401
 from .pipeline import pipeline_forward, pipeline_call  # noqa: F401
 from .pipeline_layer import (PipelineLayer, LayerDesc, SharedLayerDesc,  # noqa: F401
                              PipelineParallel, PipelineParallelWithInterleave)

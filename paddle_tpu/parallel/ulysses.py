@@ -1,0 +1,84 @@
+"""Ulysses (DeepSpeed-style) all-to-all sequence parallelism (SURVEY §5
+long-context mechanism 2: the reference wires the 'sep' mesh axis through
+topology and leaves the attention-level CP algorithms — ring attention AND
+Ulysses all-to-all — to PaddleNLP; both are in-core here).
+
+TPU-native: ONE shard_map over 'sep' whose body does
+  all_to_all(seq-shard -> head-shard) -> full-sequence flash attention on
+  the local head group -> all_to_all back.
+The two all-to-alls ride ICI; between them every device sees the FULL
+sequence for H/sep heads, so the attention itself needs no communication —
+the right trade when S >> H and the ring's per-step latency would dominate.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ..core.dispatch import apply_op
+from ..distributed.fleet.topology import get_hybrid_communicate_group
+
+__all__ = ["ulysses_attention"]
+
+
+def _ulysses_local(q, k, v, axis_name, causal, scale):
+    """Per-shard body. q/k/v local: [B, S/n, H, D] -> out [B, S/n, H, D]."""
+    n = jax.lax.psum(1, axis_name)
+
+    def seq_to_heads(x):
+        # [B, s, H, D] -> [B, s*n, H/n, D]: tiled all_to_all splits the head
+        # axis into n chunks (chunk i -> rank i) and concatenates received
+        # seq chunks in rank order — global sequence order, rank-major heads
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def heads_to_seq(x):
+        # [B, S, H/n, D] -> [B, S/n, H, D]: exact inverse
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    # full-sequence attention on the local head group (flash-style math)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qg.astype(jnp.float32) * scale,
+                        kg.astype(jnp.float32))
+    if causal:
+        S = qg.shape[1]
+        mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, vg.astype(jnp.float32))
+    return heads_to_seq(out.astype(q.dtype))
+
+
+def ulysses_attention(q, k, v, causal=True, axis_name="sep", mesh=None):
+    """[B, S, H, D] with S sharded over `axis_name`; H must be divisible by
+    the axis size. Returns the same sharding."""
+    hcg = get_hybrid_communicate_group()
+    jmesh = mesh if mesh is not None else hcg.get_mesh().jax_mesh()
+    if axis_name not in jmesh.axis_names or \
+            jmesh.devices.shape[jmesh.axis_names.index(axis_name)] == 1:
+        from ..nn.functional.attention import _sdpa_ref
+        return apply_op("ulysses_attention",
+                        lambda a, b, c: _sdpa_ref(a, b, c, causal=causal),
+                        q, k, v)
+    n = jmesh.devices.shape[jmesh.axis_names.index(axis_name)]
+    if q.shape[2] % n != 0:
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[2]}) divisible by the "
+            f"'{axis_name}' axis size ({n})")
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    spec = P(None, axis_name, None, None)
+
+    def f(qa, ka, va):
+        body = functools.partial(_ulysses_local, axis_name=axis_name,
+                                 causal=causal, scale=scale)
+        sm = shard_map(body, mesh=jmesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_rep=False)
+        return sm(qa, ka, va)
+
+    return apply_op("ulysses_attention", f, q, k, v)
