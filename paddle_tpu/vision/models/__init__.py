@@ -127,3 +127,5 @@ def resnet50(pretrained=False, **kwargs):
 
 def resnet101(pretrained=False, **kwargs):
     return ResNet(BottleneckBlock, 101, **kwargs)
+from .extra import (VGG, vgg16, vgg19, MobileNetV2, mobilenet_v2,
+                    AlexNet, alexnet)  # noqa: F401,E402

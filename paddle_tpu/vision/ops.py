@@ -1,0 +1,176 @@
+"""paddle.vision.ops (reference: python/paddle/vision/ops.py — nms:1934,
+roi_align:1705, roi_pool:1610, box coders etc.).
+
+TPU-native: roi_align/roi_pool are dense gather+interpolate jnp math (jit
+fusable); nms's data-dependent loop runs as a lax.while_loop over a fixed
+[N] mask — static shapes, no host sync."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply_op, unwrap
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_area", "box_iou"]
+
+
+def box_area(boxes):
+    def f(b):
+        return (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return apply_op("box_area", f, boxes)
+
+
+def _iou_matrix(a, b):
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter,
+                               1e-10)
+
+
+def box_iou(boxes1, boxes2):
+    return apply_op("box_iou", _iou_matrix, boxes1, boxes2)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """reference: vision/ops.py nms:1934 — returns kept indices sorted by
+    score. Greedy suppression as a lax.while_loop over a static [N] mask."""
+    b = unwrap(boxes)
+    n = b.shape[0]
+    s = unwrap(scores) if scores is not None else jnp.arange(
+        n, 0, -1, dtype=jnp.float32)
+
+    def f(bx, sc, *cat):
+        iou = _iou_matrix(bx, bx)
+        if cat:  # category-aware: only same-category boxes suppress
+            same = cat[0][:, None] == cat[0][None, :]
+            iou = jnp.where(same, iou, 0.0)
+        order = jnp.argsort(-sc)
+
+        def body(state):
+            i, alive, keep = state
+            idx = order[i]
+            is_alive = alive[idx]
+            keep = keep.at[idx].set(is_alive)
+            sup = (iou[idx] > iou_threshold) & is_alive
+            alive = alive & ~sup
+            alive = alive.at[idx].set(False)
+            return i + 1, alive, keep
+
+        def cond(state):
+            return state[0] < n
+
+        _, _, keep = jax.lax.while_loop(
+            cond, body, (0, jnp.ones((n,), bool), jnp.zeros((n,), bool)))
+        kept_sorted = order[keep[order]]
+        return kept_sorted
+
+    args = (Tensor(b), Tensor(s))
+    if category_idxs is not None:
+        args += (Tensor(jnp.asarray(unwrap(category_idxs))),)
+    out = apply_op("nms", f, *args)
+    if top_k is not None:
+        out = out[:top_k]
+    return out
+
+
+def _bilinear(feat, y, x):
+    """feat [C, H, W]; y/x [...] float coords -> [C, ...]."""
+    H, W = feat.shape[-2:]
+    y0 = jnp.clip(jnp.floor(y), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(x), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    ly, lx = y - y0, x - x0
+    y0i, y1i, x0i, x1i = (v.astype(jnp.int32) for v in (y0, y1, x0, x1))
+    v00 = feat[:, y0i, x0i]
+    v01 = feat[:, y0i, x1i]
+    v10 = feat[:, y1i, x0i]
+    v11 = feat[:, y1i, x1i]
+    return (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx
+            + v10 * ly * (1 - lx) + v11 * ly * lx)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """reference: vision/ops.py roi_align:1705. x [N, C, H, W]; boxes
+    [R, 4] in (x1, y1, x2, y2); boxes_num [N] rois per image."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    ratio = 2 if sampling_ratio <= 0 else sampling_ratio
+
+    def f(xa, ba, bn):
+        # roi r belongs to the image whose cumulative count first exceeds r
+        img_of = jnp.searchsorted(jnp.cumsum(bn),
+                                  jnp.arange(ba.shape[0]), side="right")
+        off = 0.5 if aligned else 0.0
+        sb = ba * spatial_scale - off
+
+        def one(roi, img):
+            x1, y1, x2, y2 = roi
+            rw = jnp.maximum(x2 - x1, 1e-3)
+            rh = jnp.maximum(y2 - y1, 1e-3)
+            bin_h, bin_w = rh / ph, rw / pw
+            gy = (jnp.arange(ph)[:, None] * bin_h + y1 +
+                  (jnp.arange(ratio)[None, :] + 0.5) * bin_h / ratio)
+            gx = (jnp.arange(pw)[:, None] * bin_w + x1 +
+                  (jnp.arange(ratio)[None, :] + 0.5) * bin_w / ratio)
+            yy = gy.reshape(-1)                       # [ph*ratio]
+            xx = gx.reshape(-1)                       # [pw*ratio]
+            feat = xa[img]
+            vals = _bilinear(feat, yy[:, None], xx[None, :])  # [C,phr,pwr]
+            C = feat.shape[0]
+            vals = vals.reshape(C, ph, ratio, pw, ratio)
+            return vals.mean(axis=(2, 4))
+
+        return jax.vmap(one)(sb, img_of)
+
+    return apply_op("roi_align", f, x, boxes,
+                    Tensor(jnp.asarray(unwrap(boxes_num)).astype(jnp.int32)))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """reference: vision/ops.py roi_pool:1610 — max pooling per bin."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def f(xa, ba, bn):
+        H, W = xa.shape[-2:]
+        img_of = jnp.searchsorted(jnp.cumsum(bn), jnp.arange(ba.shape[0]),
+                                  side="right")
+        sb = jnp.round(ba * spatial_scale)
+
+        def one(roi, img):
+            # exact integer-cell membership per bin (matches the quantized
+            # reference kernel): cell (h, w) belongs to bin
+            # (floor((h-y1)/bin_h), floor((w-x1)/bin_w)) when inside the roi
+            x1, y1, x2, y2 = roi
+            rw = jnp.maximum(x2 - x1 + 1, 1.0)
+            rh = jnp.maximum(y2 - y1 + 1, 1.0)
+            bin_h, bin_w = rh / ph, rw / pw
+            hs = jnp.arange(H, dtype=jnp.float32)
+            ws = jnp.arange(W, dtype=jnp.float32)
+            bin_h_of = jnp.floor((hs - y1) / bin_h)
+            bin_w_of = jnp.floor((ws - x1) / bin_w)
+            in_h = (hs >= y1) & (hs <= y2)
+            in_w = (ws >= x1) & (ws <= x2)
+            mh = (bin_h_of[None, :] == jnp.arange(ph)[:, None]) & in_h
+            mw = (bin_w_of[None, :] == jnp.arange(pw)[:, None]) & in_w
+            mask = mh[:, None, :, None] & mw[None, :, None, :]  # [ph,pw,H,W]
+            feat = xa[img]                                      # [C, H, W]
+            vals = jnp.where(mask[None], feat[:, None, None], -jnp.inf)
+            out = vals.max(axis=(-2, -1))
+            return jnp.where(jnp.isfinite(out), out, 0.0)       # empty bins
+
+        return jax.vmap(one)(sb, img_of)
+
+    return apply_op("roi_pool", f, x, boxes,
+                    Tensor(jnp.asarray(unwrap(boxes_num)).astype(jnp.int32)))

@@ -1,0 +1,110 @@
+"""vision model zoo additions + vision.ops (nms/roi_align/roi_pool)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+rng = np.random.RandomState(0)
+
+
+class TestModelZoo:
+    def test_vgg16_params_and_forward(self):
+        from paddle_tpu.vision.models import vgg16
+        m = vgg16(num_classes=10)
+        m.eval()
+        n = sum(p.size for p in m.parameters())
+        assert n == 134_301_514  # canonical vgg16 @ 10 classes
+        x = paddle.to_tensor(rng.rand(1, 3, 64, 64).astype(np.float32))
+        assert m(x).shape == [1, 10]
+
+    def test_mobilenet_v2_params_and_train_step(self):
+        from paddle_tpu.vision.models import mobilenet_v2
+        m = mobilenet_v2(num_classes=10)
+        n = sum(p.size for p in m.parameters())
+        assert n == 2_236_682  # canonical mobilenet_v2 @ 10 classes
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=m.parameters())
+        x = paddle.to_tensor(rng.rand(2, 3, 32, 32).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 10, (2,)))
+        loss = paddle.nn.CrossEntropyLoss()(m(x), y)
+        loss.backward()
+        opt.step()
+        assert np.isfinite(float(loss))
+
+    def test_alexnet_forward(self):
+        from paddle_tpu.vision.models import alexnet
+        m = alexnet(num_classes=10)
+        m.eval()
+        assert sum(p.size for p in m.parameters()) == 57_044_810
+        x = paddle.to_tensor(rng.rand(1, 3, 64, 64).astype(np.float32))
+        assert m(x).shape == [1, 10]
+
+
+class TestVisionOps:
+    def test_nms_matches_greedy_reference(self):
+        from paddle_tpu.vision.ops import nms
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30],
+                          [21, 21, 29, 29], [50, 50, 60, 60]], np.float32)
+        scores = np.array([0.9, 0.8, 0.7, 0.95, 0.5], np.float32)
+        kept = nms(paddle.to_tensor(boxes), iou_threshold=0.5,
+                   scores=paddle.to_tensor(scores)).numpy()
+        # greedy: 3 (0.95) suppresses 2; 0 (0.9) suppresses 1; 4 stays
+        assert kept.tolist() == [3, 0, 4]
+
+    def test_nms_category_aware(self):
+        from paddle_tpu.vision.ops import nms
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+        scores = np.array([0.9, 0.8], np.float32)
+        cats = np.array([0, 1])
+        kept = nms(paddle.to_tensor(boxes), iou_threshold=0.3,
+                   scores=paddle.to_tensor(scores),
+                   category_idxs=paddle.to_tensor(cats),
+                   categories=[0, 1]).numpy()
+        assert sorted(kept.tolist()) == [0, 1]  # different cats never suppress
+
+    def test_box_iou(self):
+        from paddle_tpu.vision.ops import box_iou
+        a = np.array([[0, 0, 10, 10]], np.float32)
+        b = np.array([[0, 0, 10, 10], [5, 5, 15, 15], [20, 20, 30, 30]],
+                     np.float32)
+        iou = box_iou(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+        np.testing.assert_allclose(iou[0], [1.0, 25 / 175, 0.0], atol=1e-5)
+
+    def test_roi_align_constant_field(self):
+        from paddle_tpu.vision.ops import roi_align
+        # constant feature map -> every pooled value equals the constant
+        x = paddle.to_tensor(np.full((1, 2, 16, 16), 3.0, np.float32))
+        boxes = paddle.to_tensor(np.array([[2, 2, 10, 10]], np.float32))
+        out = roi_align(x, boxes, paddle.to_tensor(np.array([1])), 4,
+                        spatial_scale=1.0)
+        assert out.shape == [1, 2, 4, 4]
+        np.testing.assert_allclose(out.numpy(), 3.0, rtol=1e-6)
+
+    def test_roi_align_gradient_flows(self):
+        from paddle_tpu.vision.ops import roi_align
+        x = paddle.to_tensor(rng.rand(1, 2, 8, 8).astype(np.float32),
+                             stop_gradient=False)
+        boxes = paddle.to_tensor(np.array([[1, 1, 6, 6]], np.float32))
+        roi_align(x, boxes, paddle.to_tensor(np.array([1])),
+                  2).sum().backward()
+        assert x.grad is not None and float(np.abs(x.grad.numpy()).sum()) > 0
+
+    def test_roi_pool_takes_max(self):
+        from paddle_tpu.vision.ops import roi_pool
+        feat = np.zeros((1, 1, 8, 8), np.float32)
+        feat[0, 0, 3, 3] = 7.0
+        out = roi_pool(paddle.to_tensor(feat),
+                       paddle.to_tensor(np.array([[0, 0, 7, 7]], np.float32)),
+                       paddle.to_tensor(np.array([1])), 1)
+        assert float(out.numpy().max()) == 7.0
+
+    def test_multi_image_roi_assignment(self):
+        from paddle_tpu.vision.ops import roi_align
+        x = np.zeros((2, 1, 8, 8), np.float32)
+        x[0] = 1.0
+        x[1] = 5.0
+        boxes = np.array([[0, 0, 7, 7], [0, 0, 7, 7]], np.float32)
+        out = roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                        paddle.to_tensor(np.array([1, 1])), 2)
+        np.testing.assert_allclose(out.numpy()[0], 1.0, rtol=1e-5)
+        np.testing.assert_allclose(out.numpy()[1], 5.0, rtol=1e-5)
