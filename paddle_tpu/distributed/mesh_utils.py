@@ -37,34 +37,45 @@ def create_mesh(axis_shapes, axis_names=None, devices=None):
     return jax.sharding.Mesh(dev_array, tuple(names))
 
 
-def create_hybrid_mesh(dcn_axis_shapes, ici_axis_shapes, axis_names=None,
-                       devices=None):
-    """Multi-slice mesh: leading axes span DCN (one entry per slice), the
-    rest ride ICI inside each slice. Put dp/pp on the DCN axes and mp/sep on
-    ICI — collectives on the fast axes then never cross the data-center
-    network (the scaling-book mesh recipe; reference ranks order dp slowest
-    for the same reason)."""
-    dcn = list(dcn_axis_shapes.values()) if isinstance(dcn_axis_shapes, dict) \
-        else list(dcn_axis_shapes)
-    ici = list(ici_axis_shapes.values()) if isinstance(ici_axis_shapes, dict) \
-        else list(ici_axis_shapes)
-    if axis_names is None:
-        dn = list(dcn_axis_shapes) if isinstance(dcn_axis_shapes, dict) else \
-            [f"dcn{i}" for i in range(len(dcn))]
-        im = list(ici_axis_shapes) if isinstance(ici_axis_shapes, dict) else \
-            [f"ici{i}" for i in range(len(ici))]
-        axis_names = dn + im
+def create_hybrid_mesh(ici_axis_shapes, dcn_axis_shapes, devices=None):
+    """Multi-slice mesh with PER-AXIS (ICI x DCN) factors — the maxtext-style
+    contract of jax's create_hybrid_device_mesh: both dicts share the same
+    axis names, axis i's final size is ici_i * dcn_i, and the helper places
+    the DCN factor major so collectives along an axis whose dcn factor is 1
+    never cross the data-center network. Put dp/pp's growth in dcn factors
+    and keep mp/sep at dcn=1 (the scaling-book recipe).
+
+        create_hybrid_mesh({"dp": 2, "mp": 4}, {"dp": 2, "mp": 1})
+        -> Mesh [dp=4, mp=4] over 2 slices of 8 chips
+    """
+    if isinstance(ici_axis_shapes, dict):
+        names = list(ici_axis_shapes)
+        ici = [int(ici_axis_shapes[n]) for n in names]
+        if not isinstance(dcn_axis_shapes, dict):
+            raise ValueError("pass both shapes as dicts with the same keys")
+        dcn = [int(dcn_axis_shapes.get(n, 1)) for n in names]
+    else:
+        ici = [int(v) for v in ici_axis_shapes]
+        dcn = [int(v) for v in dcn_axis_shapes]
+        if len(ici) != len(dcn):
+            raise ValueError("ici and dcn factor lists must align per axis")
+        names = [f"d{i}" for i in range(len(ici))]
     devices = list(devices if devices is not None else jax.devices())
+    final = tuple(i * d for i, d in zip(ici, dcn))
+    n = int(np.prod(final))
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
     try:
         from jax.experimental import mesh_utils
         dev_array = mesh_utils.create_hybrid_device_mesh(
-            tuple(ici), tuple(dcn), devices=devices,
+            tuple(ici), tuple(dcn), devices=devices[:n],
             allow_split_physical_axes=True)
-        # hybrid helper returns [dcn..., ici...]-shaped array
-        dev_array = dev_array.reshape(tuple(dcn) + tuple(ici))
     except Exception:
-        n = int(np.prod(dcn + ici))
-        if n > len(devices):
-            raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
-        dev_array = np.array(devices[:n]).reshape(tuple(dcn) + tuple(ici))
-    return jax.sharding.Mesh(dev_array, tuple(axis_names))
+        # no slice topology info (CPU/sim): emulate dcn-major placement so
+        # each axis is [dcn factor major, ici factor minor] over enumeration
+        # order (devices of one "slice" stay contiguous on the ici factors)
+        arr = np.array(devices[:n]).reshape(tuple(dcn) + tuple(ici))
+        k = len(ici)
+        perm = [x for i in range(k) for x in (i, k + i)]
+        dev_array = arr.transpose(perm).reshape(final)
+    return jax.sharding.Mesh(dev_array, tuple(names))

@@ -134,22 +134,33 @@ class EarlyStopping(Callback):
     """reference: callbacks.py:598 — stop when a monitored metric stalls."""
 
     def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
-                 min_delta=0, baseline=None, save_best_model=True):
+                 min_delta=0, baseline=None, save_best_model=True,
+                 save_dir=None):
         self.monitor = monitor
         self.patience = patience
         self.min_delta = abs(min_delta)
         self.baseline = baseline
         self.verbose = verbose
-        if mode == "max" or (mode == "auto" and "acc" in monitor):
-            self._cmp = lambda cur, best: cur > best + self.min_delta
-            self.best = -np.inf
-        else:
-            self._cmp = lambda cur, best: cur < best - self.min_delta
-            self.best = np.inf
-        if baseline is not None:
-            self.best = baseline
+        self.save_best_model = save_best_model
+        self.save_dir = save_dir
+        self._maximize = mode == "max" or (mode == "auto" and "acc" in
+                                           monitor)
+        self._reset()
+
+    def _cmp(self, cur, best):
+        return cur > best + self.min_delta if self._maximize else \
+            cur < best - self.min_delta
+
+    def _reset(self):
+        self.best = -np.inf if self._maximize else np.inf
+        if self.baseline is not None:
+            self.best = self.baseline
         self.stop_training = False
         self.wait = 0
+
+    def on_train_begin(self, logs=None):
+        # a reused instance must not inherit the previous fit()'s state
+        self._reset()
 
     def on_epoch_end(self, epoch, logs=None):
         cur = (logs or {}).get(self.monitor)
@@ -160,6 +171,8 @@ class EarlyStopping(Callback):
         if self._cmp(cur, self.best):
             self.best = cur
             self.wait = 0
+            if self.save_best_model and self.save_dir:
+                self.model.save(os.path.join(self.save_dir, "best_model"))
         else:
             self.wait += 1
             if self.wait > self.patience:

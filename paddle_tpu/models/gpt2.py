@@ -129,7 +129,7 @@ class GPT2Model(Layer):
         return self.ln_f(x)
 
 
-def _chunked_lm_loss(hidden, wte, labels, chunk):
+def _chunked_lm_loss(hidden, wte, labels, chunk, ignore_index=-100):
     """Tied-head LM loss WITHOUT materializing [B*S, V] logits: lax.scan over
     token chunks, each chunk jax.checkpoint'ed so the backward recomputes its
     [chunk, V] logits instead of keeping them — peak memory drops from
@@ -154,25 +154,33 @@ def _chunked_lm_loss(hidden, wte, labels, chunk):
 
         @jax.checkpoint
         def one(hc, yc):
+            # ignore_index rows (and padding, marked the same way) are
+            # masked out of both the sum and the valid-token count, matching
+            # F.cross_entropy's default ignore_index=-100 semantics
+            valid = yc != ignore_index
             logits = (hc @ w.T).astype(jnp.float32)
             lse = jax.scipy.special.logsumexp(logits, axis=-1)
-            picked = jnp.take_along_axis(
-                logits, yc[:, None].astype(jnp.int32), axis=1)[:, 0]
-            return lse - picked
+            safe_y = jnp.where(valid, yc, 0).astype(jnp.int32)
+            picked = jnp.take_along_axis(logits, safe_y[:, None],
+                                         axis=1)[:, 0]
+            per_tok = jnp.where(valid, lse - picked, 0.0)
+            return jnp.sum(per_tok), jnp.sum(valid)
+
+        if pad:
+            flat_y = flat_y.at[n:].set(ignore_index)
+            hs = flat_h.reshape(-1, c, H)
+            ys = flat_y.reshape(-1, c)
 
         def body(carry, xs):
+            tot, cnt = carry
             hc, yc = xs
-            return carry + jnp.sum(one(hc, yc)), None
+            t, k = one(hc, yc)
+            return (tot + t, cnt + k), None
 
-        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hs, ys))
-        if pad:
-            # padded rows contribute lse(logits of zero-vector h) - logits[0];
-            # with h=0 logits are the zero vector + ... not zero in general
-            # (w.T has no bias): recompute their exact contribution and drop
-            zpad = one(jnp.zeros((pad, H), flat_h.dtype),
-                       jnp.zeros((pad,), flat_y.dtype))
-            total = total - jnp.sum(zpad)
-        return total / n
+        (total, count), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+            (hs, ys))
+        return total / jnp.maximum(count, 1)
 
     return apply_op("chunked_lm_loss", f, hidden, wte, labels)
 

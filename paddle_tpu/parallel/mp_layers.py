@@ -145,19 +145,23 @@ class ParallelCrossEntropy(Layer):
         ignore = self._ignore_index
         input = _constrain(
             input, P(*([None] * (input.ndim - 1) + ["mp"])))
+        return apply_op("parallel_cross_entropy",
+                        lambda x, y: _pce_math(x, y, ignore), input, label)
 
-        def f(x, y):
-            xf = x.astype(jnp.float32)
-            m = jnp.max(xf, axis=-1, keepdims=True)
-            lse = jnp.log(jnp.sum(jnp.exp(xf - m), axis=-1,
-                                  keepdims=True)) + m
-            oh = jax.nn.one_hot(y, x.shape[-1], dtype=xf.dtype)
-            picked = jnp.sum(xf * oh, axis=-1)
-            loss = lse[..., 0] - picked
-            if ignore is not None:
-                loss = jnp.where(y == ignore, 0.0, loss)
-            return loss
-        return apply_op("parallel_cross_entropy", f, input, label)
+
+def _pce_math(x, y, ignore=-100):
+    """The shard-local CE math (module-level so tests can lower THIS exact
+    function with sharded inputs and assert the compiled program never
+    all-gathers the vocab axis)."""
+    xf = x.astype(jnp.float32)
+    m = jnp.max(xf, axis=-1, keepdims=True)
+    lse = jnp.log(jnp.sum(jnp.exp(xf - m), axis=-1, keepdims=True)) + m
+    oh = jax.nn.one_hot(y, x.shape[-1], dtype=xf.dtype)
+    picked = jnp.sum(xf * oh, axis=-1)
+    loss = lse[..., 0] - picked
+    if ignore is not None:
+        loss = jnp.where(y == ignore, 0.0, loss)
+    return loss
 
 
 class RNGStatesTracker:

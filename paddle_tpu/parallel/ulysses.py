@@ -43,16 +43,50 @@ def _ulysses_local(q, k, v, axis_name, causal, scale):
                                   tiled=True)
 
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    # full-sequence attention on the local head group (flash-style math)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", qg.astype(jnp.float32) * scale,
-                        kg.astype(jnp.float32))
-    if causal:
-        S = qg.shape[1]
-        mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
-        logits = jnp.where(mask[None, None], logits, -1e30)
-    p = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p, vg.astype(jnp.float32))
+    # full-sequence attention on the local head group, BLOCKWISE over K with
+    # an online softmax — memory O(S * block), never the dense [S, S]
+    # logits this mode exists to avoid at long context
+    out = _blockwise_sdpa(qg, kg, vg, causal=causal, scale=scale)
     return heads_to_seq(out.astype(q.dtype))
+
+
+def _blockwise_sdpa(q, k, v, causal, scale, block=1024):
+    """[B, S, H, D] flash-style attention via lax.scan over K blocks."""
+    B, S, H, D = q.shape
+    blk = min(block, S)
+    while S % blk:          # static divisor of S
+        blk //= 2
+    nk = S // blk
+    qf = q.astype(jnp.float32) * scale
+    kb = k.astype(jnp.float32).reshape(B, nk, blk, H, D).transpose(
+        1, 0, 2, 3, 4)
+    vb = v.astype(jnp.float32).reshape(B, nk, blk, H, D).transpose(
+        1, 0, 2, 3, 4)
+    q_pos = jnp.arange(S)
+
+    def body(carry, xs):
+        m_prev, l_prev, acc = carry
+        kc, vc, j = xs
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kc)
+        if causal:
+            k_pos = j * blk + jnp.arange(blk)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(mask[None, None], logits, -1e30)
+        m_cur = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(logits - m_new[..., None])
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, vc)
+        return (m_new, l_new, acc), None
+
+    init = (jnp.full((B, H, S), -jnp.inf, jnp.float32),
+            jnp.zeros((B, H, S), jnp.float32),
+            jnp.zeros((B, H, S, D), jnp.float32))
+    (m, l, acc), _ = jax.lax.scan(body, init,
+                                  (kb, vb, jnp.arange(nk)))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3)    # [B, H, S, D] -> [B, S, H, D]
 
 
 def ulysses_attention(q, k, v, causal=True, axis_name="sep", mesh=None):

@@ -94,6 +94,16 @@ class _InvertedResidual(Layer):
         return x + out if self.use_res else out
 
 
+def _make_divisible(v, divisor=8, min_value=None):
+    """reference: mobilenetv2.py _make_divisible — round channel counts to
+    multiples of 8, never dropping more than 10%."""
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
 class MobileNetV2(Layer):
     """reference: vision/models/mobilenetv2.py MobileNetV2."""
 
@@ -101,12 +111,12 @@ class MobileNetV2(Layer):
         super().__init__()
         cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
                (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
-        inp = int(32 * scale) if scale > 1.0 else 32
-        last = int(1280 * max(1.0, scale))
+        inp = _make_divisible(32 * scale)
+        last = _make_divisible(1280 * max(1.0, scale))
         feats = [Conv2D(3, inp, 3, stride=2, padding=1, bias_attr=False),
                  BatchNorm2D(inp), ReLU6()]
         for t, c, n, s in cfg:
-            out_c = int(c * scale)
+            out_c = _make_divisible(c * scale)
             for i in range(n):
                 feats.append(_InvertedResidual(inp, out_c,
                                                s if i == 0 else 1, t))

@@ -81,8 +81,13 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
 
 
 def _bilinear(feat, y, x):
-    """feat [C, H, W]; y/x [...] float coords -> [C, ...]."""
+    """feat [C, H, W]; y/x [...] float coords -> [C, ...]. Coordinates are
+    CLAMPED into the image before weights are computed (reference roi_align
+    border behavior) — unclamped coords would extrapolate with negative
+    weights at the borders."""
     H, W = feat.shape[-2:]
+    y = jnp.clip(y, 0.0, H - 1.0)
+    x = jnp.clip(x, 0.0, W - 1.0)
     y0 = jnp.clip(jnp.floor(y), 0, H - 1)
     x0 = jnp.clip(jnp.floor(x), 0, W - 1)
     y1 = jnp.clip(y0 + 1, 0, H - 1)

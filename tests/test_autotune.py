@@ -197,3 +197,21 @@ class TestChunkedLMLoss:
         l1, g1 = run(17)   # non-dividing chunk exercises the padding path
         np.testing.assert_allclose(l1, l0, rtol=1e-5)
         np.testing.assert_allclose(g1, g0, rtol=1e-3)
+
+    def test_chunked_loss_respects_ignore_index(self):
+        from paddle_tpu.models.gpt2 import GPT2Config, GPT2ForCausalLM
+        ids = rng.randint(0, 256, (2, 33)).astype(np.int32)
+        labels = ids[:, 1:].copy()
+        labels[0, :10] = -100   # masked prefix
+
+        def run(chunk):
+            paddle.seed(3)
+            cfg = GPT2Config.tiny(hidden_dropout_prob=0.0,
+                                  attention_dropout_prob=0.0,
+                                  loss_chunk_size=chunk)
+            m = GPT2ForCausalLM(cfg)
+            _, loss = m(paddle.to_tensor(ids[:, :-1]),
+                        labels=paddle.to_tensor(labels))
+            return float(loss)
+
+        np.testing.assert_allclose(run(17), run(0), rtol=1e-5)

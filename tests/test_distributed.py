@@ -258,13 +258,14 @@ class TestParallelCrossEntropy:
             NamedSharding(mesh, P(None, None, "mp")))
         y = jnp.asarray(rng.randint(0, V, (B, S)))
 
+        from paddle_tpu.parallel.mp_layers import _pce_math
+
         def ce(xa, ya):
+            # the PRODUCT math (what ParallelCrossEntropy dispatches), under
+            # the same sharding constraint its forward applies
             xa = jax.lax.with_sharding_constraint(
                 xa, NamedSharding(mesh, P(None, None, "mp")))
-            m = jnp.max(xa, -1, keepdims=True)
-            lse = jnp.log(jnp.sum(jnp.exp(xa - m), -1, keepdims=True)) + m
-            oh = jax.nn.one_hot(ya, xa.shape[-1], dtype=xa.dtype)
-            return lse[..., 0] - jnp.sum(xa * oh, -1)
+            return _pce_math(xa, ya)
 
         compiled = jax.jit(ce).lower(x, y).compile()
         hlo = compiled.as_text()

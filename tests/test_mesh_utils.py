@@ -22,16 +22,26 @@ class TestMeshUtils:
             create_mesh({"dp": 64})
 
     def test_hybrid_mesh_axes(self):
-        # 2 "slices" over DCN x 4 chips ICI
-        m = create_hybrid_mesh({"dp": 2}, {"mp": 4})
+        # per-axis (ICI x DCN) factors: dp grows over DCN (2 slices), mp
+        # stays inside a slice (dcn factor 1)
+        m = create_hybrid_mesh({"dp": 1, "mp": 4}, {"dp": 2, "mp": 1})
         assert m.axis_names == ("dp", "mp")
         assert m.devices.shape == (2, 4)
+        # mp rows stay within one contiguous "slice" of the enumeration
+        # (the fallback's dcn-major placement contract)
+        ids = np.vectorize(lambda d: d.id)(m.devices)
+        assert set(ids[0].tolist()) == {0, 1, 2, 3}
+        assert set(ids[1].tolist()) == {4, 5, 6, 7}
         # a sharded matmul over the hybrid mesh executes
         from jax.sharding import NamedSharding, PartitionSpec as P
         x = jax.device_put(np.ones((8, 16), np.float32),
                            NamedSharding(m, P("dp", "mp")))
         out = jax.jit(lambda a: a.sum())(x)
         assert float(out) == 128.0
+
+    def test_hybrid_mesh_mismatched_axes_raise(self):
+        with pytest.raises(ValueError, match="align|same keys"):
+            create_hybrid_mesh((2, 2), (2,))
 
 
 class TestIncubateMoeSurface:
