@@ -16,7 +16,12 @@ from .watchdog import CommTaskManager  # noqa: F401
 from .collective import (all_reduce, all_gather, all_gather_object, reduce,  # noqa: F401
                          broadcast, scatter, all_to_all, reduce_scatter,
                          send, recv, barrier, new_group, get_group, ReduceOp,
-                         split_group)
+                         split_group, broadcast_object_list, alltoall,
+                         all_to_all_single, gather, gather_object,
+                         scatter_object_list, isend, irecv, wait, P2POp,
+                         batch_isend_irecv, destroy_process_group)
+from . import mesh_utils  # noqa: F401
+from .mesh_utils import create_mesh, create_hybrid_mesh  # noqa: F401
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
 

@@ -298,13 +298,20 @@ def _store_or_raise():
     return store
 
 
+_local_p2p: dict = {}
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
     """P2P send over the TCPStore control plane (reference send over NCCL;
     on TPU the compute plane uses ppermute inside shard_map — see
     parallel/pipeline — so explicit send/recv is host-side by design)."""
     import pickle
-    store = _store_or_raise()
     me = get_rank()
+    if dst == me and jax.process_count() == 1:   # self-send loopback
+        k = ("loop", me)
+        _local_p2p.setdefault(k, []).append(np.asarray(unwrap(tensor)))
+        return tensor
+    store = _store_or_raise()
     k = ("send", me, dst)
     seq = _p2p_seq.get(k, 0)
     _p2p_seq[k] = seq + 1
@@ -315,8 +322,14 @@ def send(tensor, dst=0, group=None, sync_op=True):
 
 def recv(tensor, src=0, group=None, sync_op=True):
     import pickle
-    store = _store_or_raise()
     me = get_rank()
+    if src == me and jax.process_count() == 1:   # self-recv loopback
+        q = _local_p2p.get(("loop", me), [])
+        if not q:
+            raise RuntimeError("recv from self with nothing sent")
+        tensor._data = jnp.asarray(q.pop(0))
+        return tensor
+    store = _store_or_raise()
     k = ("recv", src, me)
     seq = _p2p_seq.get(k, 0)
     _p2p_seq[k] = seq + 1
@@ -385,3 +398,136 @@ reduce_scatter = _watched(reduce_scatter)
 send = _watched(send)
 recv = _watched(recv)
 barrier = _watched(barrier)
+
+
+# ---- API-parity wrappers (reference: distributed/communication/*) -----------
+alltoall = all_to_all      # reference exposes both names
+
+
+def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
+                      in_split_sizes=None, group=None, sync_op=True):
+    """reference: communication/all_to_all.py alltoall_single — a single
+    tensor split row-wise across ranks."""
+    g = group or _get_global_group()
+    world = g.get_world_size()
+    if world <= 1 or jax.process_count() == 1:
+        out_tensor._data = unwrap(in_tensor)
+        return out_tensor
+    parts = ops_split_rows(in_tensor, in_split_sizes, world)
+    outs = [Tensor(np.zeros(1, np.float32)) for _ in range(world)]
+    all_to_all(outs, parts, group=group)
+    import jax.numpy as _jnp
+    out_tensor._data = _jnp.concatenate([unwrap(t) for t in outs], axis=0)
+    return out_tensor
+
+
+def ops_split_rows(tensor, split_sizes, world):
+    a = unwrap(tensor)
+    if split_sizes:
+        idx = np.cumsum(split_sizes)[:-1]
+        chunks = np.split(np.asarray(a), idx, axis=0)
+    else:
+        chunks = np.split(np.asarray(a), world, axis=0)
+    import jax.numpy as _jnp
+    return [Tensor(_jnp.asarray(c)) for c in chunks]
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """reference: communication/gather.py — all ranks contribute, dst gets
+    the list (on the single-controller plane every process materializes)."""
+    out = []
+    all_gather(out, tensor, group=group)
+    if gather_list is not None and get_rank() == dst:
+        gather_list.extend(out)
+    return gather_list if get_rank() == dst else None
+
+
+def gather_object(obj, object_list=None, dst=0, group=None):
+    out = []
+    all_gather_object(out, obj, group=group)
+    if object_list is not None and get_rank() == dst:
+        object_list.extend(out)
+    return object_list if get_rank() == dst else None
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """reference: communication/scatter.py scatter_object_list."""
+    g = group or _get_global_group()
+    world = g.get_world_size()
+    if world <= 1 or jax.process_count() == 1:
+        out_object_list.append(in_object_list[0] if in_object_list else None)
+        return out_object_list
+    gathered = []
+    all_gather_object(gathered, in_object_list if get_rank() == src else
+                      None, group=group)
+    src_list = gathered[src]
+    out_object_list.append(src_list[get_rank()])
+    return out_object_list
+
+
+class _Work:
+    """Completed-work handle (reference: async Task.wait() contract; the
+    store-based P2P plane completes synchronously, so wait() is a no-op)."""
+
+    def __init__(self, result=None):
+        self._result = result
+
+    def wait(self, timeout=None):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def isend(tensor, dst=0, group=None):
+    send(tensor, dst=dst, group=group, sync_op=False)
+    return _Work(tensor)
+
+
+def irecv(tensor, src=0, group=None):
+    recv(tensor, src=src, group=group, sync_op=False)
+    return _Work(tensor)
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """reference: communication/wait.py — XLA's async dispatch makes this a
+    device sync on the tensor."""
+    import jax as _jax
+    _jax.block_until_ready(unwrap(tensor))
+    return tensor
+
+
+class P2POp:
+    """reference: communication/batch_isend_irecv.py P2POp."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """reference: batch_isend_irecv — issue sends first so the store always
+    has the payloads before any blocking recv."""
+    works = []
+    sends = [p for p in p2p_op_list if p.op in (isend, send, "isend")]
+    recvs = [p for p in p2p_op_list if p not in sends]
+    for p in sends:
+        works.append(isend(p.tensor, dst=p.peer, group=p.group))
+    for p in recvs:
+        works.append(irecv(p.tensor, src=p.peer, group=p.group))
+    return works
+
+
+def destroy_process_group(group=None):
+    """reference: communication/group.py destroy_process_group."""
+    global _groups
+    try:
+        if group is None:
+            _groups.clear()
+        else:
+            _groups.pop(getattr(group, "id", None), None)
+    except NameError:
+        pass

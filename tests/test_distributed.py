@@ -613,3 +613,41 @@ class TestLlamaUlyssesBackend:
             return float(loss)
 
         np.testing.assert_allclose(run("ulysses"), run("ring"), rtol=1e-4)
+
+
+class TestCommunicationSurface:
+    """API-parity wrappers (reference distributed/communication/*): single-
+    process semantics here; cross-process paths are covered by test_launch."""
+
+    def test_gather_and_objects(self):
+        from paddle_tpu import distributed as dist
+        t = pt.to_tensor(np.arange(4.0, dtype=np.float32))
+        out = []
+        dist.gather(t, out, dst=0)
+        np.testing.assert_allclose(out[0].numpy(), t.numpy())
+        objs = []
+        dist.gather_object({"a": 1}, objs, dst=0)
+        assert objs == [{"a": 1}]
+        o = []
+        dist.scatter_object_list(o, [[42]])
+        assert o == [[42]]
+
+    def test_p2p_loopback_and_batch(self):
+        from paddle_tpu import distributed as dist
+        t = pt.to_tensor(np.arange(4.0, dtype=np.float32))
+        r = pt.to_tensor(np.zeros(4, np.float32))
+        assert dist.isend(t, dst=0).wait()
+        dist.irecv(r, src=0).wait()
+        np.testing.assert_allclose(r.numpy(), t.numpy())
+        works = dist.batch_isend_irecv([dist.P2POp(dist.isend, t, 0),
+                                        dist.P2POp(dist.irecv, r, 0)])
+        assert all(w.wait() for w in works)
+        dist.wait(t)
+
+    def test_all_to_all_single_one_proc(self):
+        from paddle_tpu import distributed as dist
+        x = pt.to_tensor(np.arange(8.0, dtype=np.float32).reshape(4, 2))
+        out = pt.to_tensor(np.zeros((4, 2), np.float32))
+        dist.all_to_all_single(out, x)
+        np.testing.assert_allclose(out.numpy(), x.numpy())
+        assert dist.alltoall is dist.all_to_all
