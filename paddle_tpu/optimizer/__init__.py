@@ -1,5 +1,6 @@
 """paddle.optimizer surface (reference: python/paddle/optimizer/__init__.py)."""
 from .optimizer import Optimizer, L1Decay, L2Decay  # noqa: F401
 from .optimizers import (SGD, Momentum, Adam, AdamW, Adamax, Adagrad, RMSProp,  # noqa: F401
-                         Adadelta, Lamb, LBFGS)
+                         Adadelta, Lamb, LBFGS, NAdam,
+                         RAdam, Rprop, ASGD)
 from . import lr  # noqa: F401

@@ -7,6 +7,7 @@ multi_precision=True keeps an f32 copy as the source of truth).
 from __future__ import annotations
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
@@ -283,3 +284,127 @@ class LBFGS(Optimizer):
             upd = self._last_step[offset:offset + n].reshape(p._data.shape)
             p._data = (unwrap(p).astype(jnp.float32) + upd).astype(p._data.dtype)
             offset += n
+
+
+class NAdam(Optimizer):
+    """reference: optimizer/nadam.py — Adam with Nesterov momentum
+    (Dozat 2016; mu-product schedule)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def _update_param(self, p, g, lr, wd):
+        mw, pw = self._master(p)
+        gf = self._apply_weight_decay_l2(pw, g.astype(jnp.float32), wd)
+        m = self._acc("moment1", p, dtype=jnp.float32)
+        v = self._acc("moment2", p, dtype=jnp.float32)
+        tacc = self._acc("step", p, init=jnp.zeros((), jnp.float32))
+        mu_prod = self._acc("mu_product", p, init=jnp.ones((), jnp.float32))
+        t = unwrap(tacc) + 1.0
+        tacc._data = t
+        mu_t = self._beta1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        mu_t1 = self._beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        mp = unwrap(mu_prod) * mu_t
+        mu_prod._data = mp
+        mv = self._beta1 * unwrap(m) + (1 - self._beta1) * gf
+        vv = self._beta2 * unwrap(v) + (1 - self._beta2) * gf * gf
+        m._data, v._data = mv, vv
+        m_hat = mu_t1 * mv / (1 - mp * mu_t1) + (1 - mu_t) * gf / (1 - mp)
+        v_hat = vv / (1 - self._beta2 ** t)
+        self._commit(p, mw, pw - lr * m_hat / (jnp.sqrt(v_hat) + self._eps))
+
+
+class RAdam(Optimizer):
+    """reference: optimizer/radam.py — rectified Adam (Liu et al. 2020)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _update_param(self, p, g, lr, wd):
+        mw, pw = self._master(p)
+        gf = self._apply_weight_decay_l2(pw, g.astype(jnp.float32), wd)
+        m = self._acc("moment1", p, dtype=jnp.float32)
+        v = self._acc("moment2", p, dtype=jnp.float32)
+        tacc = self._acc("step", p, init=jnp.zeros((), jnp.float32))
+        t = unwrap(tacc) + 1.0
+        tacc._data = t
+        mv = self._beta1 * unwrap(m) + (1 - self._beta1) * gf
+        vv = self._beta2 * unwrap(v) + (1 - self._beta2) * gf * gf
+        m._data, v._data = mv, vv
+        m_hat = mv / (1 - self._beta1 ** t)
+        rho_inf = 2.0 / (1 - self._beta2) - 1.0
+        b2t = self._beta2 ** t
+        rho_t = rho_inf - 2.0 * t * b2t / (1 - b2t)
+        # variance rectification: plain momentum until rho_t > 5
+        # (reference radam.py:66 and torch both gate at 5)
+        def rect():
+            r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf) /
+                         ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+            v_hat = jnp.sqrt(vv / (1 - b2t))
+            return r * m_hat / (v_hat + self._eps)
+        upd = jnp.where(rho_t > 5.0, rect(), m_hat)
+        self._commit(p, mw, pw - lr * upd)
+
+
+class Rprop(Optimizer):
+    """reference: optimizer/rprop.py — resilient backprop (sign-based
+    per-weight step sizes; full-batch regime)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 name=None, weight_decay=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_minus, self._eta_plus = etas
+
+    def _update_param(self, p, g, lr, wd):
+        mw, pw = self._master(p)
+        gf = self._apply_weight_decay_l2(pw, g.astype(jnp.float32), wd)
+        prev = self._acc("prev_grad", p, dtype=jnp.float32)
+        step = self._acc("step_size", p, dtype=jnp.float32,
+                         init=jnp.full(p.shape, float(lr), jnp.float32))
+        sgn = jnp.sign(unwrap(prev) * gf)
+        factor = jnp.where(sgn > 0, self._eta_plus,
+                           jnp.where(sgn < 0, self._eta_minus, 1.0))
+        ns = jnp.clip(unwrap(step) * factor, self._lr_min, self._lr_max)
+        g_eff = jnp.where(sgn < 0, 0.0, gf)   # backtrack: skip update
+        step._data = ns
+        prev._data = g_eff
+        self._commit(p, mw, pw - ns * jnp.sign(g_eff))
+
+
+class ASGD(Optimizer):
+    """reference: optimizer/asgd.py — Stochastic Average Gradient (SAG):
+    keep the last-seen gradient y_i per batch slot, maintain their running
+    sum d, step with the averaged gradient d / min(m+1, n)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._n = int(max(batch_num, 1))
+
+    def _update_param(self, p, g, lr, wd):
+        mw, pw = self._master(p)
+        gf = self._apply_weight_decay_l2(pw, g.astype(jnp.float32), wd)
+        n = self._n
+        d = self._acc("d", p, dtype=jnp.float32)
+        ys = self._acc("ys", p, init=jnp.zeros((n,) + tuple(p.shape),
+                                               jnp.float32))
+        macc = self._acc("m", p, init=jnp.zeros((), jnp.int32))
+        m = unwrap(macc)
+        i = m % n
+        yi = jax.lax.dynamic_index_in_dim(unwrap(ys), i, keepdims=False)
+        dv = unwrap(d) - yi + gf
+        d._data = dv
+        ys._data = jax.lax.dynamic_update_index_in_dim(
+            unwrap(ys), gf, i, axis=0)
+        macc._data = m + 1
+        denom = jnp.minimum(m + 1, n).astype(jnp.float32)
+        self._commit(p, mw, pw - lr * dv / denom)

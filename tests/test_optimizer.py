@@ -224,3 +224,68 @@ def test_set_state_dict_preserves_f32_moments_on_bf16_params():
     assert m1._buf.dtype == np.float32
     np.testing.assert_allclose(np.asarray(m1._buf),
                                np.asarray(sd["moment1_0"]._buf))
+
+
+class TestNewOptimizersVsTorch:
+    """NAdam/RAdam/Rprop update math vs torch.optim on identical streams."""
+
+    def _run_pair(self, make_ours, make_torch, steps=5, rtol=2e-4):
+        import torch
+        rng2 = np.random.RandomState(3)
+        w0 = rng2.rand(6, 4).astype(np.float32)
+        grads = [rng2.randn(6, 4).astype(np.float32) for _ in range(steps)]
+        p = pt.to_tensor(w0.copy(), stop_gradient=False)
+        opt = make_ours([p])
+        tp = torch.nn.Parameter(torch.from_numpy(w0.copy()))
+        topt = make_torch([tp])
+        for g in grads:
+            p._grad_buf = pt.to_tensor(g)
+            opt.step()
+            opt.clear_grad()
+            tp.grad = torch.from_numpy(g)
+            topt.step()
+            topt.zero_grad()
+        np.testing.assert_allclose(p.numpy(), tp.detach().numpy(),
+                                   rtol=rtol, atol=1e-5)
+
+    def test_nadam_matches_torch(self):
+        import torch
+        self._run_pair(
+            lambda ps: pt.optimizer.NAdam(learning_rate=0.01,
+                                              parameters=ps),
+            lambda ps: torch.optim.NAdam(ps, lr=0.01))
+
+    def test_radam_matches_torch(self):
+        import torch
+        self._run_pair(
+            lambda ps: pt.optimizer.RAdam(learning_rate=0.01,
+                                              parameters=ps),
+            lambda ps: torch.optim.RAdam(ps, lr=0.01), steps=8)
+
+    def test_rprop_matches_torch(self):
+        import torch
+        self._run_pair(
+            lambda ps: pt.optimizer.Rprop(learning_rate=0.01,
+                                              parameters=ps),
+            lambda ps: torch.optim.Rprop(ps, lr=0.01), steps=6)
+
+    def test_asgd_sag_semantics(self):
+        # constant grads: d/min(m+1,n) == 1 every step -> x -= lr each step
+        p = pt.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+        opt = pt.optimizer.ASGD(learning_rate=0.1, batch_num=2,
+                                parameters=[p])
+        for _ in range(5):
+            p._grad_buf = pt.to_tensor(np.ones(4, np.float32))
+            opt.step()
+            opt.clear_grad()
+        np.testing.assert_allclose(p.numpy(), 0.5, rtol=1e-5)
+        # alternating batch grads: d averages the two slots
+        q = pt.to_tensor(np.zeros((2,), np.float32), stop_gradient=False)
+        opt2 = pt.optimizer.ASGD(learning_rate=1.0, batch_num=2,
+                                 parameters=[q])
+        for g in (2.0, 4.0):
+            q._grad_buf = pt.to_tensor(np.full(2, g, np.float32))
+            opt2.step()
+            opt2.clear_grad()
+        # step1: -1*2/1 = -2 ; step2: -(2+4)/2 = -3 -> total -5
+        np.testing.assert_allclose(q.numpy(), -5.0, rtol=1e-5)
