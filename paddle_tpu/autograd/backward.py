@@ -70,14 +70,29 @@ def _vjp_on_tape(node, out_cots):
 
 
 def backward(tensors, grad_tensors=None, retain_graph=False,
-             create_graph=False, _only=None):
+             create_graph=False, _only=None, defer_param_ids=None):
     """paddle.autograd.backward analog.
 
     create_graph=True runs every node's vjp THROUGH dispatch (apply_op), so
     cotangents are tape Tensors and the produced grads are differentiable —
     the eager double-grad semantics of fluid/eager RunBackward+grad ops.
     _only (internal, paddle.grad only_inputs=True): restrict .grad writes to
-    this id-set so a grad() call never pollutes other leaves' .grad."""
+    this id-set so a grad() call never pollutes other leaves' .grad.
+
+    defer_param_ids (internal, zero-bubble pipeline): id-set of leaf
+    parameters whose weight-grad computation is DEFERRED — the sweep
+    propagates activation cotangents now (the "B" pass) and returns a list of
+    zero-arg "W" closures computing/accumulating the parameter grads; the last
+    entry flushes hooks + .grad writes on the per-param summed cotangent.
+    For a node with both activation and parameter inputs we re-linearize
+    restricted to the activation inputs, so only dX is computed now; the W
+    closure re-linearizes restricted to the params. Eagerly that replays the
+    node's forward once per phase; under `to_static` capture both
+    linearizations land in one XLA module and the duplicated forward
+    subexpressions are CSE'd (reference analog: pipeline_zero_bubble.py splits
+    matmul_grad into dX-now / dW-later at the op level)."""
+    if create_graph and defer_param_ids:
+        raise ValueError("defer_param_ids cannot be combined with create_graph")
     if grad_tensors is None:
         grad_tensors = [None] * len(tensors)
 
@@ -160,6 +175,51 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
                                 stop_gradient=True)
         return cot
 
+    # --- deferred W machinery (zero-bubble) -----------------------------------
+    deferred = []
+    pending_w = {}     # id(param) -> [tensor, summed cotangent]
+
+    def _w_accum(t: Tensor, cot):
+        if _is_float0(cot):
+            return
+        e = pending_w.get(id(t))
+        if e is None:
+            pending_w[id(t)] = [t, cot]
+        else:
+            e[1] = e[1] + _same_device(e[1], cot)
+
+    def make_w_closure(raw_fn, in_arrays, p_idxs, p_tensors, out_cots, n_outs):
+        def w_fn():
+            def pf(*ps):
+                ins = list(in_arrays)
+                for k, i in enumerate(p_idxs):
+                    ins[i] = ps[k]
+                return raw_fn(*ins)
+            _, vjp = jax.vjp(pf, *(in_arrays[i] for i in p_idxs))
+            arg = out_cots[0] if n_outs == 1 else tuple(out_cots)
+            for t, c in zip(p_tensors, vjp(arg)):
+                _w_accum(t, c)
+        return w_fn
+
+    def flush_w():
+        """Hooks fire once on the per-param summed cotangent, matching the
+        joint sweep's finalize semantics."""
+        for t, cot in pending_w.values():
+            if t._hooks:
+                g = Tensor(cot, stop_gradient=True)
+                for hook in list(t._hooks):
+                    out = hook(g)
+                    if out is not None:
+                        g = out if isinstance(out, Tensor) else \
+                            Tensor(jnp.asarray(out))
+                cot = g._data
+            if t.grad is None:
+                t.grad = Tensor(cot, stop_gradient=True)
+            else:
+                t.grad = Tensor(t.grad._data + _same_device(t.grad._data, cot),
+                                stop_gradient=True)
+        pending_w.clear()
+
     # --- seed ready queue: nodes with no pending consumers --------------------
     ready = [n for n in nodes if dep[id(n)] == 0]
     processed = set()
@@ -183,7 +243,38 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
                 shape, dt = node.out_avals[i]
                 cot = _const(jnp.zeros(shape, dtype=dt))
             out_cots.append(cot)
-        if create_graph and node.raw_fn is not None:
+        # classify inputs for the zero-bubble split: deferred leaf params vs
+        # activations that must propagate now
+        p_idxs, a_idxs = [], []
+        if defer_param_ids:
+            for i, inp in enumerate(node.inputs):
+                if inp is None or inp.stop_gradient:
+                    continue
+                if id(inp) in defer_param_ids and inp._grad_node is None:
+                    p_idxs.append(i)
+                else:
+                    a_idxs.append(i)
+        splittable = (bool(p_idxs) and node.raw_fn is not None
+                      and node.in_arrays is not None)
+        if splittable:
+            raw_fn, in_arrays = node.raw_fn, node.in_arrays
+            deferred.append(make_w_closure(
+                raw_fn, in_arrays, tuple(p_idxs),
+                tuple(node.inputs[i] for i in p_idxs),
+                tuple(out_cots), node.n_outs))
+            in_cots = [None] * len(node.inputs)
+            if a_idxs:
+                def af(*acts, _ia=in_arrays, _ai=tuple(a_idxs), _fn=raw_fn):
+                    ins = list(_ia)
+                    for k, i in enumerate(_ai):
+                        ins[i] = acts[k]
+                    return _fn(*ins)
+                _, avjp = jax.vjp(af, *(in_arrays[i] for i in a_idxs))
+                arg = out_cots[0] if node.n_outs == 1 else tuple(out_cots)
+                acots = avjp(arg)
+                for k, i in enumerate(a_idxs):
+                    in_cots[i] = acots[k]
+        elif create_graph and node.raw_fn is not None:
             in_cots = _vjp_on_tape(node, out_cots)
         else:
             arg = out_cots[0] if node.n_outs == 1 else tuple(out_cots)
@@ -194,7 +285,7 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
         if not retain_graph and not create_graph:
             node.release()
         for inp, cot in zip(node.inputs, in_cots):
-            if inp is None or inp.stop_gradient:
+            if inp is None or inp.stop_gradient or cot is None:
                 continue
             accum_tensor(inp, cot)
             prod = inp._grad_node
@@ -202,10 +293,24 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
                 dep[id(prod)] -= 1
                 if dep[id(prod)] == 0:
                     ready.append(node_by_id[id(prod)])
-    # finalize leaves that never went through a node's out_refs
+    # finalize leaves that never went through a node's out_refs; params whose
+    # grads were deferred never entered `cots`, so this flushes only the
+    # immediately-computed cotangents
     for k, t in list(keepalive.items()):
         if t._grad_node is None:
             finalize(t)
+    if defer_param_ids is not None:
+        if deferred:
+            deferred.append(flush_w)
+        return deferred
+
+
+def backward_split(tensors, grad_tensors=None, param_ids=frozenset()):
+    """Zero-bubble B-phase backward: propagate activation cotangents now,
+    return deferred W closures for the leaf params in `param_ids` (last entry
+    flushes hooks + .grad writes). Thin wrapper over backward(); see its
+    defer_param_ids docs."""
+    return backward(tensors, grad_tensors, defer_param_ids=param_ids)
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,

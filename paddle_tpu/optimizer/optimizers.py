@@ -345,8 +345,10 @@ class RAdam(Optimizer):
         # variance rectification: plain momentum until rho_t > 5
         # (reference radam.py:66 and torch both gate at 5)
         def rect():
-            r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf) /
-                         ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+            # clamp keeps the unselected branch NaN-free for rho_t in (2, 4)
+            # (jnp.where evaluates both sides; jax_debug_nans would trip)
+            num = jnp.maximum((rho_t - 4) * (rho_t - 2) * rho_inf, 0.0)
+            r = jnp.sqrt(num / ((rho_inf - 4) * (rho_inf - 2) * rho_t))
             v_hat = jnp.sqrt(vv / (1 - b2t))
             return r * m_hat / (v_hat + self._eps)
         upd = jnp.where(rho_t > 5.0, rect(), m_hat)

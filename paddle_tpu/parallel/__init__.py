@@ -15,7 +15,8 @@ from .ring_attention import ring_flash_attention  # noqa: F401
 from .ulysses import ulysses_attention  # noqa: F401
 from .pipeline import pipeline_forward, pipeline_call  # noqa: F401
 from .pipeline_layer import (PipelineLayer, LayerDesc, SharedLayerDesc,  # noqa: F401
-                             PipelineParallel, PipelineParallelWithInterleave)
+                             PipelineParallel, PipelineParallelWithInterleave,
+                             ZeroBubblePipelineParallel)
 from .tensor_parallel import TensorParallel, SegmentParallel  # noqa: F401
 from .sharding import (group_sharded_parallel, save_group_sharded_model,  # noqa: F401
                        DygraphShardingOptimizer, GroupShardedStage2,

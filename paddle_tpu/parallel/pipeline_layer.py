@@ -311,25 +311,33 @@ class PipelineParallel(Layer):
         loss = loss / n_micro
         return boundaries, loss
 
-    def _backward_micro(self, boundaries, loss, scaler=None):
+    def _backward_micro(self, boundaries, loss, scaler=None, param_ids=None):
         """Backward chunk-by-chunk in reverse — each chunk's tape sweep is
         independent because its input is a detached leaf; the cotangent hops
-        the boundary exactly like the reference's p2p grad send."""
+        the boundary exactly like the reference's p2p grad send.
+
+        param_ids (zero-bubble): defer these leaf params' weight grads;
+        returns the deferred W closures (empty list when param_ids is None)."""
         from ..autograd.backward import backward as _backward
         pinned = bool(getattr(self._layers, "_chunk_device", None))
+        kw = {"defer_param_ids": param_ids} if param_ids else {}
+        deferred = []
         cots = None          # aligned with _boundary_leaves of chunk c's output
         for c in reversed(range(len(boundaries))):
             leaf_struct, out_struct = boundaries[c]
             if c == len(boundaries) - 1:
                 l = scaler.scale(loss) if scaler is not None else loss
-                _backward([l], [None])
+                res = _backward([l], [None], **kw)
             else:
                 outs = _boundary_leaves(out_struct)
                 pairs = [(o, g) for o, g in zip(outs, cots) if g is not None]
                 if not pairs:
                     raise RuntimeError(
                         f"pipeline chunk {c + 1} produced no input gradient")
-                _backward([o for o, _ in pairs], [g for _, g in pairs])
+                res = _backward([o for o, _ in pairs], [g for _, g in pairs],
+                                **kw)
+            if param_ids and res:
+                deferred.extend(res)
             if c > 0:
                 leaves = _boundary_leaves(leaf_struct)
                 prev_outs = _boundary_leaves(boundaries[c - 1][1])
@@ -340,13 +348,20 @@ class PipelineParallel(Layer):
                     if g is not None and pinned:
                         g = _hop_cot(g, po)
                     cots.append(g)
+        return deferred
 
     # ---- schedules -----------------------------------------------------------
-    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None,
-                    loss_fn=None):
-        """1F1B: warmup (P-1) forwards, steady one-fwd-one-bwd, drain.
-        Peak live microbatches = min(P, M) — the 1F1B memory bound — vs
-        GPipe's M (reference forward_backward_pipeline:575)."""
+    def _train_batch_impl(self, data, optimizer, lr_scheduler, scaler, loss_fn,
+                          param_ids=None):
+        """Shared 1F1B loop: warmup (P-1) forwards, steady one-fwd-one-bwd,
+        drain. Peak live microbatches = min(P, M) — the 1F1B memory bound — vs
+        GPipe's M (reference forward_backward_pipeline:575).
+
+        With param_ids set (ZB-H1), each backward is B-only; its deferred dW
+        closures queue per-microbatch, and the queue is drained FIFO whenever
+        it exceeds the P-microbatch window — so W work fills the bubble right
+        after the stage's critical-path B's, and residual memory stays within
+        the ZB-H1 bound instead of growing O(accumulate_steps)."""
         self.train()
         inputs, labels = data
         n = self.accumulate_steps
@@ -354,7 +369,14 @@ class PipelineParallel(Layer):
         micro_y = self._split_micro(labels, n)
         P = self.num_stages
         in_flight = deque()
+        w_queue = deque()                     # per-microbatch deferred-W lists
         self.max_in_flight = 0
+        self.w_deferred_total = 0
+
+        def run_oldest_w():
+            for w in w_queue.popleft():
+                w()
+
         total = None
         for m in range(n):
             boundaries, loss = self._forward_micro(micro_x[m], micro_y[m],
@@ -364,9 +386,22 @@ class PipelineParallel(Layer):
             in_flight.append((boundaries, loss))
             self.max_in_flight = max(self.max_in_flight, len(in_flight))
             if len(in_flight) >= P:           # steady state: 1F1B
-                self._backward_micro(*in_flight.popleft(), scaler=scaler)
-        while in_flight:                      # drain
-            self._backward_micro(*in_flight.popleft(), scaler=scaler)
+                b, l = in_flight.popleft()
+                ws = self._backward_micro(b, l, scaler=scaler,
+                                          param_ids=param_ids)
+                if ws:
+                    w_queue.append(ws)
+                    self.w_deferred_total += len(ws)
+                while len(w_queue) > P:       # ZB-H1 residual window
+                    run_oldest_w()
+        while in_flight:                      # drain: B's are the critical path
+            b, l = in_flight.popleft()
+            ws = self._backward_micro(b, l, scaler=scaler, param_ids=param_ids)
+            if ws:
+                w_queue.append(ws)
+                self.w_deferred_total += len(ws)
+        while w_queue:                        # bubble fill: remaining dW
+            run_oldest_w()
         if scaler is not None:
             scaler.step(optimizer)
         else:
@@ -375,6 +410,11 @@ class PipelineParallel(Layer):
         if lr_scheduler is not None:
             lr_scheduler.step()
         return total
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None,
+                    loss_fn=None):
+        return self._train_batch_impl(data, optimizer, lr_scheduler, scaler,
+                                      loss_fn)
 
     def eval_batch(self, data, compute_loss=True):
         self.eval()
@@ -393,6 +433,33 @@ class PipelineParallel(Layer):
 
     def parameters(self, *a, **k):
         return self._layers.parameters(*a, **k)
+
+
+class ZeroBubblePipelineParallel(PipelineParallel):
+    """Zero-bubble (ZB-H1) schedule (reference: distributed/passes/
+    pipeline_scheduler_pass/pipeline_zero_bubble.py).
+
+    The reference splits each backward op into B (grad-input, on the critical
+    path — the upstream stage waits for it) and W (grad-weight, off the
+    critical path) and sinks W into the drain-phase bubble, eliminating the
+    tail bubble of 1F1B. Here the split happens at the tape level:
+    ``backward_split`` propagates activation cotangents immediately and
+    returns deferred W closures, which this schedule runs only during the
+    drain — so each stage's device queue sees F/B work first and fills its
+    idle tail with dW, exactly the ZB-H1 op ordering.
+
+    Numerics are identical to 1F1B (same grads, different order); the
+    deferred-W residuals are drained on a P-microbatch window so peak memory
+    stays within the ZB-H1 bound (1F1B activations + one window of dW
+    residuals), not O(accumulate_steps)."""
+
+    schedule_mode = "ZB-H1"
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None,
+                    loss_fn=None):
+        param_ids = {id(p) for p in self._layers.parameters()}
+        return self._train_batch_impl(data, optimizer, lr_scheduler, scaler,
+                                      loss_fn, param_ids=param_ids)
 
 
 def interleave_schedule(num_micro, num_stages, num_virtual, rank):
