@@ -111,7 +111,7 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
         else:
             root_cots.append(_const(jnp.asarray(g)))
     if not roots:
-        return
+        return [] if defer_param_ids is not None else None
 
     # --- discover reachable subgraph & count consumer edges per node ---------
     dep = defaultdict(int)     # producer node -> #pending consumer edges

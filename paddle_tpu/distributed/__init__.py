@@ -12,6 +12,10 @@ from .parallel import DataParallel  # noqa: F401
 from .auto_parallel.api import (ProcessMesh, shard_tensor, reshard, shard_layer,  # noqa: F401
                                 dtensor_from_fn, unshard_dtensor)
 from .auto_parallel.placement import (Placement, Replicate, Shard, Partial)  # noqa: F401
+from .auto_parallel import (parallelize, to_distributed, Engine, Strategy,  # noqa: F401
+                            ColWiseParallel, RowWiseParallel,
+                            SequenceParallelBegin, SequenceParallelEnd,
+                            SequenceParallelEnable)
 from .watchdog import CommTaskManager  # noqa: F401
 from .collective import (all_reduce, all_gather, all_gather_object, reduce,  # noqa: F401
                          broadcast, scatter, all_to_all, reduce_scatter,

@@ -2,3 +2,9 @@ from .api import (ProcessMesh, shard_tensor, reshard, shard_layer, set_mesh,  # 
                   get_mesh, dtensor_from_fn, unshard_dtensor, shard_optimizer,
                   local_map, get_placements, get_process_mesh)
 from .placement import Placement, Replicate, Shard, Partial  # noqa: F401
+from .intermediate import (parallelize, ColWiseParallel, RowWiseParallel,  # noqa: F401
+                           SequenceParallelBegin, SequenceParallelEnd,
+                           SequenceParallelEnable, PrepareLayerInput,
+                           PrepareLayerOutput)
+from .engine import Engine, Strategy  # noqa: F401
+from .high_level_api import to_distributed  # noqa: F401

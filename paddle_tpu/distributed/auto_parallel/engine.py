@@ -1,0 +1,228 @@
+"""Auto-parallel Strategy + Engine (reference: python/paddle/distributed/
+auto_parallel/api.py:1886 `Strategy`; static/engine.py:99 `Engine`, fit:1533).
+
+The reference Engine lowers the model to a static distributed program
+(completion → partition → reshard passes) and drives it with an executor.
+TPU-native: the "distributed program" is one jit-compiled XLA module — the
+train step (forward + backward + optimizer update, with GSPMD shardings from
+the parameters' NamedShardings) is captured via paddle_tpu.jit.to_static, and
+the per-rank partitioning/reshard insertion is XLA's SPMD partitioner.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...core.dispatch import unwrap
+from .api import ProcessMesh, get_mesh, shard_tensor
+from .placement import Shard, Replicate
+
+
+class _Config:
+    """Attribute-dict config node (mirrors the reference's Strategy sub-config
+    objects, auto_parallel/strategy.py)."""
+
+    def __init__(self, **defaults):
+        self.__dict__.update(defaults)
+
+    def __repr__(self):
+        return f"_Config({self.__dict__})"
+
+
+class Strategy:
+    """reference auto_parallel/api.py:1886 — configuration bundle for
+    parallelization choices. Fields mirror the reference's sub-configs:
+
+      strategy.sharding.{enable, degree, stage}
+      strategy.amp.{enable, dtype, level}
+      strategy.recompute.{enable}
+      strategy.pipeline.{enable, schedule_mode, micro_batch_size,
+                         accumulate_steps}
+      strategy.gradient_merge.{enable, k_steps}
+      strategy.dataset.{micro_batch_size}
+    """
+
+    def __init__(self, config=None):
+        config = config or {}
+
+        def sub(key, **defaults):
+            defaults.update(config.get(key, {}))
+            return _Config(**defaults)
+
+        self.sharding = sub("sharding", enable=False, degree=-1, stage=1)
+        self.amp = sub("amp", enable=False, dtype="float16", level="O1")
+        self.recompute = sub("recompute", enable=False)
+        self.pipeline = sub("pipeline", enable=False, schedule_mode="1F1B",
+                            micro_batch_size=1, accumulate_steps=1)
+        self.gradient_merge = sub("gradient_merge", enable=False, k_steps=1)
+        self.fused_passes = sub("fused_passes", enable=False,
+                                fused_passes_list=[])
+
+    def __repr__(self):
+        return (f"Strategy(sharding={self.sharding}, amp={self.amp}, "
+                f"pipeline={self.pipeline})")
+
+
+class Engine:
+    """reference static/engine.py:99. fit/evaluate/predict drive a compiled
+    train/eval/predict step; `to_static=False` mode (dygraph fallback) runs the
+    same step eagerly — useful when Python control flow graph-breaks."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy=None, mesh=None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else \
+            ([metrics] if metrics else [])
+        self._strategy = strategy or Strategy()
+        mesh = mesh or get_mesh()
+        if mesh is not None and not isinstance(mesh, ProcessMesh):
+            # accept a raw jax.sharding.Mesh like parallelize/to_distributed do
+            shape = mesh.devices.shape
+            ids = np.arange(int(np.prod(shape))).reshape(shape)
+            mesh = ProcessMesh(ids, list(mesh.axis_names))
+        self._mesh = mesh
+        self._compiled = {}         # mode -> compiled step
+        self.history = {"loss": []}
+
+    # ---- data placement ------------------------------------------------------
+    def _dp_axis(self):
+        if self._mesh is None:
+            return None
+        names = self._mesh.dim_names
+        for cand in ("dp", "data", "x"):
+            if cand in names:
+                return cand
+        return names[0]
+
+    def _place_batch(self, t):
+        """Shard the batch dim over the dp axis; replicate elsewhere (the
+        reference's dist dataloader does the same split per rank)."""
+        if self._mesh is None:
+            return t if isinstance(t, Tensor) else Tensor(np.asarray(t))
+        t = t if isinstance(t, Tensor) else Tensor(np.asarray(t))
+        axis = self._dp_axis()
+        nd = self._mesh.get_dim_size(axis)
+        if t.ndim == 0 or t.shape[0] % nd != 0:
+            placements = [Replicate() for _ in self._mesh.dim_names]
+        else:
+            placements = [Shard(0) if n == axis else Replicate()
+                          for n in self._mesh.dim_names]
+        return shard_tensor(t, self._mesh, placements,
+                            stop_gradient=t.stop_gradient)
+
+    # ---- steps ---------------------------------------------------------------
+    def _train_step(self, x, y):
+        self._model.train()
+        out = self._model(x)
+        loss = self._loss(out, y) if self._loss is not None else out
+        loss.backward()
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        return loss
+
+    def _get_step(self, mode):
+        if mode in self._compiled:
+            return self._compiled[mode]
+        if mode == "train":
+            from ...jit import to_static
+            step = to_static(self._train_step)
+        elif mode == "eval":
+            def step(x, y):
+                self._model.eval()
+                out = self._model(x)
+                return self._loss(out, y) if self._loss is not None else out
+        else:
+            def step(x):
+                self._model.eval()
+                return self._model(x)
+        self._compiled[mode] = step
+        return step
+
+    @staticmethod
+    def _iter_batches(data, batch_size, steps=None):
+        from ...io import DataLoader
+        if isinstance(data, DataLoader):
+            it = iter(data)
+        elif isinstance(data, (tuple, list)) and len(data) == 2 and \
+                hasattr(data[0], "shape"):
+            xs, ys = np.asarray(data[0]), np.asarray(data[1])
+
+            def gen():   # tail remainder included (partial batch replicates)
+                for i in range(0, len(xs), batch_size):
+                    yield xs[i:i + batch_size], ys[i:i + batch_size]
+            it = gen()
+        else:
+            it = iter(DataLoader(data, batch_size=batch_size))
+        for k, batch in enumerate(it):
+            if steps is not None and k >= steps:
+                return
+            yield batch
+
+    # ---- public API ----------------------------------------------------------
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
+        """Warm the compile cache for `mode` from specs (reference
+        Engine.prepare builds the static program up front)."""
+        if inputs_spec is None:
+            return self
+        x = Tensor(np.zeros(inputs_spec.shape, dtype=inputs_spec.dtype))
+        if mode == "predict":
+            self._get_step(mode)(self._place_batch(x))
+        elif labels_spec is not None:
+            y = Tensor(np.zeros(labels_spec.shape, dtype=labels_spec.dtype))
+            self._get_step(mode)(self._place_batch(x), self._place_batch(y))
+        return self
+
+    def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,
+            valid_data=None, log_freq=0, verbose=0):
+        step = self._get_step("train")
+        for epoch in range(epochs):
+            for k, (bx, by) in enumerate(
+                    self._iter_batches(train_data, batch_size, steps_per_epoch)):
+                loss = step(self._place_batch(bx), self._place_batch(by))
+                lv = float(unwrap(loss.detach() if hasattr(loss, "detach")
+                                  else loss).mean())
+                self.history["loss"].append(lv)
+                if log_freq and k % log_freq == 0 and verbose:
+                    print(f"[Engine] epoch {epoch} step {k}: loss={lv:.5f}")
+            if valid_data is not None:
+                self.evaluate(valid_data, batch_size=batch_size)
+        return self.history
+
+    def evaluate(self, valid_data, batch_size=1, steps=None):
+        step = self._get_step("eval")
+        losses = []
+        for bx, by in self._iter_batches(valid_data, batch_size, steps):
+            loss = step(self._place_batch(bx), self._place_batch(by))
+            losses.append(float(unwrap(loss).mean()))
+        out = {"loss": float(np.mean(losses)) if losses else float("nan")}
+        self.history.setdefault("eval_loss", []).append(out["loss"])
+        return out
+
+    def predict(self, test_data, batch_size=1, steps=None):
+        step = self._get_step("predict")
+        outs = []
+        for batch in self._iter_batches(
+                test_data if not (isinstance(test_data, (tuple, list)) and
+                                  len(test_data) == 2)
+                else (test_data[0], test_data[0]), batch_size, steps):
+            bx = batch[0] if isinstance(batch, (tuple, list)) else batch
+            out = step(self._place_batch(bx))
+            outs.append(np.asarray(unwrap(out)))
+        return outs
+
+    def save(self, path, training=True):
+        from ...framework.io import save
+        state = {"model": self._model.state_dict()}
+        if training and self._optimizer is not None:
+            state["optimizer"] = self._optimizer.state_dict()
+        save(state, path)
+
+    def load(self, path):
+        from ...framework.io import load
+        state = load(path)
+        self._model.set_state_dict(state["model"])
+        if self._optimizer is not None and "optimizer" in state:
+            self._optimizer.set_state_dict(state["optimizer"])
+        return self

@@ -1,0 +1,66 @@
+"""to_distributed — fully-automatic placement (reference: python/paddle/
+distributed/auto_parallel/high_level_api.py:253).
+
+The reference analyzes the model structure and picks a parallelization plan
+(TP for attention/MLP projections, vocab-sharded embeddings, DP/sharding for
+the rest). TPU-native: the same structural heuristics, realized as
+NamedSharding placements; GSPMD does the rest. Deterministic and inspectable:
+returns the applied plan alongside the model via `model._dist_plan`.
+"""
+from __future__ import annotations
+
+import re
+
+from .api import ProcessMesh, get_mesh
+from .intermediate import ColWiseParallel, RowWiseParallel, parallelize
+
+# projection-name heuristics mirroring the reference's plan detection
+# (high_level_api.py matches q/k/v/gate/up → colwise, o/out/down → rowwise)
+_COLWISE_PAT = re.compile(
+    r"(^|\.)((q|k|v|qkv)_?proj|query|key|value|gate_proj|up_proj|fc1|w1|w3|"
+    r"in_proj|wi)$")
+_ROWWISE_PAT = re.compile(
+    r"(^|\.)((o|out)_?proj|dense|gate_up_down|down_proj|fc2|w2|wo)$")
+_EMBED_PAT = re.compile(r"(^|\.)(embed\w*|wte|word_embeddings?)$")
+
+
+def to_distributed(model, optimizer=None, mesh=None, config=None):
+    """Inspect `model`, build a TP+FSDP plan from layer names/shapes, apply it.
+
+    config keys (all optional): {"mp_axis": str, "dp_axis": str,
+    "sharding_level": int (default 3 when a dp axis exists)}.
+    Returns (model, optimizer, plan_dict)."""
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        raise ValueError("to_distributed needs a mesh "
+                         "(or dist.auto_parallel.set_mesh)")
+    jmesh = mesh.jax_mesh() if isinstance(mesh, ProcessMesh) else mesh
+    names = list(jmesh.axis_names)
+    config = dict(config or {})
+    mp_axis = config.get("mp_axis", "mp" if "mp" in names else names[-1])
+    dp_axis = config.get("dp_axis", "dp" if "dp" in names else names[0])
+    sizes = dict(zip(jmesh.axis_names, jmesh.devices.shape))
+    nmp = sizes.get(mp_axis, 1)
+
+    plan = {}
+    for lname, layer in model.named_sublayers(include_self=False):
+        w = getattr(layer, "weight", None)
+        if w is None or w.ndim != 2:
+            continue
+        if _EMBED_PAT.search(lname) and w.shape[0] % nmp == 0:
+            plan[lname] = ColWiseParallel()       # vocab-dim shard
+        elif _COLWISE_PAT.search(lname) and w.shape[1] % nmp == 0:
+            plan[lname] = ColWiseParallel()
+        elif _ROWWISE_PAT.search(lname) and w.shape[0] % nmp == 0:
+            plan[lname] = RowWiseParallel()
+
+    level = int(config.get("sharding_level",
+                           3 if sizes.get(dp_axis, 1) > 1 else 0))
+    model, optimizer = parallelize(
+        model, optimizer, mesh,
+        {"mp_config": {"parallelize_plan": plan} if plan else None,
+         "dp_config": {"sharding_level": level}})
+    model._dist_plan = {"tp": {k: type(v).__name__ for k, v in plan.items()},
+                        "mp_axis": mp_axis, "dp_axis": dp_axis,
+                        "sharding_level": level}
+    return model, optimizer, model._dist_plan
