@@ -195,7 +195,12 @@ def main():
     meas_peak = _measure_peak(jax)
     spec_peak = _spec_peak(dev.device_kind, on_tpu)
 
-    cfg = GPT2Config.gpt2_small(hidden_dropout_prob=0.0, attention_dropout_prob=0.0) \
+    # loss_chunk_size streams the tied-head CE in [chunk, V] tiles instead of
+    # materializing [B*S, V] logits — the loss path was the OOM wall that
+    # capped round-2 at batch=4 (MFU 0.19); chunking buys batch 16+
+    cfg = GPT2Config.gpt2_small(hidden_dropout_prob=0.0,
+                                attention_dropout_prob=0.0,
+                                loss_chunk_size=4096) \
         if on_tpu else GPT2Config.tiny(hidden_dropout_prob=0.0,
                                        attention_dropout_prob=0.0,
                                        max_position_embeddings=256)
@@ -205,7 +210,8 @@ def main():
     # leaves compiled programs/optimizer state behind that would poison the
     # smaller retries in-process (round-2 lesson: batch=2 fits standalone but
     # OOM'd after the batch=8 attempt).
-    shapes = [(8, 1024), (4, 1024), (2, 512)] if on_tpu else [(2, 128)]
+    shapes = [(32, 1024), (16, 1024), (8, 1024), (4, 1024), (2, 512)] \
+        if on_tpu else [(2, 128)]
     geom = os.environ.get("BENCH_GEOMETRY")
     if geom:                                  # child: run one geometry
         batch, seqlen = (int(v) for v in geom.split("x"))

@@ -57,6 +57,7 @@ class Strategy:
         self.gradient_merge = sub("gradient_merge", enable=False, k_steps=1)
         self.fused_passes = sub("fused_passes", enable=False,
                                 fused_passes_list=[])
+        self.dataset = sub("dataset", micro_batch_size=1)
 
     def __repr__(self):
         return (f"Strategy(sharding={self.sharding}, amp={self.amp}, "
@@ -78,10 +79,14 @@ class Engine:
         self._strategy = strategy or Strategy()
         mesh = mesh or get_mesh()
         if mesh is not None and not isinstance(mesh, ProcessMesh):
-            # accept a raw jax.sharding.Mesh like parallelize/to_distributed do
+            # accept a raw jax.sharding.Mesh like parallelize/to_distributed
+            # do — keep the caller's device array verbatim (a permuted /
+            # topology-aware layout must not be rebuilt from jax.devices())
             shape = mesh.devices.shape
             ids = np.arange(int(np.prod(shape))).reshape(shape)
-            mesh = ProcessMesh(ids, list(mesh.axis_names))
+            pm = ProcessMesh(ids, list(mesh.axis_names))
+            pm._jax_mesh = mesh
+            mesh = pm
         self._mesh = mesh
         self._compiled = {}         # mode -> compiled step
         self.history = {"loss": []}
@@ -163,15 +168,33 @@ class Engine:
     # ---- public API ----------------------------------------------------------
     def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
         """Warm the compile cache for `mode` from specs (reference
-        Engine.prepare builds the static program up front)."""
+        Engine.prepare builds the static program up front). Side-effect-free:
+        the capture run's mutations to model weights and optimizer state are
+        rolled back (jax arrays are immutable, so the snapshot is refs)."""
         if inputs_spec is None:
             return self
+        saved_model = {k: v._data for k, v in self._model.state_dict().items()}
+        saved_opt = None
+        if mode == "train" and self._optimizer is not None:
+            saved_opt = {k: (v._data if isinstance(v, Tensor) else v)
+                         for k, v in self._optimizer.state_dict().items()}
         x = Tensor(np.zeros(inputs_spec.shape, dtype=inputs_spec.dtype))
-        if mode == "predict":
-            self._get_step(mode)(self._place_batch(x))
-        elif labels_spec is not None:
-            y = Tensor(np.zeros(labels_spec.shape, dtype=labels_spec.dtype))
-            self._get_step(mode)(self._place_batch(x), self._place_batch(y))
+        try:
+            if mode == "predict":
+                self._get_step(mode)(self._place_batch(x))
+            elif labels_spec is not None:
+                y = Tensor(np.zeros(labels_spec.shape, dtype=labels_spec.dtype))
+                self._get_step(mode)(self._place_batch(x), self._place_batch(y))
+        finally:
+            sd = self._model.state_dict()
+            for k, arr in saved_model.items():
+                if k in sd:
+                    sd[k]._data = arr
+            if saved_opt is not None:
+                osd = self._optimizer.state_dict()
+                for k, arr in saved_opt.items():
+                    if k in osd and isinstance(osd[k], Tensor):
+                        osd[k]._data = arr
         return self
 
     def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,

@@ -105,7 +105,12 @@ class ColWiseParallel(PlanBase):
 
 class RowWiseParallel(PlanBase):
     """Megatron row parallel: weight [in, out] shards the in dim on mp; bias
-    replicated (the partial-sum allreduce is GSPMD's job)."""
+    replicated (the partial-sum allreduce is GSPMD's job).
+
+    is_input_parallel is accepted for reference API compatibility only: the
+    reference uses it to decide whether to insert an input scatter, which
+    GSPMD derives from the actual input sharding here — the knob has no
+    effect."""
 
     def __init__(self, is_input_parallel=True):
         self.is_input_parallel = is_input_parallel

@@ -46,10 +46,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *, scale,
 
     @pl.when(run if causal else True)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
+        # MXU operands stay in the input dtype (bf16 native mode — f32
+        # operands would force the slow multi-pass f32 MXU path); softmax
+        # statistics and accumulation are f32
+        q = q_ref[0]
+        k = k_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT) * scale
         if causal:
             rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -61,8 +65,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *, scale,
         corr = jnp.exp(m_prev - m_new)
         l_new = l_s[:, 0] * corr + jnp.sum(p, axis=1)
         acc_s[:] = acc_s[:] * corr[:, None] + jax.lax.dot_general(
-            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
         m_s[:] = jnp.broadcast_to(m_new[:, None], m_s.shape)
         l_s[:] = jnp.broadcast_to(l_new[:, None], l_s.shape)
 
@@ -147,26 +152,29 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, dq_s, *,
 
     @pl.when(run if causal else True)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        o = o_ref[0].astype(jnp.float32)
+        # bf16 MXU operands, f32 softmax math/accumulation (see _fwd_kernel)
+        q = q_ref[0]
+        k = k_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT) * scale
         if causal:
             rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
-        dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
-                                 (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        delta = jnp.sum(do * o, axis=1)
+        dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
+        delta = jnp.sum(do.astype(jnp.float32) *
+                        o_ref[0].astype(jnp.float32), axis=1)
         ds = p * (dp - delta[:, None])
         dq_s[:] = dq_s[:] + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT) * scale
 
     @pl.when(j == nk - 1)
     def _finish():
@@ -190,27 +198,33 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref,
 
     @pl.when(run if causal else True)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        o = o_ref[0].astype(jnp.float32)
+        # bf16 MXU operands, f32 softmax math/accumulation (see _fwd_kernel)
+        q = q_ref[0]
+        k = k_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT) * scale
         if causal:
             rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
         dv_s[:] = dv_s[:] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
-                                 (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        delta = jnp.sum(do * o, axis=1)
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
+        dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
+        delta = jnp.sum(do.astype(jnp.float32) *
+                        o_ref[0].astype(jnp.float32), axis=1)
         ds = p * (dp - delta[:, None])
         dk_s[:] = dk_s[:] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT) * scale
 
     @pl.when(t == nt - 1)
     def _finish():
@@ -303,19 +317,28 @@ def _flash3_bwd(scale, causal, nh, nhk, bq, bk, res, do):
 _flash3.defvjp(_flash3_fwd, _flash3_bwd)
 
 
-_BLOCK_CANDIDATES = [(128, 128), (256, 128), (128, 256), (256, 256),
-                     (512, 128), (128, 512), (512, 256), (256, 512)]
+# Ordered by preference: cands[0] (the first divisibility+VMEM-viable entry)
+# is the untuned default, so large blocks lead. Measured on v5e at
+# B4/S1024/H12/D64 bf16: (512,1024) runs fwd+bwd 6x faster than (128,128) —
+# fewer grid steps amortize MXU pipeline startup, and causal block-skipping
+# still prunes the strictly-upper-triangle k blocks.
+_BLOCK_CANDIDATES = [(512, 1024), (1024, 512), (512, 512), (1024, 1024),
+                     (256, 512), (512, 256), (256, 256), (128, 256),
+                     (256, 128), (512, 128), (128, 512), (128, 128)]
 
 
 def _block_candidates(Sq, Sk, D, dtype):
     """Valid (bq, bk) choices: divisibility + a VMEM budget estimate
     (q/o/dq blocks bq*D, k/v bk*D, lse/m/l bq*128; f32 scratch; ~2x for
-    pipelining double-buffering; keep under ~12MB of the 16MB/core VMEM)."""
+    pipelining double-buffering; PLUS the bq*bk score tiles — the _dkv
+    backward materializes up to ~4 of s/p/dp/ds in f32, which dominates at
+    the large blocks; keep under ~12MB of the 16MB/core VMEM)."""
     out = []
     for bq, bk in _BLOCK_CANDIDATES:
         if Sq % bq or Sk % bk:
             continue
-        vmem = (3 * bq * D + 2 * bk * D + 3 * bq * 128) * 4 * 2
+        vmem = (3 * bq * D + 2 * bk * D + 3 * bq * 128) * 4 * 2 \
+            + 4 * bq * bk * 4
         if vmem <= 12 * 1024 * 1024:
             out.append((bq, bk))
     return out or [(BQ, BK)]

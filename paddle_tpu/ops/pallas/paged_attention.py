@@ -52,7 +52,8 @@ def _kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s, *,
             k = k_ref[0, :, h, :].astype(jnp.float32)      # [page, D]
             v = v_ref[0, :, h, :].astype(jnp.float32)
             sc = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
+                                     preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
             sc = jnp.where(valid, sc, NEG_INF)             # [group, page]
             row = slice(h * group, (h + 1) * group)
             m_prev = m_s[row, 0]
@@ -62,7 +63,8 @@ def _kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s, *,
             l_s[row, 0] = l_s[row, 0] * corr + jnp.sum(p, axis=1)
             acc_s[row, :] = acc_s[row, :] * corr[:, None] + jax.lax.dot_general(
                 p, v, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
+                preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
             m_s[row, 0] = m_new
 
     @pl.when(s == n_slots - 1)

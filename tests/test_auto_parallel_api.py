@@ -153,6 +153,41 @@ class TestEngine:
         engine.load(path)
         np.testing.assert_allclose(np.asarray(model[0].weight._buf), w0)
 
+    def test_prepare_is_side_effect_free(self):
+        """prepare() warms the compile cache without touching weights or
+        optimizer state (the reference Engine.prepare only builds programs)."""
+        class _Spec:
+            def __init__(self, shape, dtype): self.shape, self.dtype = shape, dtype
+
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 1))
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=model.parameters())
+        engine = dist.Engine(model=model, loss=nn.MSELoss(), optimizer=opt)
+        w0 = np.asarray(model[0].weight._buf).copy()
+        engine.prepare(_Spec((16, 8), "float32"), _Spec((16, 1), "float32"))
+        np.testing.assert_array_equal(np.asarray(model[0].weight._buf), w0)
+        # and the compiled step is live: fit reuses it and trains normally
+        xs, ys = self._data(32)
+        hist = engine.fit((xs, ys), epochs=1, batch_size=16)
+        assert np.isfinite(hist["loss"][-1])
+
+    def test_accepts_raw_jax_mesh(self):
+        import jax
+        from jax.sharding import Mesh
+        paddle.seed(0)
+        devs = np.asarray(jax.devices()[:8], dtype=object)[::-1]  # permuted
+        jmesh = Mesh(devs.reshape(8), axis_names=("dp",))
+        model = nn.Sequential(nn.Linear(8, 1))
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=model.parameters())
+        engine = dist.Engine(model=model, loss=nn.MSELoss(), optimizer=opt,
+                             mesh=jmesh)
+        assert engine._mesh.jax_mesh() is jmesh   # device order preserved
+        xs, ys = self._data(32)
+        out = engine.evaluate((xs, ys), batch_size=16)
+        assert np.isfinite(out["loss"])
+
     def test_strategy_fields(self):
         s = dist.Strategy({"pipeline": {"enable": True, "accumulate_steps": 4},
                            "sharding": {"enable": True, "stage": 2}})
