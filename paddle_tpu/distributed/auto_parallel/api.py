@@ -63,6 +63,16 @@ class ProcessMesh:
         from ..collective import new_group
         return new_group(self.process_ids)
 
+    @classmethod
+    def from_jax_mesh(cls, jmesh: Mesh) -> "ProcessMesh":
+        """Wrap an existing jax.sharding.Mesh, deriving process ids from the
+        actual device array (preserves permuted / topology-aware layouts —
+        rebuilding from np.arange would silently reorder devices)."""
+        ids = np.vectorize(lambda d: d.id, otypes=[np.int64])(jmesh.devices)
+        pm = cls(ids, list(jmesh.axis_names))
+        pm._jax_mesh = jmesh
+        return pm
+
     def jax_mesh(self) -> Mesh:
         if self._jax_mesh is None:
             devices = np.asarray(jax.devices(), dtype=object)

@@ -80,13 +80,8 @@ class Engine:
         mesh = mesh or get_mesh()
         if mesh is not None and not isinstance(mesh, ProcessMesh):
             # accept a raw jax.sharding.Mesh like parallelize/to_distributed
-            # do — keep the caller's device array verbatim (a permuted /
-            # topology-aware layout must not be rebuilt from jax.devices())
-            shape = mesh.devices.shape
-            ids = np.arange(int(np.prod(shape))).reshape(shape)
-            pm = ProcessMesh(ids, list(mesh.axis_names))
-            pm._jax_mesh = mesh
-            mesh = pm
+            # do, preserving the caller's device order
+            mesh = ProcessMesh.from_jax_mesh(mesh)
         self._mesh = mesh
         self._compiled = {}         # mode -> compiled step
         self.history = {"loss": []}
@@ -174,10 +169,15 @@ class Engine:
         if inputs_spec is None:
             return self
         saved_model = {k: v._data for k, v in self._model.state_dict().items()}
-        saved_opt = None
-        if mode == "train" and self._optimizer is not None:
-            saved_opt = {k: (v._data if isinstance(v, Tensor) else v)
-                         for k, v in self._optimizer.state_dict().items()}
+        saved_acc = None
+        opt = self._optimizer
+        if mode == "train" and opt is not None:
+            # snapshot BOTH values and key-sets: accumulators are created
+            # lazily inside step(), so anything new after the warm-up run is
+            # synthetic-state and must be dropped, not just restored
+            saved_acc = {name: {pid: t._data for pid, t in store.items()}
+                         for name, store in opt._accumulators.items()}
+            saved_step = opt._global_step._data
         x = Tensor(np.zeros(inputs_spec.shape, dtype=inputs_spec.dtype))
         try:
             if mode == "predict":
@@ -190,11 +190,18 @@ class Engine:
             for k, arr in saved_model.items():
                 if k in sd:
                     sd[k]._data = arr
-            if saved_opt is not None:
-                osd = self._optimizer.state_dict()
-                for k, arr in saved_opt.items():
-                    if k in osd and isinstance(osd[k], Tensor):
-                        osd[k]._data = arr
+            if saved_acc is not None:
+                opt._global_step._data = saved_step
+                for name in list(opt._accumulators):
+                    if name not in saved_acc:
+                        del opt._accumulators[name]   # lazily created: drop
+                        continue
+                    store, saved = opt._accumulators[name], saved_acc[name]
+                    for pid in list(store):
+                        if pid in saved:
+                            store[pid]._data = saved[pid]
+                        else:
+                            del store[pid]
         return self
 
     def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,

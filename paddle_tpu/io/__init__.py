@@ -312,8 +312,9 @@ class DataLoader:
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=60,
-                 worker_init_fn=None, persistent_workers=False):
+                 worker_init_fn=None, persistent_workers=False, engine="auto"):
         self.dataset = dataset
+        self.engine = engine
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
@@ -338,10 +339,49 @@ class DataLoader:
     def __iter__(self):
         if self._iterable:
             yield from self._iter_iterable()
+        # engine="native" is an explicit requirement regardless of
+        # num_workers; "auto" upgrades the worker path when eligible
+        elif (self.engine == "native" or self.num_workers > 0) and \
+                self._native_eligible():
+            yield from self._iter_native()
         elif self.num_workers == 0:
             yield from self._iter_sync()
         else:
             yield from self._iter_workers()
+
+    def _native_eligible(self):
+        """Use the C++ gather engine (core/native/dataloader.cc) when the
+        dataset is a TensorDataset of fixed-shape arrays with the default
+        collate — the common pretraining layout. engine: "auto" (default),
+        "native" (require), "python" (mp workers)."""
+        if self.engine == "python":
+            return False
+        ok = (isinstance(self.dataset, TensorDataset)
+              and self.collate_fn is default_collate_fn)
+        if ok:
+            from .native_loader import available
+            ok = available()
+        if self.engine == "native" and not ok:
+            raise RuntimeError(
+                "engine='native' requires a TensorDataset with the default "
+                "collate and a working C++ toolchain")
+        return ok
+
+    def _iter_native(self):
+        from .native_loader import NativeArrayLoader
+        if getattr(self, "_native_arrays", None) is None:
+            # one-time host materialization (device->host for device-resident
+            # tensors + contiguity), reused across epochs
+            self._native_arrays = [
+                np.ascontiguousarray(np.asarray(t._data)) if isinstance(t, Tensor)
+                else np.ascontiguousarray(t) for t in self.dataset.tensors]
+        loader = NativeArrayLoader(self._native_arrays,
+                                   list(self.batch_sampler),
+                                   num_threads=max(1, self.num_workers),
+                                   depth=self.prefetch_factor *
+                                   max(1, self.num_workers))
+        for views in loader:
+            yield [Tensor(v) for v in views]
 
     def _iter_iterable(self):
         batch = []

@@ -48,12 +48,14 @@ def _kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s, *,
             jnp.int32, (1, page_size), 1)
         valid = tok < cl                                   # [1, page_size]
         for h in range(kv_heads):
-            q = q_ref[0, h * group:(h + 1) * group, :].astype(jnp.float32)
-            k = k_ref[0, :, h, :].astype(jnp.float32)      # [page, D]
-            v = v_ref[0, :, h, :].astype(jnp.float32)
-            sc = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+            # MXU operands stay in the input dtype (bf16 native mode);
+            # softmax statistics and accumulation are f32
+            q = q_ref[0, h * group:(h + 1) * group, :]
+            k = k_ref[0, :, h, :]                          # [page, D]
+            v = v_ref[0, :, h, :]
+            sc = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.DEFAULT)
+                                     precision=jax.lax.Precision.DEFAULT) * scale
             sc = jnp.where(valid, sc, NEG_INF)             # [group, page]
             row = slice(h * group, (h + 1) * group)
             m_prev = m_s[row, 0]
@@ -62,9 +64,9 @@ def _kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s, *,
             corr = jnp.exp(m_prev - m_new)
             l_s[row, 0] = l_s[row, 0] * corr + jnp.sum(p, axis=1)
             acc_s[row, :] = acc_s[row, :] * corr[:, None] + jax.lax.dot_general(
-                p, v, (((1,), (0,)), ((), ())),
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.DEFAULT)
+                precision=jax.lax.Precision.DEFAULT)
             m_s[row, 0] = m_new
 
     @pl.when(s == n_slots - 1)

@@ -167,6 +167,10 @@ class TestEngine:
         w0 = np.asarray(model[0].weight._buf).copy()
         engine.prepare(_Spec((16, 8), "float32"), _Spec((16, 1), "float32"))
         np.testing.assert_array_equal(np.asarray(model[0].weight._buf), w0)
+        # lazily-created Adam moments from the warm-up step were dropped,
+        # and the step counter rolled back
+        assert all(not store for store in opt._accumulators.values())
+        assert int(np.asarray(opt._global_step._data)) == 0
         # and the compiled step is live: fit reuses it and trains normally
         xs, ys = self._data(32)
         hist = engine.fit((xs, ys), epochs=1, batch_size=16)
