@@ -1,3 +1,4 @@
 """paddle.incubate (reference: python/paddle/incubate) — fused layers + MoE."""
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
+from . import asp  # noqa: F401

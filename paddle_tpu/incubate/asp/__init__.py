@@ -1,0 +1,189 @@
+"""Automatic Structured Pruning (2:4 sparsity) — reference:
+python/paddle/incubate/asp/asp.py (set_excluded_layers:55, decorate:233,
+prune_model:319) and utils.py (mask generation / density).
+
+TPU-native realization: the mask IS the mechanism. The reference prunes so
+CUDA sparse-tensor-core kernels can exploit 2:4 patterns; on TPU there is no
+sparse MXU path, so ASP's value is model-compression workflows (train sparse,
+export). Masks are jnp 0/1 arrays held in a registry; `decorate` wraps
+`optimizer.step` to re-apply masks after each update, preserving the sparsity
+invariant exactly like the reference's OptimizerWithSparsityGuarantee.
+"""
+from __future__ import annotations
+
+import weakref
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ["decorate", "prune_model", "set_excluded_layers",
+           "reset_excluded_layers", "calculate_density",
+           "check_sparsity", "create_mask", "clear_masks"]
+
+# id(param) -> (weakref(param), mask); weakrefs so a discarded pruned model
+# is collectable — dead entries are purged on access
+_masks: dict[int, tuple] = {}
+_excluded: set[str] = set()
+
+
+def _live_masks():
+    dead = [k for k, (ref, _) in _masks.items() if ref() is None]
+    for k in dead:
+        del _masks[k]
+    return _masks
+
+
+def clear_masks():
+    """Drop every registered sparsity mask (masks also vanish automatically
+    when the pruned parameters are garbage-collected)."""
+    _masks.clear()
+
+
+def set_excluded_layers(layers=None, main_program=None, param_names=None):
+    """Exclude sublayers (by name) from pruning (reference asp.py:55; the
+    static-graph main_program form is accepted and ignored — there is no
+    separate static program here)."""
+    names = param_names if param_names is not None else layers
+    if names:
+        _excluded.update(names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def calculate_density(x) -> float:
+    """Fraction of nonzeros (reference utils.py calculate_density)."""
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(arr)) / max(1, arr.size)
+
+
+def _mask_1d(w: np.ndarray, n: int, m: int) -> np.ndarray:
+    """n:m along the last axis: in every group of m consecutive elements keep
+    the n largest |w| (reference utils.py get_mask_1d)."""
+    flat = w.reshape(-1, m)
+    order = np.argsort(-np.abs(flat), axis=1)[:, :n]
+    mask = np.zeros_like(flat, dtype=w.dtype)
+    np.put_along_axis(mask, order, 1.0, axis=1)
+    return mask.reshape(w.shape)
+
+
+def _mask_2d_greedy(w: np.ndarray, n: int, m: int) -> np.ndarray:
+    """2-D n:m over m x m patches: greedily keep the largest-|w| entries
+    subject to per-row AND per-column budgets of n inside each patch — both
+    directions satisfy n:m exactly (reference utils.py get_mask_2d_greedy).
+    Requires both trailing dims divisible by m."""
+    mat = w.reshape(-1, w.shape[-1])
+    R, C = mat.shape
+    if R % m or C % m:
+        raise ValueError(
+            f"mask_2d needs both matrix dims divisible by {m}, got {mat.shape}")
+    # [P, m, m] patches
+    patches = np.abs(mat).reshape(R // m, m, C // m, m).transpose(0, 2, 1, 3)
+    P = patches.reshape(-1, m, m)
+    order = np.argsort(-P.reshape(len(P), m * m), axis=1)
+    mask = np.zeros((len(P), m, m), w.dtype)
+    rowc = np.zeros((len(P), m), np.int64)
+    colc = np.zeros((len(P), m), np.int64)
+    ar = np.arange(len(P))
+    for k in range(m * m):
+        e = order[:, k]
+        r, c = e // m, e % m
+        ok = (rowc[ar, r] < n) & (colc[ar, c] < n)
+        mask[ar, r, c] = np.where(ok, 1.0, mask[ar, r, c])
+        rowc[ar, r] += ok
+        colc[ar, c] += ok
+    out = mask.reshape(R // m, C // m, m, m).transpose(0, 2, 1, 3)
+    return out.reshape(w.shape)
+
+
+_MASK_ALGOS = {"mask_1d": _mask_1d, "mask_2d_greedy": _mask_2d_greedy,
+               "mask_2d_best": _mask_2d_greedy}
+
+
+def create_mask(w, n=2, m=4, mask_algo="mask_1d") -> np.ndarray:
+    arr = np.asarray(w._data if isinstance(w, Tensor) else w, np.float32)
+    if arr.ndim < 2 or arr.shape[-1] % m != 0:
+        raise ValueError(
+            f"cannot {n}:{m}-prune shape {arr.shape}: need ndim>=2 and last "
+            f"dim divisible by {m}")
+    try:
+        fn = _MASK_ALGOS[mask_algo]
+    except KeyError:
+        raise ValueError(f"unknown mask_algo {mask_algo!r}; "
+                         f"one of {sorted(_MASK_ALGOS)}")
+    return fn(arr, n, m)
+
+
+def check_sparsity(x, n=2, m=4) -> bool:
+    """True when every m-group along the last axis has <= (m - n) zeros...
+    i.e. at most n nonzeros (reference utils.py check_mask_1d semantics)."""
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    flat = (arr != 0).reshape(-1, m)
+    return bool((flat.sum(axis=1) <= n).all())
+
+
+def _prunable(name, layer):
+    w = getattr(layer, "weight", None)
+    if w is None or w.ndim < 2:
+        return None
+    if name in _excluded or type(layer).__name__ in _excluded:
+        return None
+    return w
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Prune every supported sublayer's weight to n:m sparsity and (when
+    with_mask) register the mask so `decorate`d optimizers re-apply it after
+    each step (reference asp.py:319 prune_model)."""
+    pruned = {}
+    for name, layer in model.named_sublayers(include_self=False):
+        w = _prunable(name, layer)
+        if w is None:
+            continue
+        arr = np.asarray(w._buf, np.float32)
+        if arr.ndim > 2:
+            # conv [out, in, kh, kw] -> [out, in*kh*kw]: n:m along the
+            # flattened reduction dim (reference supported_layer_list
+            # reshapes conv weights the same way; depthwise convs whose
+            # flattened dim isn't divisible are skipped)
+            flat = arr.reshape(arr.shape[0], -1)
+        else:
+            flat = arr
+        if flat.ndim < 2 or flat.shape[-1] % m != 0 or \
+                (mask_algo != "mask_1d" and flat.shape[0] % m != 0):
+            continue
+        mask = create_mask(flat, n, m, mask_algo).reshape(arr.shape)
+        mask = jnp.asarray(mask, w._buf.dtype)
+        w._data = w._buf * mask
+        if with_mask:
+            _masks[id(w)] = (weakref.ref(w), mask)
+        pruned[name] = float(mask.mean())
+    return pruned
+
+
+class OptimizerWithSparsityGuarantee:
+    """Re-applies registered masks after every step (reference asp.py: the
+    decorated optimizer masks grads/params so pruned weights stay pruned).
+    Only THIS optimizer's parameters are touched — two decorated optimizers
+    over different models don't cross-couple."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def step(self):
+        self._optimizer.step()
+        own = {id(p) for p in self._optimizer._parameter_list}
+        for pid, (ref, mask) in list(_live_masks().items()):
+            p = ref()
+            if p is not None and pid in own:
+                p._data = p._buf * mask
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+
+def decorate(optimizer) -> OptimizerWithSparsityGuarantee:
+    return OptimizerWithSparsityGuarantee(optimizer)

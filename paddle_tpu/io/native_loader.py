@@ -4,8 +4,10 @@ Reference analog: the C++ data plane (fluid/framework/data_feed.cc, the
 DataLoader's C++ worker pool) — the reference feeds training from native
 threads, not Python. Here `NativeArrayLoader` drives the pthread gather engine
 in core/native/dataloader.cc over contiguous host arrays: workers assemble
-batch buffers ahead of consumption (bounded by `depth`), Python receives each
-batch as a zero-copy view and wraps it into Tensors.
+batch buffers ahead of consumption (bounded by `depth`). Each delivered batch
+is one native gather into the engine slot plus one memcpy out (the consumer
+owns its batches across steps, so the slot can be recycled immediately); the
+Python-side fancy-indexing and per-sample collate of the mp path are gone.
 
 Used automatically by DataLoader for TensorDataset/array datasets with
 num_workers > 0 and the default collate (engine="auto"), with the Python
@@ -118,8 +120,9 @@ class NativeArrayLoader:
         self._depth = max(1, depth)
 
     def __iter__(self):
-        engines = [_Engine(a, self._threads, self._depth)
-                   for a in self._arrays]
+        # the thread budget is TOTAL: split across the per-array engines
+        per = max(1, self._threads // len(self._arrays))
+        engines = [_Engine(a, per, self._depth) for a in self._arrays]
         err = []
 
         def feed():

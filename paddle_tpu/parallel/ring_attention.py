@@ -29,12 +29,16 @@ def _ring_attn_local(q, k, v, axis_name, causal, scale):
     n = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     B, Sq, H, D = q.shape
-    qf = q.astype(jnp.float32) * scale
 
     def attend(carry, kv_and_src):
         m_prev, l_prev, acc = carry
         (kb, vb), src_idx = kv_and_src
-        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kb.astype(jnp.float32))
+        # bf16 MXU operands + f32 accumulation (native MXU mode — upcasting
+        # operands to f32 forces the slow multi-pass path); the scale and all
+        # softmax statistics stay in f32
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kb,
+                            preferred_element_type=jnp.float32,
+                            precision=jax.lax.Precision.DEFAULT) * scale
         if causal:
             q_pos = my_idx * Sq + jnp.arange(Sq)
             k_pos = src_idx * kb.shape[1] + jnp.arange(kb.shape[1])
@@ -50,7 +54,9 @@ def _ring_attn_local(q, k, v, axis_name, causal, scale):
         corr = jnp.where(jnp.isfinite(corr), corr, 0.0)
         l_new = l_prev * corr + jnp.sum(p, axis=-1)
         acc_new = acc * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, vb.astype(jnp.float32))
+            "bhqk,bkhd->bhqd", p.astype(vb.dtype), vb,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
         return (m_new, l_new, acc_new)
 
     m0 = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)

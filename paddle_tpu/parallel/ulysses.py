@@ -57,17 +57,18 @@ def _blockwise_sdpa(q, k, v, causal, scale, block=1024):
     while S % blk:          # static divisor of S
         blk //= 2
     nk = S // blk
-    qf = q.astype(jnp.float32) * scale
-    kb = k.astype(jnp.float32).reshape(B, nk, blk, H, D).transpose(
-        1, 0, 2, 3, 4)
-    vb = v.astype(jnp.float32).reshape(B, nk, blk, H, D).transpose(
-        1, 0, 2, 3, 4)
+    # bf16 MXU operands + f32 accumulation (native MXU mode; see
+    # ring_attention) — scale and softmax statistics stay f32
+    kb = k.reshape(B, nk, blk, H, D).transpose(1, 0, 2, 3, 4)
+    vb = v.reshape(B, nk, blk, H, D).transpose(1, 0, 2, 3, 4)
     q_pos = jnp.arange(S)
 
     def body(carry, xs):
         m_prev, l_prev, acc = carry
         kc, vc, j = xs
-        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kc)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kc,
+                            preferred_element_type=jnp.float32,
+                            precision=jax.lax.Precision.DEFAULT) * scale
         if causal:
             k_pos = j * blk + jnp.arange(blk)
             mask = q_pos[:, None] >= k_pos[None, :]
@@ -77,7 +78,10 @@ def _blockwise_sdpa(q, k, v, causal, scale, block=1024):
         p = jnp.exp(logits - m_new[..., None])
         corr = jnp.exp(m_prev - m_new)
         l_new = l_prev * corr + jnp.sum(p, axis=-1)
-        acc = acc * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, vc)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p.astype(vc.dtype), vc,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
         return (m_new, l_new, acc), None
 
     init = (jnp.full((B, H, S), -jnp.inf, jnp.float32),

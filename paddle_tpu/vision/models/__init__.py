@@ -129,3 +129,11 @@ def resnet101(pretrained=False, **kwargs):
     return ResNet(BottleneckBlock, 101, **kwargs)
 from .extra import (VGG, vgg16, vgg19, MobileNetV2, mobilenet_v2,
                     AlexNet, alexnet)  # noqa: F401,E402
+from .extra2 import (DenseNet, densenet121, densenet161, densenet169,  # noqa: F401,E402
+                     densenet201, SqueezeNet, squeezenet1_0, squeezenet1_1,
+                     ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_5,
+                     shufflenet_v2_x1_0, shufflenet_v2_x1_5,
+                     shufflenet_v2_x2_0, shufflenet_v2_swish,
+                     MobileNetV1, mobilenet_v1, MobileNetV3,
+                     mobilenet_v3_large, mobilenet_v3_small,
+                     GoogLeNet, googlenet, InceptionV3, inception_v3)

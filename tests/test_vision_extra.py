@@ -40,6 +40,54 @@ class TestModelZoo:
         assert m(x).shape == [1, 10]
 
 
+class TestModelZoo3:
+    """extra2 families — exact canonical (torch) parameter counts @ 1000
+    classes, plus forward shape on the fast ones."""
+
+    def test_small_families_counts_and_forward(self):
+        from paddle_tpu.vision.models import (squeezenet1_1,
+                                              shufflenet_v2_x1_0,
+                                              mobilenet_v3_small)
+        x = paddle.to_tensor(rng.rand(1, 3, 64, 64).astype(np.float32))
+        for fn, count in [(squeezenet1_1, 1_235_496),
+                          (shufflenet_v2_x1_0, 2_278_604),
+                          (mobilenet_v3_small, 2_542_856)]:
+            m = fn()
+            m.eval()
+            assert sum(p.size for p in m.parameters()) == count, fn.__name__
+            assert m(x).shape == [1, 1000], fn.__name__
+
+    def test_mobilenet_v1_count_and_forward(self):
+        from paddle_tpu.vision.models import mobilenet_v1
+        m = mobilenet_v1()
+        m.eval()
+        assert sum(p.size for p in m.parameters()) == 4_231_976
+        x = paddle.to_tensor(rng.rand(1, 3, 64, 64).astype(np.float32))
+        assert m(x).shape == [1, 1000]
+
+    def test_densenet121_count_and_forward(self):
+        from paddle_tpu.vision.models import densenet121
+        m = densenet121()
+        m.eval()
+        assert sum(p.size for p in m.parameters()) == 7_978_856
+        x = paddle.to_tensor(rng.rand(1, 3, 64, 64).astype(np.float32))
+        assert m(x).shape == [1, 1000]
+
+    def test_googlenet_aux_heads_and_inception_count(self):
+        from paddle_tpu.vision.models import googlenet, inception_v3
+        g = googlenet(num_classes=10)
+        assert sum(p.size for p in g.parameters()) == 13_004_888 - \
+            (1000 - 10) * (1024 + 1024 + 1024 + 3)  # three heads @ 10 classes
+        g.train()
+        x = paddle.to_tensor(rng.rand(1, 3, 64, 64).astype(np.float32))
+        out, a1, a2 = g(x)
+        assert out.shape == [1, 10] and a1.shape == [1, 10] and a2.shape == [1, 10]
+        g.eval()
+        assert g(x).shape == [1, 10]
+        i = inception_v3()
+        assert sum(p.size for p in i.parameters()) == 23_834_568
+
+
 class TestVisionOps:
     def test_nms_matches_greedy_reference(self):
         from paddle_tpu.vision.ops import nms
