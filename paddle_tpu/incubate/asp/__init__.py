@@ -99,8 +99,46 @@ def _mask_2d_greedy(w: np.ndarray, n: int, m: int) -> np.ndarray:
     return out.reshape(w.shape)
 
 
+_best_patterns: dict = {}
+
+
+def _patterns_2d(n: int, m: int) -> np.ndarray:
+    """All m x m 0/1 patterns with every row AND column summing to exactly n
+    (for 2:4 that's 90 patterns), flattened to [P, m*m]. Cached per (n, m)."""
+    import itertools
+    key = (n, m)
+    if key not in _best_patterns:
+        rows = [np.bincount(c, minlength=m)
+                for c in itertools.combinations(range(m), n)]
+        pats = []
+        for combo in itertools.product(rows, repeat=m):
+            grid = np.stack(combo)
+            if (grid.sum(axis=0) == n).all():
+                pats.append(grid.reshape(-1))
+        _best_patterns[key] = np.stack(pats).astype(np.float32)
+    return _best_patterns[key]
+
+
+def _mask_2d_best(w: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Exhaustive per-patch optimum over all exactly-n:m-both-ways patterns
+    (reference utils.py get_mask_2d_best): for each m x m patch pick the
+    pattern maximizing the kept |w| sum. Vectorized: one [P_patterns, m*m] x
+    [m*m, n_patches] matmul + argmax."""
+    mat = w.reshape(-1, w.shape[-1])
+    R, C = mat.shape
+    if R % m or C % m:
+        raise ValueError(
+            f"mask_2d needs both matrix dims divisible by {m}, got {mat.shape}")
+    pats = _patterns_2d(n, m)                                  # [P, m*m]
+    patches = np.abs(mat).reshape(R // m, m, C // m, m).transpose(0, 2, 1, 3)
+    flat = patches.reshape(-1, m * m).astype(np.float32)       # [N, m*m]
+    best = np.argmax(pats @ flat.T, axis=0)                    # [N]
+    mask = pats[best].reshape(R // m, C // m, m, m).transpose(0, 2, 1, 3)
+    return mask.reshape(w.shape).astype(w.dtype)
+
+
 _MASK_ALGOS = {"mask_1d": _mask_1d, "mask_2d_greedy": _mask_2d_greedy,
-               "mask_2d_best": _mask_2d_greedy}
+               "mask_2d_best": _mask_2d_best}
 
 
 def create_mask(w, n=2, m=4, mask_algo="mask_1d") -> np.ndarray:
@@ -118,11 +156,19 @@ def create_mask(w, n=2, m=4, mask_algo="mask_1d") -> np.ndarray:
 
 
 def check_sparsity(x, n=2, m=4) -> bool:
-    """True when every m-group along the last axis has <= (m - n) zeros...
-    i.e. at most n nonzeros (reference utils.py check_mask_1d semantics)."""
+    """True when every m-group ALONG THE LAST AXIS has at most n nonzeros
+    (reference utils.py check_mask_1d semantics). Groups never straddle rows;
+    shapes whose last dim isn't divisible by m are simply not n:m-sparse."""
     arr = np.asarray(x._data if isinstance(x, Tensor) else x)
-    flat = (arr != 0).reshape(-1, m)
-    return bool((flat.sum(axis=1) <= n).all())
+    if arr.ndim == 0:
+        return False
+    if arr.ndim > 2:
+        arr = arr.reshape(arr.shape[0], -1)   # conv view, matching prune_model
+    if arr.shape[-1] % m != 0:
+        return False
+    rows = (arr != 0).reshape(-1, arr.shape[-1])
+    groups = rows.reshape(rows.shape[0], -1, m)
+    return bool((groups.sum(axis=2) <= n).all())
 
 
 def _prunable(name, layer):

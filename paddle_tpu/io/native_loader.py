@@ -120,9 +120,14 @@ class NativeArrayLoader:
         self._depth = max(1, depth)
 
     def __iter__(self):
-        # the thread budget is TOTAL: split across the per-array engines
-        per = max(1, self._threads // len(self._arrays))
-        engines = [_Engine(a, per, self._depth) for a in self._arrays]
+        # the thread budget is TOTAL, split across the per-array engines with
+        # the remainder distributed; each engine needs >= 1 thread, so more
+        # arrays than budget means a mild oversubscription by design
+        k = len(self._arrays)
+        base, rem = divmod(self._threads, k)
+        engines = [_Engine(a, max(1, base + (1 if i < rem else 0)),
+                           self._depth)
+                   for i, a in enumerate(self._arrays)]
         err = []
 
         def feed():

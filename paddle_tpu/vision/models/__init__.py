@@ -53,14 +53,16 @@ class BasicBlock(Layer):
 class BottleneckBlock(Layer):
     expansion = 4
 
-    def __init__(self, inplanes, planes, stride=1, downsample=None):
+    def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1,
+                 base_width=64):
         super().__init__()
-        self.conv1 = Conv2D(inplanes, planes, 1, bias_attr=False)
-        self.bn1 = BatchNorm2D(planes)
-        self.conv2 = Conv2D(planes, planes, 3, stride=stride, padding=1,
-                            bias_attr=False)
-        self.bn2 = BatchNorm2D(planes)
-        self.conv3 = Conv2D(planes, planes * 4, 1, bias_attr=False)
+        width = int(planes * (base_width / 64.0)) * groups
+        self.conv1 = Conv2D(inplanes, width, 1, bias_attr=False)
+        self.bn1 = BatchNorm2D(width)
+        self.conv2 = Conv2D(width, width, 3, stride=stride, padding=1,
+                            groups=groups, bias_attr=False)
+        self.bn2 = BatchNorm2D(width)
+        self.conv3 = Conv2D(width, planes * 4, 1, bias_attr=False)
         self.bn3 = BatchNorm2D(planes * 4)
         self.relu = ReLU()
         self.downsample = downsample
@@ -76,11 +78,22 @@ class BottleneckBlock(Layer):
 
 
 class ResNet(Layer):
-    def __init__(self, block, depth=50, width=64, num_classes=1000, with_pool=True):
+    """groups/width follow the torchvision convention: ResNeXt sets
+    (groups=32, width=4), wide ResNet sets width=128 (reference resnet.py
+    resnext50_32x4d / wide_resnet50_2 factories)."""
+
+    def __init__(self, block, depth=50, width=64, num_classes=1000,
+                 with_pool=True, groups=1):
         super().__init__()
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                      101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
         layers = layer_cfg[depth]
+        if block is BasicBlock and (groups != 1 or width != 64):
+            raise ValueError(
+                "BasicBlock only supports groups=1 and width=64 "
+                "(ResNeXt/wide variants need the bottleneck block)")
+        self.groups = groups
+        self.base_width = width
         self.inplanes = 64
         self.conv1 = Conv2D(3, 64, 7, stride=2, padding=3, bias_attr=False)
         self.bn1 = BatchNorm2D(64)
@@ -100,10 +113,14 @@ class ResNet(Layer):
                 Conv2D(self.inplanes, planes * block.expansion, 1, stride=stride,
                        bias_attr=False),
                 BatchNorm2D(planes * block.expansion))
-        layers = [block(self.inplanes, planes, stride, downsample)]
+        kw = {}
+        if block is BottleneckBlock and (self.groups > 1 or
+                                         self.base_width != 64):
+            kw = {"groups": self.groups, "base_width": self.base_width}
+        layers = [block(self.inplanes, planes, stride, downsample, **kw)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
-            layers.append(block(self.inplanes, planes))
+            layers.append(block(self.inplanes, planes, **kw))
         return Sequential(*layers)
 
     def forward(self, x):
@@ -127,6 +144,32 @@ def resnet50(pretrained=False, **kwargs):
 
 def resnet101(pretrained=False, **kwargs):
     return ResNet(BottleneckBlock, 101, **kwargs)
+
+
+def resnet152(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 152, **kwargs)
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 50, width=4, groups=32, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 101, width=4, groups=32, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 101, width=4, groups=64, **kwargs)
+
+
+def wide_resnet50_2(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 50, width=128, **kwargs)
+
+
+def wide_resnet101_2(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 101, width=128, **kwargs)
+
+
 from .extra import (VGG, vgg16, vgg19, MobileNetV2, mobilenet_v2,
                     AlexNet, alexnet)  # noqa: F401,E402
 from .extra2 import (DenseNet, densenet121, densenet161, densenet169,  # noqa: F401,E402

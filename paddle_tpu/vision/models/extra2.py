@@ -5,17 +5,15 @@ Standard published architectures, written against paddle_tpu.nn. NCHW.
 """
 from __future__ import annotations
 
-import math
-
 from ...nn.layer.layers import Layer
 from ...nn.layer.common import Linear, Dropout
 from ...nn.layer.conv import Conv2D
 from ...nn.layer.norm import BatchNorm2D
 from ...nn.layer.pooling import AdaptiveAvgPool2D
-from ...nn import Sequential, ReLU, MaxPool2D, AvgPool2D, Hardswish, Hardsigmoid
-from ...nn.layer.container import LayerList
+from ...nn import Sequential, ReLU, MaxPool2D, AvgPool2D, Hardswish
 from ... import ops
 from ...nn import functional as F
+from .extra import _make_divisible
 
 
 class ConvBNLayer(Layer):
@@ -328,13 +326,6 @@ def mobilenet_v1(pretrained=False, scale=1.0, **kw):
 
 
 # ---- MobileNetV3 (mobilenetv3.py) -------------------------------------------
-def _make_divisible(v, divisor=8):
-    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
-    if new_v < 0.9 * v:
-        new_v += divisor
-    return new_v
-
-
 class _SqueezeExcite(Layer):
     def __init__(self, c, r=4):
         super().__init__()
@@ -606,8 +597,9 @@ class _IncC(Layer):
 
 
 class InceptionV3(Layer):
-    """reference inceptionv3.py (aux head omitted at eval; included for
-    training parity with the reference's default)."""
+    """reference inceptionv3.py. The auxiliary classifier is NOT implemented
+    (canonical aux-free variant; param count 23,834,568 @ 1000 classes) —
+    training recipes that rely on the aux loss need to add their own head."""
 
     def __init__(self, num_classes=1000, with_pool=True):
         super().__init__()

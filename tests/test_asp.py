@@ -44,6 +44,17 @@ class TestMasks:
             asp.create_mask(rng.randn(6, 8).astype(np.float32),
                             mask_algo="mask_2d_greedy")
 
+    def test_mask_2d_best_is_optimal_and_exact(self):
+        """Exhaustive best: exactly n per m-group in BOTH directions and
+        keeps at least as much |w| as greedy."""
+        rng = np.random.RandomState(1)
+        w = rng.randn(8, 8).astype(np.float32)
+        best = asp.create_mask(w, n=2, m=4, mask_algo="mask_2d_best")
+        greedy = asp.create_mask(w, n=2, m=4, mask_algo="mask_2d_greedy")
+        assert (best.reshape(-1, 4).sum(1) == 2).all()
+        assert (best.T.reshape(-1, 4).sum(1) == 2).all()
+        assert (np.abs(w) * best).sum() >= (np.abs(w) * greedy).sum() - 1e-6
+
     def test_bad_shapes_raise(self):
         with pytest.raises(ValueError):
             asp.create_mask(np.zeros((4, 6), np.float32))   # 6 % 4 != 0

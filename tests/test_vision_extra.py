@@ -73,6 +73,16 @@ class TestModelZoo3:
         x = paddle.to_tensor(rng.rand(1, 3, 64, 64).astype(np.float32))
         assert m(x).shape == [1, 1000]
 
+    def test_resnet_variants_counts_and_forward(self):
+        from paddle_tpu.vision.models import resnext50_32x4d, wide_resnet50_2
+        m = resnext50_32x4d()
+        m.eval()
+        assert sum(p.size for p in m.parameters()) == 25_028_904
+        x = paddle.to_tensor(rng.rand(1, 3, 64, 64).astype(np.float32))
+        assert m(x).shape == [1, 1000]
+        w = wide_resnet50_2()
+        assert sum(p.size for p in w.parameters()) == 68_883_240
+
     def test_googlenet_aux_heads_and_inception_count(self):
         from paddle_tpu.vision.models import googlenet, inception_v3
         g = googlenet(num_classes=10)
