@@ -9,6 +9,7 @@ import jax.numpy as jnp
 from ..core.dispatch import _state, set_grad_enabled as _set, grad_enabled
 from ..core.tensor import Tensor
 from .backward import backward, grad
+from .functional import jacobian, hessian  # noqa: F401
 from .node import GradNode
 
 

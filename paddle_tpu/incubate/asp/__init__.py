@@ -106,7 +106,14 @@ def _patterns_2d(n: int, m: int) -> np.ndarray:
     """All m x m 0/1 patterns with every row AND column summing to exactly n
     (for 2:4 that's 90 patterns), flattened to [P, m*m]. Cached per (n, m)."""
     import itertools
+    import math as _math
     key = (n, m)
+    # the search space is C(m,n)^m row combinations — fine for the canonical
+    # 2:4 (1296 -> 90 valid), intractable beyond; refuse rather than hang
+    if _math.comb(m, n) ** m > 200_000:
+        raise ValueError(
+            f"mask_2d_best is exhaustive and infeasible for n={n}, m={m} "
+            f"(C({m},{n})^{m} candidates); use mask_2d_greedy")
     if key not in _best_patterns:
         rows = [np.bincount(c, minlength=m)
                 for c in itertools.combinations(range(m), n)]

@@ -1,0 +1,102 @@
+"""incubate.autograd — functional jvp/vjp + Jacobian/Hessian aliases
+(reference: python/paddle/incubate/autograd/__init__.py exporting jvp, vjp,
+Jacobian, Hessian from functional.py).
+
+TPU-native: vjp runs the eager tape backward with a supplied cotangent; jvp
+lifts the user function into a jax.jvp over arrays — dispatch is
+trace-transparent, so running `func` on tracer-backed Tensors records the same
+ops it would eagerly, and forward-mode AD comes from XLA for free (the
+reference implements jvp via double-vjp trickery instead).
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.tensor import Tensor
+from ..core.dispatch import unwrap
+from ..autograd.functional import jacobian as _jacobian, hessian as _hessian
+from ..autograd.backward import grad as _grad
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian"]
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def vjp(func, xs, v=None):
+    """(outputs, input-cotangents) of func at xs with output cotangent v
+    (reference incubate/autograd/functional.py vjp).
+
+    State-safe: computed via grad(only_inputs) — other leaves' .grad (e.g.
+    model parameters mid-training) are untouched, and the inputs'
+    stop_gradient/.grad are restored on exit."""
+    xs_l = _as_list(xs)
+    snap = [t.stop_gradient for t in xs_l]
+    for t in xs_l:
+        t.stop_gradient = False
+    try:
+        ys = func(*xs_l)
+        ys_l = _as_list(ys)
+        v_l = _as_list(v) if v is not None else None
+        grads = _grad(ys_l, xs_l, grad_outputs=v_l, allow_unused=True)
+    finally:
+        for t, sg in zip(xs_l, snap):
+            t.stop_gradient = sg
+    single = not isinstance(xs, (list, tuple))
+    return ys, grads[0] if single else grads
+
+
+def Jacobian(func, xs, is_batched=False):
+    """reference incubate/autograd/functional.py Jacobian: takes a CALLABLE
+    and evaluation points; returns the full jacobian Tensor (sliceable, which
+    covers the reference object's lazy-indexing surface)."""
+    xs_l = _as_list(xs)
+    snap = [t.stop_gradient for t in xs_l]
+    for t in xs_l:
+        t.stop_gradient = False
+    try:
+        ys = func(*xs_l)
+        return _jacobian(ys, xs, batch_axis=0 if is_batched else None)
+    finally:
+        for t, sg in zip(xs_l, snap):
+            t.stop_gradient = sg
+
+
+def Hessian(func, xs, is_batched=False):
+    """reference incubate/autograd/functional.py Hessian (callable-first)."""
+    xs_l = _as_list(xs)
+    snap = [t.stop_gradient for t in xs_l]
+    for t in xs_l:
+        t.stop_gradient = False
+    try:
+        ys = func(*xs_l)
+        return _hessian(ys, xs, batch_axis=0 if is_batched else None)
+    finally:
+        for t, sg in zip(xs_l, snap):
+            t.stop_gradient = sg
+
+
+def jvp(func, xs, v=None):
+    """(outputs, output-tangents) of func at xs with input tangent v —
+    true forward-mode via jax.jvp over the lifted array function."""
+    xs_l = _as_list(xs)
+    primals = [unwrap(t) for t in xs_l]
+    if v is None:
+        import jax.numpy as jnp
+        tangents = [jnp.ones_like(p) for p in primals]
+    else:
+        tangents = [unwrap(t) for t in _as_list(v)]
+
+    def afn(*arrs):
+        ts = [Tensor(a, stop_gradient=True) for a in arrs]
+        out = func(*ts)
+        out_l = _as_list(out)
+        return tuple(unwrap(o) for o in out_l)
+
+    out_arrs, tan_arrs = jax.jvp(afn, tuple(primals), tuple(tangents))
+    outs = [Tensor(a, stop_gradient=True) for a in out_arrs]
+    tans = [Tensor(a, stop_gradient=True) for a in tan_arrs]
+    if len(outs) == 1:
+        return outs[0], tans[0]
+    return outs, tans

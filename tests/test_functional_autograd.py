@@ -1,0 +1,167 @@
+"""paddle.autograd.jacobian / hessian (reference: python/paddle/autograd/
+autograd.py, exported at autograd/__init__.py:26)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import jacobian, hessian
+
+
+def _t(a, stop_gradient=False):
+    t = paddle.to_tensor(np.asarray(a, np.float32))
+    t.stop_gradient = stop_gradient
+    return t
+
+
+class TestJacobian:
+    def test_matches_analytic_linear(self):
+        A = np.array([[1., 2., 3.], [4., 5., 6.]], np.float32)
+        x = _t([1., -1., 2.])
+        y = paddle.to_tensor(A) @ x
+        J = jacobian(y, x)
+        np.testing.assert_allclose(np.asarray(J._data), A, atol=1e-6)
+
+    def test_elementwise_nonlinear(self):
+        x = _t([0.5, 1.0, 2.0])
+        y = x * x * x
+        J = jacobian(y, x)
+        np.testing.assert_allclose(np.asarray(J._data),
+                                   np.diag(3 * np.array([0.25, 1.0, 4.0])),
+                                   rtol=1e-5)
+
+    def test_multiple_xs_and_ys(self):
+        x1, x2 = _t([1.0, 2.0]), _t([3.0])
+        y1 = (x1 * 2).sum() + x2[0]
+        y2 = x1[0] * x2[0]
+        out = jacobian([y1, y2], [x1, x2])
+        np.testing.assert_allclose(np.asarray(out[0][0]._data), [[2., 2.]])
+        np.testing.assert_allclose(np.asarray(out[0][1]._data), [[1.]])
+        np.testing.assert_allclose(np.asarray(out[1][0]._data), [[3., 0.]])
+        np.testing.assert_allclose(np.asarray(out[1][1]._data), [[1.]])
+
+    def test_batched(self):
+        rng = np.random.RandomState(0)
+        xb = _t(rng.randn(4, 3))
+        yb = xb * xb          # independent per batch element
+        J = jacobian(yb, xb, batch_axis=0)
+        assert J.shape == [4, 3, 3]
+        for b in range(4):
+            np.testing.assert_allclose(
+                np.asarray(J._data)[b],
+                np.diag(2 * np.asarray(xb._data)[b]), rtol=1e-5)
+
+    def test_unused_input_gives_zeros(self):
+        x1, x2 = _t([1.0, 2.0]), _t([3.0, 4.0])
+        y = (x1 * x1).sum()
+        out = jacobian(y, [x1, x2])
+        np.testing.assert_allclose(np.asarray(out[1]._data), [[0., 0.]])
+
+
+class TestHessian:
+    def test_quadratic_form(self):
+        Q = np.array([[2., 1.], [1., 4.]], np.float32)
+        x = _t([1.0, -2.0])
+        y = 0.5 * (x @ paddle.to_tensor(Q) @ x)
+        H = hessian(y, x)
+        np.testing.assert_allclose(np.asarray(H._data), Q, atol=1e-5)
+
+    def test_matches_finite_difference(self):
+        def f(v):
+            t = _t(v)
+            return ((t * t * t).sum() + (t[0] * t[1])), t
+
+        x0 = np.array([0.7, -1.3], np.float32)
+        y, x = f(x0)
+        H = np.asarray(hessian(y, x)._data)
+        eps = 1e-3
+        num = np.zeros((2, 2))
+        for i in range(2):
+            for j in range(2):
+                xpp = x0.copy(); xpp[i] += eps; xpp[j] += eps
+                xpm = x0.copy(); xpm[i] += eps; xpm[j] -= eps
+                xmp = x0.copy(); xmp[i] -= eps; xmp[j] += eps
+                xmm = x0.copy(); xmm[i] -= eps; xmm[j] -= eps
+                def val(v):   # float64 reference (f32 FD noise swamps eps^2)
+                    v = v.astype(np.float64)
+                    return (v ** 3).sum() + v[0] * v[1]
+                num[i, j] = (val(xpp) - val(xpm) - val(xmp) + val(xmm)) / (4 * eps * eps)
+        np.testing.assert_allclose(H, num, atol=1e-2)
+
+    def test_batched_hessian(self):
+        rng = np.random.RandomState(0)
+        xb = _t(rng.randn(3, 2))
+        y = (xb * xb).sum(axis=1)     # per-batch scalar
+        H = hessian(y, xb, batch_axis=0)
+        assert H.shape == [3, 2, 2]
+        for b in range(3):
+            np.testing.assert_allclose(np.asarray(H._data)[b], 2 * np.eye(2),
+                                       atol=1e-5)
+
+    def test_non_scalar_raises(self):
+        x = _t([1.0, 2.0])
+        with pytest.raises(ValueError):
+            hessian(x * x, x)
+
+
+class TestJvpVjp:
+    def test_vjp_matches_manual(self):
+        from paddle_tpu.incubate.autograd import vjp
+        x = _t([1.0, 2.0, 3.0])
+        v = paddle.to_tensor(np.array([1.0, 0.5, 2.0], np.float32))
+        y, g = vjp(lambda t: t * t, x, v)
+        np.testing.assert_allclose(np.asarray(y._data), [1., 4., 9.])
+        np.testing.assert_allclose(np.asarray(g._data),
+                                   2 * np.array([1., 2., 3.]) *
+                                   np.array([1., 0.5, 2.]))
+
+    def test_jvp_forward_mode(self):
+        from paddle_tpu.incubate.autograd import jvp
+        x = _t([1.0, 2.0])
+        v = paddle.to_tensor(np.array([1.0, -1.0], np.float32))
+        y, t = jvp(lambda a: (a * a * a).sum(), x, v)
+        np.testing.assert_allclose(float(y._data), 9.0)
+        # d/deps sum((x+eps*v)^3) = 3x^2 . v = 3*1 - 3*4 = -9
+        np.testing.assert_allclose(float(t._data), -9.0, rtol=1e-6)
+
+    def test_vjp_leaves_other_grads_alone(self):
+        """vjp must not pollute unrelated leaves' .grad nor flip the input's
+        stop_gradient (regression: it used backward() over the whole graph)."""
+        from paddle_tpu.incubate.autograd import vjp
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        lin = nn.Linear(3, 3)
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        assert x.stop_gradient
+        _, g = vjp(lambda t: lin(t), x)
+        assert g is not None
+        assert x.stop_gradient                    # restored
+        assert all(p.grad is None for p in lin.parameters())
+
+    def test_callable_jacobian_hessian_wrappers(self):
+        from paddle_tpu.incubate.autograd import Jacobian, Hessian
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        J = Jacobian(lambda t: t * t, x)
+        np.testing.assert_allclose(np.asarray(J._data), np.diag([2., 4.]))
+        H = Hessian(lambda t: (t * t).sum(), x)
+        np.testing.assert_allclose(np.asarray(H._data), 2 * np.eye(2))
+
+    def test_mask_2d_best_refuses_large_m(self):
+        from paddle_tpu.incubate import asp
+        with pytest.raises(ValueError):
+            asp.create_mask(np.random.randn(8, 8).astype(np.float32),
+                            n=4, m=8, mask_algo="mask_2d_best")
+
+    def test_jvp_vjp_transpose_identity(self):
+        """<v, J u> == <J^T v, u> — forward and reverse mode agree."""
+        from paddle_tpu.incubate.autograd import jvp, vjp
+        rng = np.random.RandomState(0)
+        u = rng.randn(4).astype(np.float32)
+        vv = rng.randn(4).astype(np.float32)
+        W = rng.randn(4, 4).astype(np.float32)
+        f = lambda t: paddle.to_tensor(W) @ (t * t)
+        x0 = rng.randn(4).astype(np.float32)
+        _, jv = jvp(f, _t(x0.copy()), paddle.to_tensor(u))
+        _, vj = vjp(f, _t(x0.copy()), paddle.to_tensor(vv))
+        lhs = float(np.dot(vv, np.asarray(jv._data)))
+        rhs = float(np.dot(np.asarray(vj._data), u))
+        np.testing.assert_allclose(lhs, rhs, rtol=1e-4)
