@@ -1,0 +1,134 @@
+"""incubate.optimizer — LookAhead, ModelAverage (reference:
+python/paddle/incubate/optimizer/{lookahead.py,modelaverage.py}).
+
+Both are wrapper optimizers over an inner optimizer; slow weights / averages
+live as jnp arrays keyed by parameter identity, so they shard exactly like the
+parameters do under GSPMD (no host copies).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import unwrap
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """k steps of the fast (inner) optimizer, then interpolate toward the
+    slow weights: slow += alpha * (fast - slow); fast = slow
+    (reference lookahead.py LookAhead.step)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must be in [0, 1]")
+        if k < 1:
+            raise ValueError("k must be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step_num = 0
+        # slow weights seed at theta_0 (canonical Lookahead / reference
+        # lookahead.py): the FIRST sync already pulls back toward init
+        self._slow = {id(p): (p, unwrap(p))
+                      for p in inner_optimizer._parameter_list}
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k:
+            return
+        for p in self.inner_optimizer._parameter_list:
+            ent = self._slow.get(id(p))
+            fast = unwrap(p)
+            slow = ent[1] if ent is not None else fast   # late-added param
+            slow = slow + self.alpha * (fast - slow)
+            self._slow[id(p)] = (p, slow)
+            p._data = slow
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_step"] = self._step_num
+        for i, p in enumerate(self.inner_optimizer._parameter_list):
+            ent = self._slow.get(id(p))
+            if ent is not None:
+                sd[f"lookahead_slow_{i}"] = Tensor(ent[1], stop_gradient=True)
+        return sd
+
+    def set_state_dict(self, sd):
+        sd = dict(sd)
+        self._step_num = int(sd.pop("lookahead_step", 0))
+        for i, p in enumerate(self.inner_optimizer._parameter_list):
+            v = sd.pop(f"lookahead_slow_{i}", None)
+            if v is not None:
+                self._slow[id(p)] = (p, unwrap(v))
+        self.inner_optimizer.set_state_dict(sd)
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+
+class ModelAverage:
+    """Maintains a running average of parameters; `apply()` swaps the
+    averaged weights in (optionally restorable), for eval-time averaging
+    (reference modelaverage.py — the EMA-style min/max_average_window
+    windowing reduces to a plain running mean over the retained window)."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = list(parameters or [])
+        self._rate = average_window_rate
+        self._min_w = min_average_window
+        self._max_w = max_average_window
+        self._sum = {id(p): jnp.zeros_like(unwrap(p)) for p in self._params}
+        self._cnt = 0
+        self._total = 0
+        self._backup = None
+
+    def _window(self):
+        """Effective window (reference modelaverage.py): grows as
+        rate * total_updates, clamped to [min_average_window,
+        max_average_window]."""
+        grown = int(self._rate * max(self._total, 1))
+        return max(self._min_w, min(self._max_w, max(grown, 1)))
+
+    def step(self):
+        """Accumulate the current weights (call after optimizer.step)."""
+        self._total += 1
+        if self._cnt >= self._window():
+            # restart the window, keeping the current average as the seed
+            for p in self._params:
+                self._sum[id(p)] = self._sum[id(p)] / max(self._cnt, 1)
+            self._cnt = 1
+        for p in self._params:
+            self._sum[id(p)] = self._sum[id(p)] + unwrap(p)
+        self._cnt += 1
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged weights into the model (context-manager friendly)."""
+        if self._cnt == 0:
+            return self
+        self._backup = {id(p): unwrap(p) for p in self._params}
+        for p in self._params:
+            p._data = (self._sum[id(p)] / self._cnt).astype(unwrap(p).dtype)
+        if not need_restore:
+            self._backup = None
+        return self
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._params:
+            p._data = self._backup[id(p)]
+        self._backup = None
+
+    def __enter__(self):
+        return self.apply()
+
+    def __exit__(self, *exc):
+        self.restore()

@@ -48,19 +48,37 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
     return dropout(x, p, axis=ax, training=training)
 
 
-def alpha_dropout(x, p=0.5, training=True, name=None):
-    if not training or p == 0.0:
-        return x
+def _alpha_dropout_impl(x, p, name, mask_shape_of):
+    """Shared SELU-preserving dropout: mask_shape_of(a) -> bernoulli mask
+    shape (full shape = element dropout; [N, C, 1, ...] = feature dropout)."""
     key = next_key()
     alpha = 1.6732632423543772
     scale = 1.0507009873554805
     alpha_p = -alpha * scale
+
     def f(a):
-        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        keep = jax.random.bernoulli(key, 1.0 - p, mask_shape_of(a))
         A = (1.0 / np.sqrt((1 - p) * (1 + p * alpha_p ** 2)))
         B = -A * p * alpha_p
         return A * jnp.where(keep, a, jnp.asarray(alpha_p, a.dtype)) + B
-    return apply_op("alpha_dropout", f, x)
+    return apply_op(name, f, x)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    return _alpha_dropout_impl(x, p, "alpha_dropout", lambda a: a.shape)
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout of ENTIRE channels (dim 1): the SELU-preserving transform
+    applied with a per-(sample, channel) keep mask (reference/torch
+    FeatureAlphaDropout semantics)."""
+    if not training or p == 0.0:
+        return x
+    return _alpha_dropout_impl(
+        x, p, "feature_alpha_dropout",
+        lambda a: a.shape[:2] + (1,) * (a.ndim - 2))
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):

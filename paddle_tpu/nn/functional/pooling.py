@@ -49,8 +49,77 @@ def _pool(x, kernel, stride, padding, n, data_format, reducer, init, name,
     return apply_op(name, f, x)
 
 
+def _max_pool_with_mask(x, kernel_size, stride, padding, n, name,
+                        ceil_mode=False):
+    """(pooled, argmax-mask): mask holds the flat spatial index into the
+    INPUT per window (reference max_pool*_with_index kernels; consumed by
+    max_unpool*). NCHW/NCL only. Padded positions can never win (they are
+    -inf), so indices always point at real input elements."""
+    from ...core.dispatch import apply_op as _apply
+    ks = _tuple(kernel_size, n)
+    st = _tuple(stride if stride is not None else kernel_size, n)
+    pad = _padding(padding, n)
+    if isinstance(pad, str):
+        raise NotImplementedError("return_mask needs explicit int padding")
+
+    def f(a):
+        if n == 1:
+            a4 = a[..., None]                     # NCL -> NCL1
+            ks2, st2 = ks + (1,), st + (1,)
+            pad2 = list(pad) + [(0, 0)]
+        else:
+            a4, ks2, st2, pad2 = a, ks, st, list(pad)
+        if ceil_mode:
+            # extend the hi padding so the trailing partial window survives
+            # (the added positions are out-of-bounds -> masked invalid)
+            pad2 = list(pad2)
+            for i in range(2):
+                size = a4.shape[2 + i] + pad2[i][0] + pad2[i][1]
+                rem = (size - ks2[i]) % st2[i]
+                if rem:
+                    pad2[i] = (pad2[i][0], pad2[i][1] + st2[i] - rem)
+        N, C, H, W = a4.shape
+        # [N, C*kh*kw, Ho, Wo] window patches (channel-major ordering)
+        patches = jax.lax.conv_general_dilated_patches(
+            a4.astype(jnp.float32), ks2, st2,
+            padding=[(p[0], p[1]) for p in pad2] if not isinstance(pad2, str)
+            else pad2,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            precision=jax.lax.Precision.DEFAULT)
+        Ho, Wo = patches.shape[-2:]
+        patches = patches.reshape(N, C, ks2[0] * ks2[1], Ho, Wo)
+        # neutralize padding contributions
+        neg = jnp.asarray(-jnp.inf, patches.dtype)
+        # rebuild padded-validity per window position
+        rel = jnp.arange(ks2[0] * ks2[1])
+        rh, rw = rel // ks2[1], rel % ks2[1]
+        h0 = jnp.arange(Ho) * st2[0] - (0 if isinstance(pad2, str) else pad2[0][0])
+        w0 = jnp.arange(Wo) * st2[1] - (0 if isinstance(pad2, str) else pad2[1][0])
+        hh = h0[None, :, None] + rh[:, None, None]        # [K, Ho, 1]
+        ww = w0[None, None, :] + rw[:, None, None]        # [K, 1, Wo]
+        valid = (hh >= 0) & (hh < H) & (ww >= 0) & (ww < W)
+        patches = jnp.where(valid[None, None], patches, neg)
+        arg = jnp.argmax(patches, axis=2)                 # [N, C, Ho, Wo]
+        out = jnp.max(patches, axis=2).astype(a.dtype)
+        h_abs = h0[None, None, :, None] + arg // ks2[1]
+        w_abs = w0[None, None, None, :] + arg % ks2[1]
+        mask = (h_abs * W + w_abs).astype(jnp.int32)
+        if n == 1:
+            return out[..., 0], mask[..., 0]
+        return out, mask
+
+    out, mask = _apply(name + "_with_mask", f, x)
+    mask.stop_gradient = True
+    return out, mask
+
+
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
+    if return_mask:
+        if data_format != "NCL":
+            raise ValueError("return_mask supports NCL only")
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 1,
+                                   "max_pool1d", ceil_mode)
     df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
     return _pool(x, kernel_size, stride, padding, 1, df, "max", None, "max_pool1d",
                  ceil_mode)
@@ -58,14 +127,68 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        if data_format != "NCHW":
+            raise ValueError("return_mask supports NCHW only")
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 2,
+                                   "max_pool2d", ceil_mode)
     return _pool(x, kernel_size, stride, padding, 2, data_format, "max", None,
                  "max_pool2d", ceil_mode)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        raise NotImplementedError("max_pool3d return_mask")
     return _pool(x, kernel_size, stride, padding, 3, data_format, "max", None,
                  "max_pool3d", ceil_mode)
+
+
+def _unpool_size(in_sp, kernel, stride, padding, output_size):
+    if output_size is not None:
+        return tuple(int(v) for v in output_size[-len(kernel):]) \
+            if len(output_size) >= len(kernel) else tuple(output_size)
+    return tuple((i - 1) * s - 2 * p[0] + k for i, k, s, p in
+                 zip(in_sp, kernel, stride, padding))
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """Inverse of max_pool2d(return_mask=True): scatter pooled values back to
+    their argmax positions (reference: phi unpool kernel / F.max_unpool2d)."""
+    if data_format != "NCHW":
+        raise ValueError("max_unpool2d supports NCHW only")
+    ks = _tuple(kernel_size, 2)
+    st = _tuple(stride if stride is not None else kernel_size, 2)
+    pad = _padding(padding, 2)
+
+    def f(a, idx):
+        N, C, Ho, Wo = a.shape
+        H, W = _unpool_size((Ho, Wo), ks, st, pad, output_size)
+        flat = jnp.zeros((N, C, H * W), a.dtype)
+        ii = jnp.arange(N)[:, None, None]
+        cc = jnp.arange(C)[None, :, None]
+        out = flat.at[ii, cc, idx.reshape(N, C, -1)].set(
+            a.reshape(N, C, -1))
+        return out.reshape(N, C, H, W)
+
+    return apply_op("max_unpool2d", f, x, indices)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL", name=None):
+    """1-D unpool via the 2-D path on an NCL1 view (mask indices are flat
+    spatial positions, identical between L and L x 1 layouts)."""
+    ks = _tuple(kernel_size, 1)
+    st = _tuple(stride if stride is not None else kernel_size, 1)
+    pad = _padding(padding, 1)
+    if output_size is None:
+        Lo = x.shape[-1]
+        output_size = ((Lo - 1) * st[0] - 2 * pad[0][0] + ks[0],)
+    os4 = tuple(output_size)[-1:] + (1,)
+    out = max_unpool2d(x.unsqueeze(-1), indices.unsqueeze(-1),
+                       (ks[0], 1), (st[0], 1), padding=0, output_size=os4)
+    return out.squeeze(-1)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,

@@ -97,6 +97,17 @@ class AlphaDropout(Layer):
         return F.alpha_dropout(x, p=self.p, training=self.training)
 
 
+class FeatureAlphaDropout(Layer):
+    """Channel-wise alpha dropout (reference/torch FeatureAlphaDropout)."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, p=self.p, training=self.training)
+
+
 class Flatten(Layer):
     def __init__(self, start_axis=1, stop_axis=-1):
         super().__init__()

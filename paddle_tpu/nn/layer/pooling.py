@@ -13,12 +13,15 @@ def _mk_pool(name, fn, extra=()):
             self._args = dict(kernel_size=kernel_size, stride=stride, padding=padding)
             self._ceil = ceil_mode
             self._df = data_format
+            self._return_mask = return_mask
 
         def forward(self, x):
             kw = dict(self._args)
             kw["ceil_mode"] = self._ceil
             if self._df:
                 kw["data_format"] = self._df
+            if self._return_mask and name.startswith("Max"):
+                kw["return_mask"] = True
             return fn(x, **kw)
     _P.__name__ = name
     return _P
@@ -84,3 +87,30 @@ class AdaptiveMaxPool3D(Layer):
 
     def forward(self, x):
         return F.adaptive_max_pool3d(x, self._os)
+
+
+class MaxUnPool1D(Layer):
+    """Inverse of MaxPool1D(return_mask=True) (reference: nn/layer/pooling.py
+    MaxUnPool1D over the phi unpool kernel)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCL",
+                 output_size=None, name=None):
+        super().__init__()
+        self._kw = dict(kernel_size=kernel_size, stride=stride, padding=padding,
+                        output_size=output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, **self._kw)
+
+
+class MaxUnPool2D(Layer):
+    """Inverse of MaxPool2D(return_mask=True)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCHW",
+                 output_size=None, name=None):
+        super().__init__()
+        self._kw = dict(kernel_size=kernel_size, stride=stride, padding=padding,
+                        output_size=output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, **self._kw)

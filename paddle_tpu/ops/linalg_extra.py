@@ -135,3 +135,15 @@ def svd_lowrank(x, q=6, niter=2, M=None, name=None):
         return u[..., :q], s[..., :q], jnp.swapaxes(vh, -2, -1)[..., :q]
     args = (x, M) if M is not None else (x,)
     return apply_op("svd_lowrank", f, *args)
+
+
+def matrix_exp(x, name=None):
+    """Matrix exponential (reference: phi matrix_exp kernel / paddle.linalg.
+    matrix_exp) via jax.scipy.linalg.expm (Pade + scaling-squaring on MXU
+    matmuls)."""
+    def f(arr):
+        import jax.scipy.linalg as jsl
+        a32 = arr.astype(jnp.float32) if arr.dtype == jnp.bfloat16 else arr
+        out = jsl.expm(a32)
+        return out.astype(arr.dtype)
+    return apply_op("matrix_exp", f, x)

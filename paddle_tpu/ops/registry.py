@@ -376,6 +376,8 @@ g("cdist", lambda a, b_: np.sqrt(
   lambda: [U(4, 3), U(5, 3, seed=1)], "linalg", grad=True, atol=1e-4)
 g("pca_lowrank", None, lambda: [U(6, 4)], "linalg", kind="smoke")
 g("svd_lowrank", None, lambda: [U(6, 4)], "linalg", kind="smoke")
+g("matrix_exp", lambda x: __import__("scipy.linalg", fromlist=["expm"]).expm(x),
+  lambda: [U(4, 4)], "linalg", grad=True, atol=1e-4, rtol=1e-4)
 g("histogram", lambda x: np.histogram(x, 10)[0], lambda: [U(30)], "linalg",
   kwargs={"bins": 10})
 g("bincount", lambda x: np.bincount(x), lambda: [I(20, hi=6)], "linalg")

@@ -17,7 +17,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from ..core.tensor import Tensor
 from ..core.dispatch import apply_op, unwrap
@@ -71,7 +71,7 @@ def pipeline_forward(stage_fn, stacked_params, x_micro, *, mesh, axis_name="pp")
     pspec_params = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
     f = shard_map(body, mesh=mesh,
                   in_specs=(pspec_params, P()),
-                  out_specs=P(), check_rep=False)
+                  out_specs=P(), check_vma=False)
     return f(stacked_params, x_micro)
 
 

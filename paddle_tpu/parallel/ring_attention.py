@@ -13,7 +13,7 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from ..core.tensor import Tensor
 from ..core.dispatch import apply_op
@@ -94,7 +94,7 @@ def ring_flash_attention(q, k, v, causal=True, axis_name="sep", mesh=None):
         body = functools.partial(_ring_attn_local, axis_name=axis_name,
                                  causal=causal, scale=scale)
         sm = shard_map(body, mesh=jmesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_rep=False)
+                       out_specs=spec, check_vma=False)
         return sm(qa, ka, va)
 
     return apply_op("ring_attention", f, q, k, v)

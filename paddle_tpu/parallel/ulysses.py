@@ -18,7 +18,7 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from ..core.dispatch import apply_op
 from ..distributed.fleet.topology import get_hybrid_communicate_group
@@ -116,7 +116,7 @@ def ulysses_attention(q, k, v, causal=True, axis_name="sep", mesh=None):
         body = functools.partial(_ulysses_local, axis_name=axis_name,
                                  causal=causal, scale=scale)
         sm = shard_map(body, mesh=jmesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_rep=False)
+                       out_specs=spec, check_vma=False)
         return sm(qa, ka, va)
 
     return apply_op("ulysses_attention", f, q, k, v)

@@ -1,0 +1,199 @@
+"""Round-2 gap fills: max_pool return_mask + MaxUnPool, FeatureAlphaDropout,
+matrix_exp, incubate.optimizer LookAhead/ModelAverage."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestMaxPoolMask:
+    def test_mask_indices_match_naive(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 6, 8).astype(np.float32)
+        out, mask = F.max_pool2d(paddle.to_tensor(x), kernel_size=2,
+                                 return_mask=True)
+        o, m = np.asarray(out._data), np.asarray(mask._data)
+        for n in range(2):
+            for c in range(3):
+                for i in range(3):
+                    for j in range(4):
+                        win = x[n, c, 2*i:2*i+2, 2*j:2*j+2]
+                        assert o[n, c, i, j] == win.max()
+                        fi = m[n, c, i, j]
+                        assert x[n, c].reshape(-1)[fi] == win.max()
+
+    def test_unpool_roundtrip(self):
+        """unpool(pool(x)) reproduces x exactly at the argmax positions and
+        zeros elsewhere."""
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 2, 4, 4).astype(np.float32)
+        t = paddle.to_tensor(x)
+        out, mask = F.max_pool2d(t, 2, return_mask=True)
+        up = F.max_unpool2d(out, mask, 2)
+        u = np.asarray(up._data)
+        assert u.shape == x.shape
+        assert np.count_nonzero(u) <= 2 * 2 * 2 * 2
+        np.testing.assert_allclose(u.reshape(2, 2, -1).max(-1),
+                                   np.asarray(out._data).reshape(2, 2, -1).max(-1))
+
+    def test_unpool_layer_and_grad(self):
+        rng = np.random.RandomState(2)
+        x = paddle.to_tensor(rng.randn(1, 1, 4, 4).astype(np.float32))
+        x.stop_gradient = False
+        out, mask = F.max_pool2d(x, 2, return_mask=True)
+        up = nn.MaxUnPool2D(2)(out, mask)
+        up.sum().backward()
+        g = np.asarray(x.grad._data)
+        assert np.count_nonzero(g) == 4      # only argmax positions get grad
+
+    def test_padded_pool_mask(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(1, 1, 3, 3).astype(np.float32)
+        out, mask = F.max_pool2d(paddle.to_tensor(x), 2, stride=2, padding=1,
+                                 return_mask=True)
+        m = np.asarray(mask._data)
+        assert m.min() >= 0 and m.max() < 9   # indices always in-bounds
+
+    def test_ceil_mode_mask_shape_matches_plain(self):
+        rng = np.random.RandomState(5)
+        x = paddle.to_tensor(rng.randn(1, 1, 5, 5).astype(np.float32))
+        plain = F.max_pool2d(x, 2, stride=2, ceil_mode=True)
+        out, mask = F.max_pool2d(x, 2, stride=2, ceil_mode=True,
+                                 return_mask=True)
+        assert out.shape == plain.shape == [1, 1, 3, 3]
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.asarray(plain._data))
+        m = np.asarray(mask._data)
+        assert m.min() >= 0 and m.max() < 25
+
+    def test_mask_rejects_channel_last(self):
+        x = paddle.to_tensor(np.zeros((1, 6, 2), np.float32))
+        with pytest.raises(ValueError):
+            F.max_pool1d(x, 2, data_format="NLC", return_mask=True)
+
+    def test_unpool1d(self):
+        rng = np.random.RandomState(4)
+        x = paddle.to_tensor(rng.randn(1, 2, 6).astype(np.float32))
+        out, mask = F.max_pool1d(x, 2, return_mask=True)
+        up = F.max_unpool1d(out, mask, 2)
+        assert up.shape == [1, 2, 6]
+
+
+class TestFeatureAlphaDropout:
+    def test_channelwise_mask(self):
+        paddle.seed(0)
+        layer = nn.FeatureAlphaDropout(p=0.5)
+        layer.train()
+        x = paddle.to_tensor(np.ones((4, 8, 5, 5), np.float32))
+        y = np.asarray(layer(x)._data)
+        # each channel is uniformly transformed: per-channel std must be 0
+        assert np.allclose(y.std(axis=(2, 3)), 0.0, atol=1e-6)
+        layer.eval()
+        np.testing.assert_array_equal(np.asarray(layer(x)._data),
+                                      np.ones((4, 8, 5, 5), np.float32))
+
+
+class TestMatrixExp:
+    def test_matches_scipy(self):
+        import scipy.linalg
+        rng = np.random.RandomState(0)
+        a = rng.randn(4, 4).astype(np.float32) * 0.3
+        out = paddle.to_tensor(a)
+        from paddle_tpu.ops import matrix_exp
+        np.testing.assert_allclose(np.asarray(matrix_exp(out)._data),
+                                   scipy.linalg.expm(a), rtol=1e-4, atol=1e-5)
+
+
+class TestIncubateOptimizers:
+    def _setup(self):
+        paddle.seed(0)
+        model = nn.Linear(4, 4)
+        rng = np.random.RandomState(0)
+        xs = rng.randn(16, 4).astype(np.float32)
+        ys = rng.randn(16, 4).astype(np.float32)
+        return model, xs, ys
+
+    def test_lookahead_syncs_every_k(self):
+        from paddle_tpu.incubate import LookAhead
+        model, xs, ys = self._setup()
+        inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=model.parameters())
+        opt = LookAhead(inner, alpha=0.5, k=2)
+        w_hist = []
+        for i in range(4):
+            loss = ((model(paddle.to_tensor(xs)) - paddle.to_tensor(ys)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            w_hist.append(np.asarray(model.weight._buf).copy())
+        assert opt._step_num == 4 and len(opt._slow) == 2
+        # after a sync step the weights equal the slow weights
+        assert not np.allclose(w_hist[0], w_hist[1])
+        # slow weights seeded at theta_0: the first sync pulls back toward
+        # init, so LookAhead differs from plain SGD already at step k
+        paddle.seed(0)
+        ref = nn.Linear(4, 4)
+        sgd = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=ref.parameters())
+        for _ in range(2):
+            loss = ((ref(paddle.to_tensor(xs)) - paddle.to_tensor(ys)) ** 2).mean()
+            loss.backward()
+            sgd.step()
+            sgd.clear_grad()
+        assert not np.allclose(w_hist[1], np.asarray(ref.weight._buf))
+
+    def test_lookahead_state_roundtrip(self):
+        from paddle_tpu.incubate import LookAhead
+        model, xs, ys = self._setup()
+        inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=model.parameters())
+        opt = LookAhead(inner, alpha=0.5, k=3)
+        for _ in range(4):
+            loss = ((model(paddle.to_tensor(xs)) - paddle.to_tensor(ys)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        sd = opt.state_dict()
+        assert any(k.startswith("lookahead_slow_") for k in sd)
+        slow_before = {k: np.asarray(v._data if hasattr(v, "_data") else v)
+                       for k, v in sd.items() if k.startswith("lookahead_slow_")}
+        opt2 = LookAhead(paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=model.parameters()), alpha=0.5, k=3)
+        opt2.set_state_dict(sd)
+        assert opt2._step_num == 4
+        for i, p in enumerate(model.parameters()):
+            np.testing.assert_array_equal(
+                np.asarray(opt2._slow[id(p)][1]),
+                slow_before[f"lookahead_slow_{i}"])
+
+    def test_lookahead_validates(self):
+        from paddle_tpu.incubate import LookAhead
+        model, _, _ = self._setup()
+        inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=model.parameters())
+        with pytest.raises(ValueError):
+            LookAhead(inner, alpha=2.0)
+        with pytest.raises(ValueError):
+            LookAhead(inner, k=0)
+
+    def test_model_average_apply_restore(self):
+        from paddle_tpu.incubate import ModelAverage
+        model, xs, ys = self._setup()
+        opt = paddle.optimizer.SGD(learning_rate=0.5,
+                                   parameters=model.parameters())
+        ma = ModelAverage(parameters=model.parameters())
+        snaps = []
+        for i in range(3):
+            loss = ((model(paddle.to_tensor(xs)) - paddle.to_tensor(ys)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            ma.step()
+            snaps.append(np.asarray(model.weight._buf).copy())
+        cur = np.asarray(model.weight._buf).copy()
+        with ma:
+            avg = np.asarray(model.weight._buf)
+            np.testing.assert_allclose(avg, np.mean(snaps, axis=0), rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(model.weight._buf), cur)
