@@ -97,3 +97,18 @@ def __getattr__(name):
         globals()[name] = val
         return val
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+# ---- top-level compat surface (reference python/paddle/__init__.py) ---------
+import math as _math
+
+inf = float("inf")
+nan = float("nan")
+pi = _math.pi
+e = _math.e
+newaxis = None
+
+from .framework.compat import (  # noqa: F401,E402
+    dtype, iinfo, finfo, set_printoptions, CUDAPlace, CUDAPinnedPlace,
+    get_cuda_rng_state, set_cuda_rng_state, to_dlpack, from_dlpack,
+    LazyGuard, batch, check_shape, pstring, raw)
+from .nn.initializer import ParamAttr  # noqa: F401,E402

@@ -1,0 +1,217 @@
+"""Top-level API compat pieces (reference: python/paddle/__init__.py exports —
+iinfo/finfo/dtype, dlpack interop, printoptions, CUDA place/rng shims, the
+legacy `batch` reader decorator, LazyGuard).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from ..core.tensor import Tensor
+from ..core import dtype as dtypes
+from ..core.device import Place
+
+
+class dtype:
+    """paddle.dtype — wraps a numpy/jax dtype with paddle naming
+    (reference: the pybind DataType enum exposed as paddle.dtype)."""
+
+    def __init__(self, d):
+        self.np = np.dtype(dtypes.convert_dtype(d) or d)
+
+    @property
+    def name(self):
+        return dtypes.paddle_name(self.np) if hasattr(dtypes, "paddle_name") \
+            else str(self.np)
+
+    def __eq__(self, other):
+        if isinstance(other, dtype):
+            return self.np == other.np
+        try:
+            return self.np == np.dtype(dtypes.convert_dtype(other) or other)
+        except TypeError:
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.np)
+
+    def __repr__(self):
+        return f"paddle.{self.np.name}"
+
+
+class iinfo:
+    """reference paddle.iinfo (pybind iinfo): integer type limits."""
+
+    def __init__(self, d):
+        d = dtypes.convert_dtype(d) or d
+        info = np.iinfo(np.dtype(d))
+        self.min, self.max, self.bits = int(info.min), int(info.max), info.bits
+        self.dtype = str(np.dtype(d))
+
+    def __repr__(self):
+        return f"iinfo(min={self.min}, max={self.max}, bits={self.bits})"
+
+
+class finfo:
+    """reference paddle.finfo: floating type limits (bfloat16 aware)."""
+
+    def __init__(self, d):
+        d = dtypes.convert_dtype(d) or d
+        import ml_dtypes
+        info = ml_dtypes.finfo(d) if str(d) in ("bfloat16", "float8_e4m3fn",
+                                                "float8_e5m2") else \
+            np.finfo(np.dtype(d))
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.eps = float(info.eps)
+        self.tiny = float(getattr(info, "tiny", getattr(info, "smallest_normal", 0.0)))
+        self.smallest_normal = self.tiny
+        self.bits = info.bits
+        self.dtype = str(d)
+
+    def __repr__(self):
+        return (f"finfo(min={self.min}, max={self.max}, eps={self.eps}, "
+                f"bits={self.bits})")
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """reference paddle.set_printoptions — numpy drives Tensor repr here."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+# ---- CUDA-compat shims (TPU build: map to the default accelerator) ----------
+class CUDAPlace(Place):
+    """Compat: the reference's GPU place. On the TPU build it resolves to the
+    n-th available accelerator device (API-compatible, device is TPU/CPU)."""
+
+    def __init__(self, device_id=0):
+        devs = jax.devices()
+        super().__init__(devs[min(device_id, len(devs) - 1)])
+
+
+class CUDAPinnedPlace(Place):
+    """Compat: pinned-host place — host memory is already the staging area
+    for PJRT transfers, so this is the CPU device."""
+
+    def __init__(self):
+        try:
+            cpu = jax.local_devices(backend="cpu")
+        except Exception:
+            cpu = jax.devices()
+        super().__init__(cpu[0])
+
+
+def get_cuda_rng_state():
+    """Compat alias of the framework RNG state (one device RNG on TPU)."""
+    from ..core.rng import get_rng_state
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    from ..core.rng import set_rng_state
+    return set_rng_state(state)
+
+
+# ---- dlpack ------------------------------------------------------------------
+def to_dlpack(x):
+    """reference paddle.utils.dlpack.to_dlpack / paddle.to_dlpack."""
+    arr = x._data if isinstance(x, Tensor) else x
+    return arr.__dlpack__()
+
+
+def from_dlpack(capsule):
+    """Accepts any __dlpack__-capable object (numpy, torch cpu, jax arrays,
+    paddle Tensors) or a legacy raw capsule (host-resident)."""
+    if isinstance(capsule, Tensor):
+        capsule = capsule._data
+    if not hasattr(capsule, "__dlpack__"):
+        class _LegacyCapsule:
+            """jax>=0.5 dropped raw-capsule intake; present the capsule
+            through the protocol (host device — legacy capsules carry no
+            device info)."""
+
+            def __init__(self, c):
+                self._c = c
+
+            def __dlpack__(self, **kw):
+                return self._c
+
+            def __dlpack_device__(self):
+                return (1, 0)    # kDLCPU
+        capsule = _LegacyCapsule(capsule)
+    return Tensor(jax.numpy.from_dlpack(capsule))
+
+
+# ---- misc --------------------------------------------------------------------
+class LazyGuard:
+    """reference paddle.LazyGuard defers parameter materialization during
+    Layer construction. XLA arrays are lazily materialized by the runtime
+    already (construction traces an init computation; buffers appear on first
+    use), so the guard is a compat context manager with no extra effect."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader decorator (reference python/paddle/reader): turns a
+    sample generator fn into a batch generator fn."""
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+def check_shape(shape):
+    """reference paddle.static check_shape: validate a shape spec (ints, -1
+    for inferred, None for dynamic)."""
+    if isinstance(shape, (list, tuple)):
+        for v in shape:
+            if v is None:
+                continue
+            if not isinstance(v, (int, np.integer)):
+                raise TypeError(f"shape entries must be int/None, got {v!r}")
+            if v < -1:
+                raise ValueError(f"shape entries must be >= -1, got {v}")
+    elif not isinstance(shape, (int, np.integer)):
+        raise TypeError(f"shape must be int or list/tuple, got {type(shape)}")
+    return shape
+
+
+class _UnsupportedDType:
+    """Placeholder for the reference's prototype string dtypes (pstring/raw);
+    using them raises instead of silently mis-typing."""
+
+    def __init__(self, name):
+        self._name = name
+
+    def __repr__(self):
+        return f"paddle.{self._name} (unsupported on the TPU build)"
+
+    def __call__(self, *a, **k):
+        raise TypeError(f"dtype {self._name!r} is not supported on TPU")
+
+
+pstring = _UnsupportedDType("pstring")
+raw = _UnsupportedDType("raw")

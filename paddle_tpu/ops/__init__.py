@@ -225,7 +225,7 @@ _AUTO_INPLACE = [
     "put_along_axis", "ldexp", "i0", "polygamma", "renorm", "tril", "triu",
     "acos", "atan", "cos", "cosh", "sin", "sinc", "sinh", "acosh", "asinh",
     "copysign", "bitwise_left_shift", "bitwise_right_shift", "index_fill",
-    "masked_scatter", "t",
+    "masked_scatter", "t", "erf", "expm1",
 ]
 
 
@@ -252,6 +252,7 @@ def _install():
         setattr(Tensor, name, make(fn))
     for name, fn in _INPLACE.items():
         setattr(Tensor, name, _inplace_from(fn))
+        setattr(mod, name, _inplace_from(fn))   # paddle.abs_(t) module form
     for base in _AUTO_INPLACE:
         fn = getattr(mod, base, None)
         if fn is not None:
@@ -259,6 +260,7 @@ def _install():
             setattr(mod, base + "_", _inplace_from(fn))
     # paddle name quirk: floor_mod_ aliases mod_
     Tensor.floor_mod_ = Tensor.mod_
+    mod.floor_mod_ = mod.remainder_
     Tensor.set_ = _set_
     # random inplace
     from .random import (uniform_, normal_, exponential_, bernoulli_,

@@ -174,3 +174,86 @@ def masked_scatter(x, mask, value, name=None):
         src = v.reshape(-1)[jnp.clip(pos, 0, v.size - 1)].reshape(a.shape)
         return jnp.where(m, src.astype(a.dtype), a)
     return apply_op("masked_scatter", f, x, mask, value)
+
+
+def hstack(x, name=None):
+    """numpy-compatible horizontal stack (reference tensor/manipulation.py
+    hstack)."""
+    def f(*arrs):
+        return jnp.hstack(arrs)
+    return apply_op("hstack", f, *list(x))
+
+
+def vstack(x, name=None):
+    def f(*arrs):
+        return jnp.vstack(arrs)
+    return apply_op("vstack", f, *list(x))
+
+
+def dstack(x, name=None):
+    def f(*arrs):
+        return jnp.dstack(arrs)
+    return apply_op("dstack", f, *list(x))
+
+
+def column_stack(x, name=None):
+    def f(*arrs):
+        return jnp.column_stack(arrs)
+    return apply_op("column_stack", f, *list(x))
+
+
+def row_stack(x, name=None):
+    return vstack(x, name)
+
+
+def cartesian_prod(x, name=None):
+    """Cartesian product of 1-D tensors -> [prod(N_i), k] (reference
+    tensor/math.py cartesian_prod)."""
+    ts = list(x)
+
+    def f(*arrs):
+        if len(arrs) == 1:          # reference returns 1-D for a single input
+            return arrs[0].reshape(-1)
+        grids = jnp.meshgrid(*arrs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+    return apply_op("cartesian_prod", f, *ts)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Static crop (reference tensor/creation.py crop): slice `shape` starting
+    at `offsets` (defaults: offsets 0; -1 in shape = to the end)."""
+    arr_shape = x.shape
+    offs = [int(v) for v in (offsets if offsets is not None
+                             else [0] * len(arr_shape))]
+    tgt = [int(v) for v in (shape if shape is not None else arr_shape)]
+    sizes = [arr_shape[i] - offs[i] if tgt[i] == -1 else tgt[i]
+             for i in range(len(arr_shape))]
+
+    import jax
+
+    def f(a):
+        return jax.lax.dynamic_slice(a, offs, sizes)
+    return apply_op("crop", f, x)
+
+
+def positive(x, name=None):
+    """reference tensor/math.py positive: +x (errors on bool like numpy)."""
+    if str(getattr(unwrap(x), "dtype", "")) == "bool":
+        raise TypeError("positive is not supported for bool tensors")
+    return apply_op("positive", lambda a: +a, x)
+
+
+def shape(x, name=None):
+    """reference paddle.shape: the RUNTIME shape as an int32 tensor."""
+    return Tensor(jnp.asarray(unwrap(x).shape, jnp.int32))
+
+
+def numel(x, name=None):
+    """reference paddle.numel: element count as a 0-D integer tensor (int32 —
+    x64 is disabled on this build, so int64 would narrow anyway)."""
+    return Tensor(jnp.asarray(int(np.prod(unwrap(x).shape)), jnp.int32))
+
+
+def tolist(x):
+    """reference paddle.tolist (delegates to Tensor.tolist)."""
+    return x.tolist() if isinstance(x, Tensor) else np.asarray(x).tolist()

@@ -92,6 +92,15 @@ def bernoulli_(x, p=0.5, name=None):
     return x
 
 
+def standard_gamma(x, name=None):
+    """Sample Gamma(alpha=x, scale=1) elementwise (reference
+    tensor/random.py standard_gamma)."""
+    alpha = unwrap(x)
+    out = jax.random.gamma(next_key(), alpha.astype(jnp.float32))
+    keep = jnp.issubdtype(alpha.dtype, jnp.floating)   # bfloat16-aware
+    return Tensor(out.astype(alpha.dtype if keep else jnp.float32))
+
+
 def poisson(x, name=None):
     lam = unwrap(x)
     out = jax.random.poisson(next_key(), lam.astype(jnp.float32))

@@ -453,6 +453,24 @@ g("concat", lambda xs: np.concatenate(xs, 0), lambda: [[U(2, 3), U(3, 3,
   "manip")
 g("stack", lambda xs: np.stack(xs, 0), lambda: [[U(2, 3), U(2, 3, seed=1)]],
   "manip")
+g("hstack", lambda xs: np.hstack(xs), lambda: [[U(2, 3), U(2, 3, seed=1)]],
+  "manip")
+g("vstack", lambda xs: np.vstack(xs), lambda: [[U(2, 3), U(2, 3, seed=1)]],
+  "manip")
+g("dstack", lambda xs: np.dstack(xs), lambda: [[U(2, 3), U(2, 3, seed=1)]],
+  "manip")
+g("column_stack", lambda xs: np.column_stack(xs), lambda: [[U(4), U(4, seed=1)]],
+  "manip")
+alias("row_stack", "vstack", "manip")
+g("cartesian_prod", lambda xs: np.stack(
+    [g.reshape(-1) for g in np.meshgrid(*xs, indexing="ij")], -1),
+  lambda: [[U(3), U(2, seed=1)]], "manip")
+g("crop", lambda x: x[1:3, 1:3], lambda: [U(4, 4)], "manip",
+  kwargs={"shape": [2, 2], "offsets": [1, 1]})
+g("positive", lambda x: +x, lambda: [U(3, 4)], "math", grad=True)
+g("numel", lambda x: np.asarray(x.size, np.int32), lambda: [U(3, 4)], "manip")
+g("shape", lambda x: np.asarray(x.shape, np.int32), lambda: [U(3, 4)], "manip")
+g("standard_gamma", None, lambda: [POS(3, 4)], "random", kind="smoke")
 g("split", None, lambda: [U(6, 3)], "manip", kind="smoke",
   kwargs={"num_or_sections": 3})
 g("chunk", None, lambda: [U(6, 3)], "manip", kind="smoke",

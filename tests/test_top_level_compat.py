@@ -1,0 +1,103 @@
+"""Top-level API parity surface (reference python/paddle/__init__.py
+__all__ — 434 names, all present; this exercises the round-2 additions)."""
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_reference_top_level_all_covered():
+    import os
+    ref = "/root/reference/python/paddle/__init__.py"
+    if not os.path.exists(ref):
+        pytest.skip("reference checkout not present")
+    src = open(ref).read()
+    m = re.search(r"__all__ = \[(.*?)\]", src, re.S)
+    names = re.findall(r"'([A-Za-z0-9_]+)'", m.group(1))
+    missing = [n for n in names if not hasattr(paddle, n)]
+    assert not missing, f"missing top-level names: {missing}"
+
+
+def test_constants_and_dtype_info():
+    assert paddle.pi == np.pi and paddle.inf == float("inf")
+    assert paddle.newaxis is None and np.isnan(paddle.nan)
+    fi = paddle.finfo(paddle.bfloat16)
+    assert fi.bits == 16 and fi.max > 3e38
+    assert paddle.iinfo("int32").max == 2 ** 31 - 1
+    assert paddle.dtype("float32") == paddle.dtype(np.float32)
+    assert (paddle.dtype("float32") == object()) is False   # no TypeError
+
+
+def test_stack_variants_and_cartesian():
+    a = paddle.to_tensor(np.ones((2, 3), np.float32))
+    b = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    assert paddle.hstack([a, b]).shape == [2, 6]
+    assert paddle.vstack([a, b]).shape == [4, 3]
+    assert paddle.row_stack([a, b]).shape == [4, 3]
+    assert paddle.dstack([a, b]).shape == [2, 3, 2]
+    c = paddle.column_stack([paddle.to_tensor(np.ones(4, np.float32)),
+                             paddle.to_tensor(np.zeros(4, np.float32))])
+    assert c.shape == [4, 2]
+    cp = paddle.cartesian_prod([paddle.to_tensor(np.arange(3)),
+                                paddle.to_tensor(np.arange(2))])
+    assert cp.shape == [6, 2]
+    single = paddle.cartesian_prod([paddle.to_tensor(np.arange(3))])
+    assert single.shape == [3]        # 1-D for a single input (reference)
+
+
+def test_module_level_inplace_forms():
+    t = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+    r = paddle.abs_(t)
+    assert r is t
+    np.testing.assert_allclose(np.asarray(t._data), [1.0, 2.0])
+    paddle.tanh_(t)
+    np.testing.assert_allclose(np.asarray(t._data), np.tanh([1.0, 2.0]),
+                               rtol=1e-6)
+
+
+def test_dlpack_roundtrip():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    y = paddle.from_dlpack(paddle.to_dlpack(x))
+    np.testing.assert_array_equal(np.asarray(y._data), np.asarray(x._data))
+    z = paddle.from_dlpack(np.arange(4).reshape(2, 2))   # __dlpack__ object
+    assert z.shape == [2, 2]
+
+
+def test_shape_numel_tolist_crop_positive():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert np.asarray(paddle.shape(x)._data).tolist() == [2, 3]
+    assert int(np.asarray(paddle.numel(x)._data)) == 6
+    assert paddle.tolist(x) == [[0.0, 1.0, 2.0], [3.0, 4.0, 5.0]]
+    c = paddle.crop(x, shape=[1, 2], offsets=[1, 1])
+    np.testing.assert_allclose(np.asarray(c._data), [[4.0, 5.0]])
+    p = paddle.positive(x)
+    np.testing.assert_array_equal(np.asarray(p._data), np.asarray(x._data))
+    with pytest.raises(TypeError):
+        paddle.positive(paddle.to_tensor(np.array([True])))
+
+
+def test_standard_gamma_statistics():
+    paddle.seed(0)
+    g = paddle.standard_gamma(paddle.to_tensor(np.full((2000,), 2.0, np.float32)))
+    arr = np.asarray(g._data)
+    assert (arr > 0).all() and abs(arr.mean() - 2.0) < 0.15
+
+
+def test_batch_decorator_and_misc():
+    def reader():
+        yield from range(7)
+    assert list(paddle.batch(reader, 3)()) == [[0, 1, 2], [3, 4, 5], [6]]
+    assert list(paddle.batch(reader, 3, drop_last=True)()) == [[0, 1, 2], [3, 4, 5]]
+    paddle.check_shape([2, -1, None])
+    with pytest.raises(ValueError):
+        paddle.check_shape([2, -3])
+    with paddle.LazyGuard():
+        lin = nn.Linear(4, 4, weight_attr=paddle.ParamAttr(name="w0"))
+    assert lin.weight.name == "w0"
+    place = paddle.CUDAPlace(0)       # resolves to the default accelerator
+    assert place.device is not None
+    with pytest.raises(TypeError):
+        paddle.pstring()
