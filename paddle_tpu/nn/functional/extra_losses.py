@@ -1,0 +1,398 @@
+"""Round-2 loss-surface completion (reference: python/paddle/nn/functional/
+loss.py — the losses absent after round 1: poisson_nll, multi-label /
+multi-margin / soft-margin families, gaussian_nll, dice, log, npair,
+hsigmoid, margin_cross_entropy, ctc, rnnt, adaptive log-softmax).
+
+All math in f32 with the file-standard `_reduce` semantics from loss.py.
+"""
+from __future__ import annotations
+
+import math as _math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op, unwrap
+from ...core.tensor import Tensor
+from .loss import _reduce
+
+NEG = -1e30
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    """reference loss.py poisson_nll_loss."""
+    def f(x, y):
+        x32, y32 = x.astype(jnp.float32), y.astype(jnp.float32)
+        if log_input:
+            out = jnp.exp(x32) - y32 * x32
+        else:
+            out = x32 - y32 * jnp.log(x32 + epsilon)
+        if full:
+            # Stirling approximation for y! applied where y > 1
+            stir = y32 * jnp.log(y32 + 1e-30) - y32 + 0.5 * jnp.log(
+                2 * _math.pi * jnp.maximum(y32, 1e-30))
+            out = out + jnp.where(y32 > 1, stir, 0.0)
+        return _reduce(out, reduction)
+    return apply_op("poisson_nll_loss", f, input, label)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """reference loss.py gaussian_nll_loss."""
+    def f(mu, y, var):
+        v = jnp.maximum(var.astype(jnp.float32), epsilon)
+        out = 0.5 * (jnp.log(v) +
+                     (y.astype(jnp.float32) - mu.astype(jnp.float32)) ** 2 / v)
+        if full:
+            out = out + 0.5 * _math.log(2 * _math.pi)
+        return _reduce(out, reduction)
+    return apply_op("gaussian_nll_loss", f, input, label, variance)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """reference loss.py soft_margin_loss: log(1 + exp(-y * x))."""
+    def f(x, y):
+        out = jnp.log1p(jnp.exp(-y.astype(jnp.float32) * x.astype(jnp.float32)))
+        return _reduce(out, reduction)
+    return apply_op("soft_margin_loss", f, input, label)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    """reference loss.py multi_label_soft_margin_loss."""
+    args = (input, label) + ((weight,) if weight is not None else ())
+
+    def f(x, y, *w):
+        x32, y32 = x.astype(jnp.float32), y.astype(jnp.float32)
+        per = -(y32 * jax.nn.log_sigmoid(x32) +
+                (1 - y32) * jax.nn.log_sigmoid(-x32))
+        if w:
+            per = per * w[0].astype(jnp.float32)
+        out = per.mean(axis=-1)
+        return _reduce(out, reduction)
+    return apply_op("multi_label_soft_margin_loss", f, *args)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """reference loss.py multi_margin_loss (hinge over classes)."""
+    lbl = unwrap(label)
+    args = (input,) + ((weight,) if weight is not None else ())
+
+    def f(x, *w):
+        x32 = x.astype(jnp.float32)
+        N, C = x32.shape
+        correct = jnp.take_along_axis(x32, lbl[:, None].astype(jnp.int32),
+                                      axis=1)
+        m = jnp.maximum(margin - correct + x32, 0.0) ** p
+        if w:
+            m = m * w[0].astype(jnp.float32)[lbl][:, None]
+        onehot = jax.nn.one_hot(lbl, C, dtype=jnp.float32)
+        out = jnp.sum(m * (1 - onehot), axis=1) / C
+        return _reduce(out, reduction)
+    return apply_op("multi_margin_loss", f, *args)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    """reference loss.py triplet_margin_with_distance_loss (custom metric)."""
+    if distance_function is None:
+        def distance_function(a, b):
+            diff = a - b
+            return (diff * diff).sum(-1).sqrt() if isinstance(diff, Tensor) \
+                else jnp.sqrt(jnp.sum(diff * diff, -1) + 1e-12)
+    d_ap = distance_function(input, positive)
+    d_an = distance_function(input, negative)
+    if swap:
+        d_pn = distance_function(positive, negative)
+        d_an = d_an.minimum(d_pn) if isinstance(d_an, Tensor) else \
+            jnp.minimum(d_an, d_pn)
+
+    def f(ap, an):
+        out = jnp.maximum(ap.astype(jnp.float32) - an.astype(jnp.float32)
+                          + margin, 0.0)
+        return _reduce(out, reduction)
+    return apply_op("triplet_margin_with_distance_loss", f, d_ap, d_an)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """reference loss.py dice_loss: input [N, ..., C] probs, label [N, ..., 1]
+    class ids."""
+    lbl = unwrap(label)
+
+    def f(x):
+        x32 = x.astype(jnp.float32)
+        C = x32.shape[-1]
+        onehot = jax.nn.one_hot(lbl.squeeze(-1), C, dtype=jnp.float32)
+        red = tuple(range(1, x32.ndim))
+        inter = jnp.sum(x32 * onehot, axis=red)
+        union = jnp.sum(x32, axis=red) + jnp.sum(onehot, axis=red)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply_op("dice_loss", f, input)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """reference loss.py log_loss (binary cross entropy on probabilities,
+    elementwise, no reduction)."""
+    def f(p, y):
+        p32, y32 = p.astype(jnp.float32), y.astype(jnp.float32)
+        return -(y32 * jnp.log(p32 + epsilon) +
+                 (1 - y32) * jnp.log(1 - p32 + epsilon))
+    return apply_op("log_loss", f, input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """reference loss.py npair_loss."""
+    lbl = unwrap(labels)
+
+    def f(a, p):
+        a32, p32 = a.astype(jnp.float32), p.astype(jnp.float32)
+        reg = l2_reg * (jnp.mean(jnp.sum(a32 * a32, 1)) +
+                        jnp.mean(jnp.sum(p32 * p32, 1))) * 0.25
+        sim = a32 @ p32.T                       # [N, N]
+        same = (lbl[:, None] == lbl[None, :]).astype(jnp.float32)
+        tgt = same / jnp.sum(same, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        xent = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+        return xent + reg
+    return apply_op("npair_loss", f, anchor, positive)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None, path_table=None,
+                  path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid over the default complete binary tree (reference
+    loss.py hsigmoid_loss; phi hsigmoid_loss kernel). Without a custom
+    path_table, class c's path is its binary-heap route: internal node ids
+    are (c + num_classes) halved until the root, codes are the low bits."""
+    lbl = np.asarray(unwrap(label))
+    depth = int(np.ceil(np.log2(max(num_classes, 2))))
+    # precompute per-sample paths on host (labels are data, shapes static)
+    if path_table is not None:
+        table = np.asarray(unwrap(path_table))
+        codes = np.asarray(unwrap(path_code)).astype(np.float32)
+        valid = (table >= 0).astype(np.float32)
+        table = np.maximum(table, 0)
+    else:
+        table = np.zeros((len(lbl), depth), np.int64)
+        codes = np.zeros((len(lbl), depth), np.float32)
+        valid = np.zeros((len(lbl), depth), np.float32)
+        for i, c in enumerate(lbl.reshape(-1)):
+            node = int(c) + num_classes
+            k = 0
+            while node > 1:
+                table[i, k] = node // 2 - 1     # internal node row in weight
+                codes[i, k] = node % 2
+                valid[i, k] = 1.0
+                node //= 2
+                k += 1
+    tj, cj, vj = jnp.asarray(table), jnp.asarray(codes), jnp.asarray(valid)
+    args = (input, weight) + ((bias,) if bias is not None else ())
+
+    def f(x, w, *b):
+        x32 = x.astype(jnp.float32)
+        wsel = w.astype(jnp.float32)[tj]         # [N, depth, D]
+        logits = jnp.einsum("nd,nkd->nk", x32, wsel)
+        if b:
+            logits = logits + b[0].astype(jnp.float32).reshape(-1)[tj]
+        # code 1 -> sigmoid(logit), code 0 -> sigmoid(-logit)
+        sign = 2 * cj - 1
+        logp = jax.nn.log_sigmoid(sign * logits) * vj
+        return -jnp.sum(logp, axis=1, keepdims=True)
+    return apply_op("hsigmoid_loss", f, *args)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean", name=None):
+    """ArcFace-family margin softmax (reference loss.py margin_cross_entropy:
+    cos(m1*theta + m2) - m3 applied to the target logit)."""
+    lbl = unwrap(label)
+
+    def f(lg):
+        # clip strictly inside (-1, 1): d/dx arccos explodes at the boundary
+        # and jnp.where/clip would propagate NaN grads for exact +-1 logits
+        x = jnp.clip(lg.astype(jnp.float32), -1.0 + 1e-6, 1.0 - 1e-6)
+        N, C = x.shape
+        theta = jnp.arccos(jnp.take_along_axis(
+            x, lbl[:, None].astype(jnp.int32), axis=1))
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(lbl, C, dtype=jnp.float32)
+        adj = x * (1 - onehot) + target * onehot
+        adj = adj * scale
+        logp = jax.nn.log_softmax(adj, axis=1)
+        loss = -jnp.take_along_axis(logp, lbl[:, None].astype(jnp.int32),
+                                    axis=1)
+        sm = jnp.exp(logp)
+        red = _reduce(loss, reduction)
+        return (red, sm) if return_softmax else red
+    out = apply_op("margin_cross_entropy", f, logits)
+    return out
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """CTC forward-algorithm loss (reference loss.py ctc_loss over the
+    warpctc kernel). log_probs [T, B, C] raw logits (log-softmax applied
+    here, matching the reference), labels [B, L] padded with anything.
+    lax.scan over time; log-domain alpha recursion over the extended
+    blank-interleaved label sequence."""
+    lbl = unwrap(labels)
+    in_len = unwrap(input_lengths)
+    lab_len = unwrap(label_lengths)
+
+    def f(lp):
+        x = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)  # [T, B, C]
+        T, B, C = x.shape
+        L = lbl.shape[1]
+        S = 2 * L + 1
+        # extended sequence: blank, l1, blank, l2, ... blank
+        ext = jnp.full((B, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lbl.astype(jnp.int32))
+        same_as_prev2 = jnp.concatenate(
+            [jnp.zeros((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        alpha0 = jnp.full((B, S), NEG)
+        alpha0 = alpha0.at[:, 0].set(x[0, jnp.arange(B), ext[:, 0]])
+        alpha0 = alpha0.at[:, 1].set(jnp.where(
+            lab_len > 0, x[0, jnp.arange(B), ext[:, 1]], NEG))
+
+        def step(alpha, xt):
+            em = xt[jnp.arange(B)[:, None], ext]          # [B, S]
+            stay = alpha
+            prev1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], 1)
+            prev2 = jnp.where(
+                same_as_prev2, NEG,
+                jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], 1))
+            # blanks (even s) can't skip
+            even = (jnp.arange(S) % 2 == 0)[None, :]
+            prev2 = jnp.where(even, NEG, prev2)
+            new = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2) + em
+            return new, new
+
+        _, alphas = jax.lax.scan(step, alpha0, x[1:])
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, S]
+        # per-sample final time index and final states (2*len-1, 2*len)
+        t_idx = jnp.clip(in_len.astype(jnp.int32) - 1, 0, T - 1)
+        last = alphas[t_idx, jnp.arange(B)]               # [B, S]
+        s1 = jnp.clip(2 * lab_len.astype(jnp.int32) - 1, 0, S - 1)
+        s2 = jnp.clip(2 * lab_len.astype(jnp.int32), 0, S - 1)
+        ll = jnp.logaddexp(jnp.take_along_axis(last, s1[:, None], 1),
+                           jnp.take_along_axis(last, s2[:, None], 1))[:, 0]
+        loss = -ll
+        if norm_by_times:
+            loss = loss / jnp.maximum(in_len.astype(jnp.float32), 1.0)
+        if reduction == "mean":
+            # reference/torch semantics: mean of loss / label_length
+            return jnp.mean(loss / jnp.maximum(
+                lab_len.astype(jnp.float32), 1.0))
+        return _reduce(loss, reduction)
+    return apply_op("ctc_loss", f, log_probs)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-T transducer loss (reference loss.py rnnt_loss over warprnnt).
+    input [B, T, U+1, C] joint-network logits; alpha DP over the (T, U) grid
+    (scan over t, inner scan over u) in log domain."""
+    lbl = unwrap(label)
+    in_len = unwrap(input_lengths)
+    lab_len = unwrap(label_lengths)
+
+    def f(lg):
+        x = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)  # [B,T,U1,C]
+        B, T, U1, C = x.shape
+        U = U1 - 1
+        bi = jnp.arange(B)
+        blank_lp = x[..., blank]                                  # [B, T, U+1]
+        if U > 0:
+            idx = lbl[:, :U].astype(jnp.int32)                    # [B, U]
+            y_lp = jnp.take_along_axis(
+                x[:, :, :U, :], idx[:, None, :, None], axis=3)[..., 0]
+            if fastemit_lambda:
+                # FastEmit (torchaudio semantics): boost label-emission
+                # GRADIENTS by (1 + lambda); the loss VALUE is unchanged
+                y_lp = (1.0 + fastemit_lambda) * y_lp \
+                    - fastemit_lambda * jax.lax.stop_gradient(y_lp)
+        else:
+            y_lp = jnp.zeros((B, T, 0))                           # [B, T, U]
+
+        def label_sweep(from_blank, y_row):
+            """Fill one alpha row: u-sequential label moves folded against
+            the per-u blank arrivals (lax.scan over u)."""
+            a0 = from_blank[:, 0]
+            if U == 0:
+                return a0[:, None]
+
+            def u_body(carry, u):
+                lbl_move = carry + y_row[:, u - 1]
+                cur = jnp.logaddexp(from_blank[:, u], lbl_move)
+                return cur, cur
+            _, rest = jax.lax.scan(u_body, a0, jnp.arange(1, U1))
+            return jnp.concatenate([a0[:, None], rest.T], axis=1)
+
+        # t = 0 row: no blank arrivals except the (0,0) origin
+        neg_row = jnp.full((B, U1), NEG).at[:, 0].set(0.0)
+        alpha0 = label_sweep(neg_row, y_lp[:, 0])
+
+        def t_step(alpha_prev, t):
+            from_blank = alpha_prev + blank_lp[:, t - 1]          # [B, U+1]
+            alpha_t = label_sweep(from_blank, y_lp[:, t])
+            return alpha_t, alpha_t
+
+        _, rest_alpha = jax.lax.scan(t_step, alpha0, jnp.arange(1, T))
+        all_alpha = jnp.concatenate([alpha0[None], rest_alpha], axis=0)  # [T,B,U+1]
+
+        t_idx = jnp.clip(in_len.astype(jnp.int32) - 1, 0, T - 1)
+        u_idx = jnp.clip(lab_len.astype(jnp.int32), 0, U1 - 1)
+        final = all_alpha[t_idx, bi, u_idx] + blank_lp[bi, t_idx, u_idx]
+        loss = -final
+        if reduction == "mean":
+            return jnp.mean(loss)
+        return _reduce(loss, reduction)
+    return apply_op("rnnt_loss", f, input)
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """reference loss.py adaptive_log_softmax_with_loss (torch-style
+    adaptive softmax): head covers [0, cutoffs[0]) + one logit per tail
+    cluster; each tail projects down then classifies within its range.
+    Returns (per-sample logprob-of-target, mean NLL loss)."""
+    lbl = unwrap(label)
+    cuts = list(cutoffs)
+    args = [input, head_weight] + list(tail_weights or []) \
+        + ([head_bias] if head_bias is not None else [])
+    n_tail_arrays = len(tail_weights or [])
+
+    def f(x, hw, *rest):
+        tails = rest[:n_tail_arrays]
+        hb = rest[n_tail_arrays:] if head_bias is not None else ()
+        x32 = x.astype(jnp.float32)
+        head = x32 @ hw.astype(jnp.float32)     # head_weight is [in, out]
+        if hb:
+            head = head + hb[0].astype(jnp.float32)
+        head_lp = jax.nn.log_softmax(head, axis=1)
+        shortlist = cuts[0]
+        out = jnp.take_along_axis(
+            head_lp, jnp.clip(lbl, 0, shortlist - 1)[:, None].astype(jnp.int32),
+            axis=1)[:, 0]
+        in_short = lbl < shortlist
+        result = jnp.where(in_short, out, 0.0)
+        for ci in range(len(tails) // 2):
+            lo = cuts[ci]
+            hi = cuts[ci + 1]
+            proj, cls = tails[2 * ci], tails[2 * ci + 1]
+            h = x32 @ proj.astype(jnp.float32)
+            tail_logits = h @ cls.astype(jnp.float32)
+            tail_lp = jax.nn.log_softmax(tail_logits, axis=1)
+            cluster_lp = head_lp[:, shortlist + ci]
+            rel = jnp.clip(lbl - lo, 0, hi - lo - 1)
+            lp = cluster_lp + jnp.take_along_axis(
+                tail_lp, rel[:, None].astype(jnp.int32), axis=1)[:, 0]
+            mask = (lbl >= lo) & (lbl < hi)
+            result = jnp.where(mask, lp, result)
+        return result, -jnp.mean(result)
+    return apply_op("adaptive_log_softmax_with_loss", f, *args)

@@ -1,0 +1,361 @@
+"""Round-2 functional-surface completion, part 2 (reference:
+python/paddle/nn/functional/ — pooling variants, vision sampling, seq2seq
+helpers, attention wrappers, inplace activation forms).
+"""
+from __future__ import annotations
+
+import math as _math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op, unwrap
+from ...core.tensor import Tensor
+from . import activation as _act
+from .pooling import avg_pool1d, avg_pool2d, max_unpool2d
+
+
+# ---- inplace activation forms (reference: elu_/tanh_/... in activation.py) --
+def _inplace(fn):
+    def f(x, *a, **k):
+        out = fn(x, *a, **k)
+        x._data = out._data
+        x._grad_node, x._out_slot = out._grad_node, out._out_slot
+        if not out.stop_gradient:
+            x.stop_gradient = False
+        return x
+    return f
+
+
+elu_ = _inplace(_act.elu)
+hardtanh_ = _inplace(_act.hardtanh)
+leaky_relu_ = _inplace(_act.leaky_relu)
+tanh_ = _inplace(_act.tanh)
+thresholded_relu_ = _inplace(_act.thresholded_relu)
+
+
+# ---- distance ----------------------------------------------------------------
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """reference distance.py pairwise_distance (the PairwiseDistance layer's
+    functional form)."""
+    def f(a, b):
+        d = (a - b).astype(jnp.float32) + epsilon
+        out = jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+        return out[..., None] if keepdim else out
+    return apply_op("pairwise_distance", f, x, y)
+
+
+# ---- LP / fractional pooling -------------------------------------------------
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    """reference pooling.py lp_pool1d: (avg(|x|^p) * k)^(1/p)."""
+    p = float(norm_type)
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    powed = apply_op("lp_pow", lambda a: jnp.abs(a.astype(jnp.float32)) ** p, x)
+    pooled = avg_pool1d(powed, kernel_size, stride, padding,
+                        ceil_mode=ceil_mode, data_format=data_format,
+                        exclusive=False)
+    return apply_op("lp_root",
+                    lambda a: (a * float(k)) ** (1.0 / p), pooled)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    p = float(norm_type)
+    ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    powed = apply_op("lp_pow", lambda a: jnp.abs(a.astype(jnp.float32)) ** p, x)
+    pooled = avg_pool2d(powed, kernel_size, stride, padding,
+                        ceil_mode=ceil_mode, data_format=data_format,
+                        exclusive=False)
+    n = float(np.prod(ks))
+    return apply_op("lp_root", lambda a: (a * n) ** (1.0 / p), pooled)
+
+
+def _fractional_bounds(in_size, out_size, u):
+    """Pseudo-random fractional pooling boundaries (torch-style: alpha =
+    in/out; start_i = ceil(alpha*(i+u)) - ceil(alpha*u))."""
+    alpha = in_size / out_size
+    i = np.arange(out_size + 1)
+    pts = np.ceil(alpha * (i + u)).astype(np.int64) - int(np.ceil(alpha * u))
+    pts = np.clip(pts, 0, in_size)
+    pts[-1] = in_size
+    return pts
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """reference pooling.py fractional_max_pool2d (NCHW)."""
+    from ...core.rng import next_key
+    N, C, H, W = x.shape
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    if random_u is None:
+        key = next_key()
+        u = float(jax.random.uniform(key, (), minval=0.05, maxval=0.95))
+    else:
+        u = float(random_u)
+    hb = _fractional_bounds(H, oh, u)
+    wb = _fractional_bounds(W, ow, u)
+
+    def f(a):
+        a32 = a
+        rows = []
+        for i in range(oh):
+            cols = []
+            for j in range(ow):
+                win = a32[:, :, hb[i]:max(hb[i + 1], hb[i] + 1),
+                          wb[j]:max(wb[j + 1], wb[j] + 1)]
+                cols.append(jnp.max(win, axis=(2, 3)))
+            rows.append(jnp.stack(cols, axis=-1))
+        return jnp.stack(rows, axis=-2)     # [N, C, oh, ow]
+    out = apply_op("fractional_max_pool2d", f, x)
+    if return_mask:
+        # indices of the max inside each fractional window (flat H*W)
+        arr = unwrap(x)
+        m = np.zeros((N, C, oh, ow), np.int32)
+        a_np = np.asarray(arr)
+        for i in range(oh):
+            for j in range(ow):
+                win = a_np[:, :, hb[i]:max(hb[i + 1], hb[i] + 1),
+                           wb[j]:max(wb[j + 1], wb[j] + 1)]
+                flat = win.reshape(N, C, -1)
+                k = np.argmax(flat, axis=-1)
+                wh = win.shape[2], win.shape[3]
+                m[:, :, i, j] = ((hb[i] + k // wh[1]) * W + (wb[j] + k % wh[1]))
+        return out, Tensor(jnp.asarray(m))
+    return out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """reference pooling.py fractional_max_pool3d (NCDHW)."""
+    if return_mask:
+        raise NotImplementedError("fractional_max_pool3d return_mask")
+    from ...core.rng import next_key
+    N, C, D, H, W = x.shape
+    od, oh, ow = (output_size,) * 3 if isinstance(output_size, int) \
+        else tuple(output_size)
+    if random_u is None:
+        u = float(jax.random.uniform(next_key(), (), minval=0.05, maxval=0.95))
+    else:
+        u = float(random_u)
+    db = _fractional_bounds(D, od, u)
+    hb = _fractional_bounds(H, oh, u)
+    wb = _fractional_bounds(W, ow, u)
+
+    def f(a):
+        out = jnp.zeros(a.shape[:2] + (od, oh, ow), a.dtype)
+        for d in range(od):
+            for i in range(oh):
+                for j in range(ow):
+                    win = a[:, :, db[d]:max(db[d + 1], db[d] + 1),
+                            hb[i]:max(hb[i + 1], hb[i] + 1),
+                            wb[j]:max(wb[j + 1], wb[j] + 1)]
+                    out = out.at[:, :, d, i, j].set(jnp.max(win, axis=(2, 3, 4)))
+        return out
+    return apply_op("fractional_max_pool3d", f, x)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW", name=None):
+    """Inverse of a max_pool3d-with-indices (flat D*H*W positions)."""
+    if data_format != "NCDHW":
+        raise ValueError("max_unpool3d supports NCDHW only")
+    ks = (kernel_size,) * 3 if isinstance(kernel_size, int) else tuple(kernel_size)
+    st = ks if stride is None else ((stride,) * 3 if isinstance(stride, int)
+                                    else tuple(stride))
+    pd = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    if output_size is None:
+        sp = x.shape[2:]
+        output_size = tuple((sp[i] - 1) * st[i] - 2 * pd[i] + ks[i]
+                            for i in range(3))
+    Do, Ho, Wo = tuple(output_size)[-3:]
+
+    def f(a, idx):
+        N, C = a.shape[:2]
+        flat = jnp.zeros((N, C, Do * Ho * Wo), a.dtype)
+        ii = jnp.arange(N)[:, None, None]
+        cc = jnp.arange(C)[None, :, None]
+        out = flat.at[ii, cc, idx.reshape(N, C, -1)].set(a.reshape(N, C, -1))
+        return out.reshape(N, C, Do, Ho, Wo)
+    return apply_op("max_unpool3d", f, x, indices)
+
+
+# ---- vision sampling ---------------------------------------------------------
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """reference vision.py affine_grid: theta [N, 2, 3] -> grid [N, H, W, 2]
+    (the 5-element NCDHW/theta [N, 3, 4] volumetric form is not implemented)."""
+    if len(out_shape) == 5:
+        raise NotImplementedError("3-D affine_grid (NCDHW out_shape)")
+    N, _, H, W = (out_shape if len(out_shape) == 4 else
+                  (out_shape[0], 1, out_shape[1], out_shape[2]))
+
+    def f(th):
+        t32 = th.astype(jnp.float32)
+        if align_corners:
+            xs = jnp.linspace(-1.0, 1.0, W)
+            ys = jnp.linspace(-1.0, 1.0, H)
+        else:
+            xs = (jnp.arange(W) * 2 + 1) / W - 1
+            ys = (jnp.arange(H) * 2 + 1) / H - 1
+        gx, gy = jnp.meshgrid(xs, ys)                    # [H, W]
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)        # [H, W, 3]
+        return jnp.einsum("hwk,njk->nhwj", base, t32)    # [N, H, W, 2]
+    return apply_op("affine_grid", f, theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """reference vision.py grid_sample (NCHW + grid [N, Ho, Wo, 2] in
+    [-1, 1] xy order). Bilinear/nearest; zeros/border/reflection padding."""
+    def f(a, g):
+        a32 = a.astype(jnp.float32)
+        N, C, H, W = a32.shape
+        gx, gy = g[..., 0].astype(jnp.float32), g[..., 1].astype(jnp.float32)
+        if align_corners:
+            fx = (gx + 1) * (W - 1) / 2
+            fy = (gy + 1) * (H - 1) / 2
+        else:
+            fx = ((gx + 1) * W - 1) / 2
+            fy = ((gy + 1) * H - 1) / 2
+
+        def reflect(v, lo, hi):
+            rng = hi - lo
+            if rng <= 0:
+                return v
+            t = jnp.mod(v - lo, 2 * rng)
+            return lo + (rng - jnp.abs(t - rng))   # triangle-wave fold
+        if padding_mode == "reflection":
+            fx = reflect(fx, 0.0, W - 1.0)
+            fy = reflect(fy, 0.0, H - 1.0)
+
+        def sample(ix, iy):
+            okx = (ix >= 0) & (ix <= W - 1)
+            oky = (iy >= 0) & (iy <= H - 1)
+            cx = jnp.clip(ix, 0, W - 1).astype(jnp.int32)
+            cy = jnp.clip(iy, 0, H - 1).astype(jnp.int32)
+            v = a32[jnp.arange(N)[:, None, None], :, cy, cx]  # [N,Ho,Wo,C]
+            if padding_mode == "zeros":
+                v = v * (okx & oky)[..., None]
+            return v
+
+        if mode == "nearest":
+            out = sample(jnp.round(fx), jnp.round(fy))
+        else:
+            x0, y0 = jnp.floor(fx), jnp.floor(fy)
+            x1, y1 = x0 + 1, y0 + 1
+            wa = (x1 - fx) * (y1 - fy)
+            wb = (fx - x0) * (y1 - fy)
+            wc = (x1 - fx) * (fy - y0)
+            wd = (fx - x0) * (fy - y0)
+            out = (sample(x0, y0) * wa[..., None] + sample(x1, y0) * wb[..., None]
+                   + sample(x0, y1) * wc[..., None] + sample(x1, y1) * wd[..., None])
+        return jnp.moveaxis(out, -1, 1).astype(a.dtype)   # [N, C, Ho, Wo]
+    return apply_op("grid_sample", f, x, grid)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    """reference extension.py temporal_shift (TSM): shift 1/r channels one
+    frame back, 1/r forward within each segment."""
+    if data_format != "NCHW":
+        raise ValueError("temporal_shift supports NCHW")
+
+    def f(a):
+        NT, C, H, W = a.shape
+        N = NT // seg_num
+        v = a.reshape(N, seg_num, C, H, W)
+        c1 = int(C * shift_ratio)
+        c2 = int(C * 2 * shift_ratio)
+        back = jnp.concatenate([v[:, 1:, :c1], jnp.zeros_like(v[:, :1, :c1])], 1)
+        fwd = jnp.concatenate([jnp.zeros_like(v[:, :1, c1:c2]), v[:, :-1, c1:c2]], 1)
+        keep = v[:, :, c2:]
+        return jnp.concatenate([back, fwd, keep], axis=2).reshape(NT, C, H, W)
+    return apply_op("temporal_shift", f, x)
+
+
+def gather_tree(ids, parents, name=None):
+    """reference extension.py gather_tree: backtrack beam-search parent
+    pointers [T, B, beam] -> full sequences."""
+    def f(idv, par):
+        T = idv.shape[0]
+
+        def step(next_beam, t):
+            # next_beam: [B, beam] beam index selected at t+1
+            cur_parent = jnp.take_along_axis(par[t], next_beam, axis=1)
+            tok = jnp.take_along_axis(idv[t], next_beam, axis=1)
+            return cur_parent, tok
+        init = jnp.broadcast_to(jnp.arange(idv.shape[2])[None, :],
+                                idv.shape[1:]).astype(idv.dtype)
+        _, toks = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return toks[::-1]
+    return apply_op("gather_tree", f, ids, parents)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """reference common.py class_center_sample: keep all positive classes +
+    uniformly sampled negatives; remap labels into the sampled index space."""
+    from ...core.rng import next_key
+    lbl = np.asarray(unwrap(label)).reshape(-1)
+    pos = np.unique(lbl)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        neg_pool = np.setdiff1d(np.arange(num_classes), pos)
+        k = num_samples - len(pos)
+        key = next_key()
+        pick = np.asarray(jax.random.choice(
+            key, len(neg_pool), (k,), replace=False))
+        sampled = np.sort(np.concatenate([pos, neg_pool[pick]]))
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (Tensor(jnp.asarray(remap[lbl])),
+            Tensor(jnp.asarray(sampled.astype(np.int64))))
+
+
+# ---- attention wrappers ------------------------------------------------------
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=False, name=None, **kw):
+    """reference flash_attention.py flashmask_attention: flash attention with
+    sparse row-bound masks. Realized via the dense-mask SDPA path (XLA fuses);
+    the row-bound form maps to an explicit boolean mask."""
+    from .attention import scaled_dot_product_attention
+    mask = None
+    if startend_row_indices is not None:
+        idx = unwrap(startend_row_indices)          # [B, H, S, 1] (causal LT)
+        S = query.shape[1]
+        rows = jnp.arange(S)
+        start = jnp.squeeze(idx, -1)                # [B, Hm, S]
+        # token j is masked for query i when i >= start[j]
+        m = rows[None, None, :, None] < start[:, :, None, :]
+        mask = Tensor(jnp.where(m, 0.0, -jnp.inf).astype(jnp.float32))
+    return scaled_dot_product_attention(query, key, value, attn_mask=mask,
+                                        dropout_p=dropout, is_causal=causal)
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
+                         *a, **kw):
+    """reference flash_attention.py flash_attn_qkvpacked: qkv [B, S, 3, H, D]."""
+    from .attention import flash_attention
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q=None, cu_seqlens_k=None,
+                                *a, **kw):
+    raise NotImplementedError(
+        "varlen packed flash attention: pad to dense [B, S, 3, H, D] and use "
+        "flash_attn_qkvpacked (ragged batching lands with the paged-attention "
+        "serving path)")
+
+
+def sparse_attention(*a, **kw):
+    raise NotImplementedError(
+        "block-sparse attention is CUDA-only in the reference (sparse_attention "
+        "op); on TPU use flashmask_attention for masked patterns")

@@ -11,3 +11,12 @@ from .rnn import (SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN,  # no
 from .transformer import (MultiHeadAttention, Transformer, TransformerEncoder,  # noqa: F401
                           TransformerEncoderLayer, TransformerDecoder,
                           TransformerDecoderLayer)
+from .extras import (PoissonNLLLoss, GaussianNLLLoss, SoftMarginLoss,  # noqa: F401
+                     MultiLabelSoftMarginLoss, MultiMarginLoss,
+                     TripletMarginWithDistanceLoss, CTCLoss, RNNTLoss,
+                     HSigmoidLoss, AdaptiveLogSoftmaxWithLoss, Softmax2D,
+                     Unflatten, ParameterDict, ZeroPad1D, ZeroPad3D,
+                     LPPool1D, LPPool2D, FractionalMaxPool2D,
+                     FractionalMaxPool3D, MaxUnPool3D, BeamSearchDecoder,
+                     dynamic_decode)
+from .rnn import RNNCellBase  # noqa: F401

@@ -1,0 +1,327 @@
+"""Round-2 nn-surface completion tests: losses vs torch goldens, vision
+sampling ops, LP/fractional pooling, seq2seq decode."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+rng = np.random.RandomState(0)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestLossGoldens:
+    def test_poisson_nll(self):
+        x, y = rng.randn(4, 5).astype(np.float32), rng.poisson(2.0, (4, 5)).astype(np.float32)
+        ours = float(F.poisson_nll_loss(_t(x), _t(y))._data)
+        ref = float(torch.nn.functional.poisson_nll_loss(
+            torch.tensor(x), torch.tensor(y)))
+        np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+    def test_gaussian_nll(self):
+        x = rng.randn(4, 5).astype(np.float32)
+        y = rng.randn(4, 5).astype(np.float32)
+        v = rng.rand(4, 5).astype(np.float32) + 0.1
+        ours = float(F.gaussian_nll_loss(_t(x), _t(y), _t(v))._data)
+        ref = float(torch.nn.functional.gaussian_nll_loss(
+            torch.tensor(x), torch.tensor(y), torch.tensor(v)))
+        np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+    def test_soft_margin(self):
+        x = rng.randn(6).astype(np.float32)
+        y = np.sign(rng.randn(6)).astype(np.float32)
+        ours = float(F.soft_margin_loss(_t(x), _t(y))._data)
+        ref = float(torch.nn.functional.soft_margin_loss(
+            torch.tensor(x), torch.tensor(y)))
+        np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+    def test_multi_label_soft_margin(self):
+        x = rng.randn(3, 4).astype(np.float32)
+        y = (rng.rand(3, 4) > 0.5).astype(np.float32)
+        ours = float(F.multi_label_soft_margin_loss(_t(x), _t(y))._data)
+        ref = float(torch.nn.functional.multilabel_soft_margin_loss(
+            torch.tensor(x), torch.tensor(y)))
+        np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+    def test_multi_margin(self):
+        x = rng.randn(5, 4).astype(np.float32)
+        y = rng.randint(0, 4, 5)
+        ours = float(F.multi_margin_loss(_t(x), _t(y))._data)
+        ref = float(torch.nn.functional.multi_margin_loss(
+            torch.tensor(x), torch.tensor(y)))
+        np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+    def test_triplet_with_distance(self):
+        a, p, n = (rng.randn(4, 8).astype(np.float32) for _ in range(3))
+        ours = float(F.triplet_margin_with_distance_loss(
+            _t(a), _t(p), _t(n))._data)
+        ref = float(torch.nn.functional.triplet_margin_with_distance_loss(
+            torch.tensor(a), torch.tensor(p), torch.tensor(n)))
+        np.testing.assert_allclose(ours, ref, rtol=1e-4)
+
+    def test_ctc_matches_torch(self):
+        T, B, C, L = 8, 3, 5, 3
+        logits = rng.randn(T, B, C).astype(np.float32)
+        labels = rng.randint(1, C, (B, L)).astype(np.int32)
+        in_len = np.array([8, 7, 6], np.int64)
+        lab_len = np.array([3, 2, 1], np.int64)
+        ours = float(F.ctc_loss(_t(logits), _t(labels), _t(in_len),
+                                _t(lab_len))._data)
+        ref = float(torch.nn.functional.ctc_loss(
+            torch.tensor(logits).log_softmax(-1), torch.tensor(labels.astype(np.int64)),
+            torch.tensor(in_len), torch.tensor(lab_len), blank=0,
+            reduction="mean"))
+        np.testing.assert_allclose(ours, ref, rtol=1e-4)
+
+    def test_rnnt_matches_bruteforce(self):
+        """Tiny grid: enumerate all monotonic paths explicitly."""
+        B, T, U, C = 1, 3, 2, 4
+        logits = rng.randn(B, T, U + 1, C).astype(np.float32)
+        label = np.array([[1, 2]], np.int32)
+        ours = float(F.rnnt_loss(_t(logits), _t(label),
+                                 _t(np.array([T], np.int64)),
+                                 _t(np.array([U], np.int64)),
+                                 reduction="mean")._data)
+        # brute force over all interleavings of T blanks and U labels
+        import itertools
+        import scipy.special
+        lp = torch.tensor(logits).log_softmax(-1).numpy()[0]
+        paths = []
+        for positions in itertools.combinations(range(T + U - 1 + 1), U):
+            # walk the grid: at each step emit label (u+1) or blank (t+1)
+            t = u = 0
+            s = 0.0
+            ok = True
+            seq = ["L" if i in positions else "B" for i in range(T + U)]
+            # last move must leave t==T when all emitted; simulate
+            t = u = 0
+            s = 0.0
+            for mv in seq:
+                if mv == "L":
+                    if u >= U or t >= T:
+                        ok = False
+                        break
+                    s += lp[t, u, label[0, u]]
+                    u += 1
+                else:
+                    if t >= T:
+                        ok = False
+                        break
+                    s += lp[t, u, 0]
+                    t += 1
+            if ok and t == T and u == U:
+                paths.append(s)
+        ref = -scipy.special.logsumexp(paths)
+        np.testing.assert_allclose(ours, ref, rtol=1e-4)
+
+    def test_dice_log_npair_smoke(self):
+        probs = torch.softmax(torch.tensor(rng.randn(2, 6, 3).astype(np.float32)), -1).numpy()
+        lbl = rng.randint(0, 3, (2, 6, 1))
+        d = float(F.dice_loss(_t(probs), _t(lbl))._data)
+        assert 0 <= d <= 1
+        p = np.clip(rng.rand(4, 1).astype(np.float32), 0.05, 0.95)
+        y = (rng.rand(4, 1) > 0.5).astype(np.float32)
+        ll = np.asarray(F.log_loss(_t(p), _t(y))._data)
+        ref = -(y * np.log(p + 1e-4) + (1 - y) * np.log(1 - p + 1e-4))
+        np.testing.assert_allclose(ll, ref, rtol=1e-4)
+        a, pos = rng.randn(4, 8).astype(np.float32), rng.randn(4, 8).astype(np.float32)
+        npl = float(F.npair_loss(_t(a), _t(pos), _t(np.arange(4)))._data)
+        assert np.isfinite(npl)
+
+    def test_hsigmoid_is_normalized(self):
+        """Sum over classes of P(c|x) must be 1 under the default tree."""
+        C, D = 8, 6
+        paddle.seed(0)
+        layer = nn.HSigmoidLoss(D, C)
+        x = _t(rng.randn(1, D).astype(np.float32))
+        total = 0.0
+        for c in range(C):
+            loss = layer(x, _t(np.array([c], np.int64)))
+            total += float(np.exp(-np.asarray(loss._data)).reshape(-1)[0])
+        np.testing.assert_allclose(total, 1.0, rtol=1e-4)
+
+    def test_margin_cross_entropy_reduces_to_ce_at_zero_margin(self):
+        cos = np.clip(rng.randn(4, 6).astype(np.float32) * 0.3, -1, 1)
+        lbl = rng.randint(0, 6, 4)
+        ours = float(F.margin_cross_entropy(_t(cos), _t(lbl), margin1=1.0,
+                                            margin2=0.0, margin3=0.0,
+                                            scale=10.0)._data)
+        ref = float(torch.nn.functional.cross_entropy(
+            torch.tensor(cos * 10.0), torch.tensor(lbl)))
+        np.testing.assert_allclose(ours, ref, rtol=1e-4)
+
+    def test_adaptive_log_softmax(self):
+        paddle.seed(0)
+        layer = nn.AdaptiveLogSoftmaxWithLoss(16, 20, cutoffs=[4, 10])
+        x = _t(rng.randn(8, 16).astype(np.float32))
+        full = np.asarray(layer.log_prob(x)._data)
+        np.testing.assert_allclose(np.exp(full).sum(-1), np.ones(8), rtol=1e-4)
+        lbl = rng.randint(0, 20, 8)
+        out, loss = layer(x, _t(lbl))
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   full[np.arange(8), lbl], rtol=1e-4)
+        np.testing.assert_allclose(float(loss._data),
+                                   -full[np.arange(8), lbl].mean(), rtol=1e-4)
+
+
+class TestVisionSampling:
+    def test_grid_sample_matches_torch(self):
+        x = rng.randn(2, 3, 5, 6).astype(np.float32)
+        grid = (rng.rand(2, 4, 4, 2).astype(np.float32) * 2 - 1)
+        for align in (True, False):
+            ours = np.asarray(F.grid_sample(_t(x), _t(grid),
+                                            align_corners=align)._data)
+            ref = torch.nn.functional.grid_sample(
+                torch.tensor(x), torch.tensor(grid), mode="bilinear",
+                padding_mode="zeros", align_corners=align).numpy()
+            np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+    def test_grid_sample_reflection_and_border(self):
+        x = rng.randn(1, 2, 5, 5).astype(np.float32)
+        grid = (rng.rand(1, 3, 3, 2).astype(np.float32) * 3 - 1.5)  # OOB too
+        for pm in ("reflection", "border"):
+            ours = np.asarray(F.grid_sample(_t(x), _t(grid), padding_mode=pm,
+                                            align_corners=True)._data)
+            ref = torch.nn.functional.grid_sample(
+                torch.tensor(x), torch.tensor(grid), mode="bilinear",
+                padding_mode=pm, align_corners=True).numpy()
+            np.testing.assert_allclose(ours, ref, atol=1e-5, err_msg=pm)
+
+    def test_affine_grid_matches_torch(self):
+        theta = rng.randn(2, 2, 3).astype(np.float32)
+        ours = np.asarray(F.affine_grid(_t(theta), (2, 3, 4, 5))._data)
+        ref = torch.nn.functional.affine_grid(
+            torch.tensor(theta), (2, 3, 4, 5), align_corners=True).numpy()
+        np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+    def test_temporal_shift(self):
+        x = rng.randn(4, 8, 2, 2).astype(np.float32)   # N*T with T=2
+        out = np.asarray(F.temporal_shift(_t(x), seg_num=2,
+                                          shift_ratio=0.25)._data)
+        v = x.reshape(2, 2, 8, 2, 2)
+        np.testing.assert_allclose(out.reshape(2, 2, 8, 2, 2)[:, 0, :2],
+                                   v[:, 1, :2])          # shifted back
+        np.testing.assert_allclose(out.reshape(2, 2, 8, 2, 2)[:, 1, 2:4],
+                                   v[:, 0, 2:4])         # shifted forward
+
+
+class TestPoolingVariants:
+    def test_lp_pool_matches_torch(self):
+        x = np.abs(rng.randn(2, 3, 8).astype(np.float32)) + 0.1
+        ours = np.asarray(F.lp_pool1d(_t(x), 2, 2)._data)
+        ref = torch.nn.functional.lp_pool1d(torch.tensor(x), 2, 2).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4)
+        x2 = np.abs(rng.randn(2, 3, 6, 6).astype(np.float32)) + 0.1
+        ours2 = np.asarray(F.lp_pool2d(_t(x2), 3, 2)._data)
+        ref2 = torch.nn.functional.lp_pool2d(torch.tensor(x2), 3, 2).numpy()
+        np.testing.assert_allclose(ours2, ref2, rtol=1e-4)
+
+    def test_fractional_pool_shapes_and_values(self):
+        x = rng.randn(1, 2, 9, 9).astype(np.float32)
+        out = F.fractional_max_pool2d(_t(x), 4, random_u=0.5)
+        assert out.shape == [1, 2, 4, 4]
+        assert np.asarray(out._data).max() <= x.max() + 1e-6
+        out3 = F.fractional_max_pool3d(
+            _t(rng.randn(1, 1, 6, 6, 6).astype(np.float32)), 3, random_u=0.4)
+        assert out3.shape == [1, 1, 3, 3, 3]
+
+    def test_max_unpool3d_roundtrip_positions(self):
+        x = rng.randn(1, 1, 2, 2, 2).astype(np.float32)
+        idx = np.array([[[[[0, 9], [18, 27]], [[36, 45], [54, 63]]]]])
+        up = F.max_unpool3d(_t(x), _t(idx.astype(np.int32)), 2)
+        u = np.asarray(up._data)
+        assert u.shape == (1, 1, 4, 4, 4)
+        np.testing.assert_allclose(u.reshape(-1)[[0, 9, 18, 27, 36, 45, 54, 63]],
+                                   x.reshape(-1))
+
+
+class TestSeq2Seq:
+    def _cell_and_emb(self, V=6, H=8):
+        paddle.seed(0)
+        cell = nn.GRUCell(H, H)
+        emb = nn.Embedding(V, H)
+        proj = nn.Linear(H, V)
+        return cell, emb, proj
+
+    def test_beam1_equals_greedy(self):
+        V = 6
+        cell, emb, proj = self._cell_and_emb(V)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=V - 1,
+                                   beam_size=1, embedding_fn=emb,
+                                   output_fn=proj)
+        h0 = paddle.to_tensor(rng.randn(2, 8).astype(np.float32))
+        ids, lp = nn.dynamic_decode(dec, h0, max_step_num=5)
+        assert ids.shape[0] == 2 and ids.shape[1] == 1
+        # greedy reference
+        import jax.numpy as jnp
+        tok = paddle.to_tensor(np.zeros(2, np.int32))
+        state = paddle.to_tensor(np.asarray(h0._data))
+        for t in range(ids.shape[2]):
+            out, state = cell(emb(tok), state)
+            logits = np.asarray(proj(out)._data)
+            nxt = logits.argmax(-1)
+            np.testing.assert_array_equal(np.asarray(ids._data)[:, 0, t], nxt)
+            tok = paddle.to_tensor(nxt.astype(np.int32))
+            if (nxt == V - 1).all():
+                break
+
+    def test_beam_scores_sorted(self):
+        V = 6
+        cell, emb, proj = self._cell_and_emb(V)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=V - 1,
+                                   beam_size=3, embedding_fn=emb,
+                                   output_fn=proj)
+        h0 = paddle.to_tensor(rng.randn(2, 8).astype(np.float32))
+        ids, lp = nn.dynamic_decode(dec, h0, max_step_num=4)
+        scores = np.asarray(lp._data)
+        assert ids.shape[:2] == [2, 3]
+        assert (np.diff(scores, axis=1) <= 1e-5).all()   # best beam first
+
+    def test_gather_tree(self):
+        ids = np.array([[[1, 2]], [[3, 4]]], np.int64)        # [T=2, B=1, K=2]
+        parents = np.array([[[0, 0]], [[1, 0]]], np.int64)
+        out = np.asarray(F.gather_tree(_t(ids), _t(parents))._data)
+        assert out.shape == (2, 1, 2)
+
+
+class TestMiscLayers:
+    def test_softmax2d_unflatten_zeropads(self):
+        x = _t(rng.randn(2, 3, 4, 4).astype(np.float32))
+        s = np.asarray(nn.Softmax2D()(x)._data)
+        np.testing.assert_allclose(s.sum(axis=1), np.ones((2, 4, 4)),
+                                   rtol=1e-5)
+        u = nn.Unflatten(1, [3, 1])(_t(rng.randn(2, 3).astype(np.float32)))
+        assert u.shape == [2, 3, 1]
+        z1 = nn.ZeroPad1D([1, 2])(_t(rng.randn(1, 2, 4).astype(np.float32)))
+        assert z1.shape == [1, 2, 7]
+        z3 = nn.ZeroPad3D([1, 1, 1, 1, 1, 1])(
+            _t(rng.randn(1, 1, 2, 2, 2).astype(np.float32)))
+        assert z3.shape == [1, 1, 4, 4, 4]
+
+    def test_parameter_dict(self):
+        pd = nn.ParameterDict({"a": paddle.create_parameter([2, 2], "float32")})
+        pd["b"] = paddle.create_parameter([3], "float32")
+        assert "a" in pd and len(pd) == 2
+        assert len(list(pd.items())) == 2
+
+    def test_inplace_activations(self):
+        t = _t(np.array([-2.0, 2.0], np.float32))
+        F.tanh_(t)
+        np.testing.assert_allclose(np.asarray(t._data), np.tanh([-2.0, 2.0]),
+                                   rtol=1e-6)
+        t2 = _t(np.array([-2.0, 2.0], np.float32))
+        F.leaky_relu_(t2)
+        np.testing.assert_allclose(np.asarray(t2._data), [-0.02, 2.0],
+                                   rtol=1e-5)
+
+    def test_pairwise_distance_matches_torch(self):
+        a, b = rng.randn(4, 6).astype(np.float32), rng.randn(4, 6).astype(np.float32)
+        ours = np.asarray(F.pairwise_distance(_t(a), _t(b))._data)
+        ref = torch.nn.functional.pairwise_distance(
+            torch.tensor(a), torch.tensor(b)).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4)
