@@ -939,3 +939,88 @@ def _kl_dirichlet(p, q):
                 - gl(qa.sum(-1)) + gl(qa).sum(-1)
                 + ((pa - qa) * (dg(pa) - dg(p0)[..., None])).sum(-1))
     return apply_op("kl_dirichlet", f, p.concentration, q.concentration)
+
+
+class ExponentialFamily(Distribution):
+    """reference distribution/exponential_family.py: base for natural-
+    parameter families; entropy via the Bregman identity when a subclass
+    provides natural parameters + log normalizer."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0
+
+    def entropy(self):
+        """H = A(eta) - sum_i eta_i * dA/deta_i - E[log h(x)], with the
+        sufficient-statistic means obtained by autodiff of the log normalizer
+        (the reference's Bregman-divergence trick)."""
+        import jax
+        nat = [(_a(p) if isinstance(p, Tensor) else jnp.asarray(p))
+               for p in self._natural_parameters]
+        lg = lambda *ps: jnp.sum(self._log_normalizer(*ps))
+        a_val = self._log_normalizer(*nat)
+        grads = jax.grad(lg, argnums=tuple(range(len(nat))))(*nat)
+        ent = a_val - self._mean_carrier_measure
+        bs = tuple(self.batch_shape)
+        for eta, g in zip(nat, grads):
+            term = (eta * g).reshape(bs + (-1,)).sum(-1) if bs else \
+                jnp.sum(eta * g)
+            ent = ent - term
+        return _t(ent)
+
+
+class LKJCholesky(Distribution):
+    """reference distribution/lkj_cholesky.py: distribution over Cholesky
+    factors of correlation matrices (LKJ(eta)); onion-method sampling."""
+
+    def __init__(self, dim, concentration=1.0, sample_method="onion"):
+        if dim < 2:
+            raise ValueError("dim must be >= 2")
+        self.dim = dim
+        self.concentration = float(_a(concentration)) if isinstance(
+            concentration, Tensor) else float(concentration)
+        super().__init__(batch_shape=(), event_shape=(dim, dim))
+
+    def sample(self, shape=()):
+        import jax
+        from ..core.rng import next_key
+        shape = tuple(shape)
+        d, eta = self.dim, self.concentration
+        key = next_key()
+        # onion method (Lewandowski et al. 2009): build row by row
+        L = jnp.zeros(shape + (d, d))
+        L = L.at[..., 0, 0].set(1.0)
+        for i in range(1, d):
+            key, k1, k2 = jax.random.split(key, 3)
+            beta_ab = eta + (d - 1 - i) / 2.0
+            y = jax.random.beta(k1, i / 2.0, beta_ab, shape)   # squared radius
+            u = jax.random.normal(k2, shape + (i,))
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            L = L.at[..., i, :i].set(jnp.sqrt(y)[..., None] * u)
+            L = L.at[..., i, i].set(jnp.sqrt(1 - y))
+        return _t(L)
+
+    def log_prob(self, value):
+        """log p(L) for a Cholesky factor of a correlation matrix
+        (reference/torch LKJCholesky.log_prob closed form)."""
+        import scipy.special as ss
+        import math as _m
+        d, eta = self.dim, self.concentration
+        Lv = _a(value) if isinstance(value, Tensor) else jnp.asarray(value)
+        diag = jnp.diagonal(Lv, axis1=-2, axis2=-1)[..., 1:]
+        order = np.arange(2, d + 1)
+        exponents = jnp.asarray(d - order + 2 * eta - 2, jnp.float32)
+        unnorm = jnp.sum(exponents * jnp.log(jnp.maximum(diag, 1e-30)), -1)
+        dm1 = d - 1
+        alpha = eta + 0.5 * dm1
+        norm = (0.5 * dm1 * _m.log(_m.pi)
+                + float(ss.multigammaln(alpha - 0.5, dm1))
+                - dm1 * float(ss.gammaln(alpha)))
+        return _t(unnorm - norm)

@@ -120,6 +120,15 @@ def random_split(dataset, lengths, generator=None):
 
 
 # ---- samplers ----------------------------------------------------------------
+def _epoch_seed(generator):
+    """Fresh seed per epoch: advance the Generator's key stream (a fixed
+    initial_seed would repeat the identical permutation every epoch)."""
+    if generator is None:
+        return int(np.random.randint(0, 2 ** 31 - 1))
+    key = np.asarray(generator.next_key())
+    return int(np.uint32(key[-1]))
+
+
 class Sampler:
     def __init__(self, data_source=None):
         self.data_source = data_source
@@ -149,9 +158,7 @@ class RandomSampler(Sampler):
 
     def __iter__(self):
         n = len(self.data_source)
-        seed = int(np.random.randint(0, 2 ** 31 - 1)) if self.generator is None \
-            else self.generator.initial_seed()
-        rng = np.random.RandomState(seed)
+        rng = np.random.RandomState(_epoch_seed(self.generator))
         if self.replacement:
             yield from rng.randint(0, n, self.num_samples).tolist()
         else:
@@ -449,3 +456,19 @@ class DataLoader:
 
 def get_worker_info():
     return None
+
+
+class SubsetRandomSampler(Sampler):
+    """reference io/dataloader/sampler.py SubsetRandomSampler."""
+
+    def __init__(self, indices, generator=None):
+        self.indices = list(indices)
+        self.generator = generator
+
+    def __iter__(self):
+        perm = np.random.RandomState(
+            _epoch_seed(self.generator)).permutation(len(self.indices))
+        return iter([self.indices[i] for i in perm])
+
+    def __len__(self):
+        return len(self.indices)

@@ -160,3 +160,24 @@ def load(path, **configs):
     with open(path + ".pdiparams", "rb") as f:
         meta = pickle.load(f)
     return TranslatedLayer(exported, meta["params"], meta["names"])
+
+
+_verbosity = 0
+_code_level = 0
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """reference jit/dy2static/logging_utils.py set_verbosity: controls how
+    chatty the capture/transcription pipeline is."""
+    global _verbosity
+    _verbosity = int(level)
+    import logging
+    logging.getLogger("paddle_tpu.jit").setLevel(
+        logging.DEBUG if level else logging.WARNING)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """reference jit set_code_level: at >0, log the captured program (here:
+    the jaxpr of the compiled step) when compilation happens."""
+    global _code_level
+    _code_level = int(level)

@@ -279,8 +279,11 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         last = alphas[t_idx, jnp.arange(B)]               # [B, S]
         s1 = jnp.clip(2 * lab_len.astype(jnp.int32) - 1, 0, S - 1)
         s2 = jnp.clip(2 * lab_len.astype(jnp.int32), 0, S - 1)
-        ll = jnp.logaddexp(jnp.take_along_axis(last, s1[:, None], 1),
-                           jnp.take_along_axis(last, s2[:, None], 1))[:, 0]
+        a1 = jnp.take_along_axis(last, s1[:, None], 1)[:, 0]
+        a2 = jnp.take_along_axis(last, s2[:, None], 1)[:, 0]
+        # empty target: only the all-blank state exists (s1 would alias s2
+        # and double-count it)
+        ll = jnp.where(lab_len > 0, jnp.logaddexp(a1, a2), a2)
         loss = -ll
         if norm_by_times:
             loss = loss / jnp.maximum(in_len.astype(jnp.float32), 1.0)

@@ -84,6 +84,14 @@ def _fractional_bounds(in_size, out_size, u):
     return pts
 
 
+def _frac_window(bounds, i, k, limit):
+    """[start, end) of fractional window i: pseudo-random partition cell, or
+    an overlapping k-sized window at the cell's start when kernel_size set."""
+    lo = bounds[i]
+    hi = bounds[i + 1] if k is None else min(lo + k, limit)
+    return lo, max(hi, lo + 1)
+
+
 def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
                           return_mask=False, name=None):
     """reference pooling.py fractional_max_pool2d (NCHW)."""
@@ -98,32 +106,34 @@ def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
         u = float(random_u)
     hb = _fractional_bounds(H, oh, u)
     wb = _fractional_bounds(W, ow, u)
+    ks = None if kernel_size is None else (
+        (kernel_size, kernel_size) if isinstance(kernel_size, int)
+        else tuple(kernel_size))
+    kh, kw = (None, None) if ks is None else ks
 
     def f(a):
-        a32 = a
         rows = []
         for i in range(oh):
             cols = []
             for j in range(ow):
-                win = a32[:, :, hb[i]:max(hb[i + 1], hb[i] + 1),
-                          wb[j]:max(wb[j + 1], wb[j] + 1)]
-                cols.append(jnp.max(win, axis=(2, 3)))
+                h0, h1 = _frac_window(hb, i, kh, H)
+                w0, w1 = _frac_window(wb, j, kw, W)
+                cols.append(jnp.max(a[:, :, h0:h1, w0:w1], axis=(2, 3)))
             rows.append(jnp.stack(cols, axis=-1))
         return jnp.stack(rows, axis=-2)     # [N, C, oh, ow]
     out = apply_op("fractional_max_pool2d", f, x)
     if return_mask:
         # indices of the max inside each fractional window (flat H*W)
-        arr = unwrap(x)
+        a_np = np.asarray(unwrap(x))
         m = np.zeros((N, C, oh, ow), np.int32)
-        a_np = np.asarray(arr)
         for i in range(oh):
             for j in range(ow):
-                win = a_np[:, :, hb[i]:max(hb[i + 1], hb[i] + 1),
-                           wb[j]:max(wb[j + 1], wb[j] + 1)]
-                flat = win.reshape(N, C, -1)
-                k = np.argmax(flat, axis=-1)
-                wh = win.shape[2], win.shape[3]
-                m[:, :, i, j] = ((hb[i] + k // wh[1]) * W + (wb[j] + k % wh[1]))
+                h0, h1 = _frac_window(hb, i, kh, H)
+                w0, w1 = _frac_window(wb, j, kw, W)
+                win = a_np[:, :, h0:h1, w0:w1]
+                k = np.argmax(win.reshape(N, C, -1), axis=-1)
+                ww = win.shape[3]
+                m[:, :, i, j] = ((h0 + k // ww) * W + (w0 + k % ww))
         return out, Tensor(jnp.asarray(m))
     return out
 
@@ -145,15 +155,20 @@ def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
     hb = _fractional_bounds(H, oh, u)
     wb = _fractional_bounds(W, ow, u)
 
+    ks = None if kernel_size is None else (
+        (kernel_size,) * 3 if isinstance(kernel_size, int)
+        else tuple(kernel_size))
+
     def f(a):
         out = jnp.zeros(a.shape[:2] + (od, oh, ow), a.dtype)
         for d in range(od):
             for i in range(oh):
                 for j in range(ow):
-                    win = a[:, :, db[d]:max(db[d + 1], db[d] + 1),
-                            hb[i]:max(hb[i + 1], hb[i] + 1),
-                            wb[j]:max(wb[j + 1], wb[j] + 1)]
-                    out = out.at[:, :, d, i, j].set(jnp.max(win, axis=(2, 3, 4)))
+                    d0, d1 = _frac_window(db, d, None if ks is None else ks[0], D)
+                    h0, h1 = _frac_window(hb, i, None if ks is None else ks[1], H)
+                    w0, w1 = _frac_window(wb, j, None if ks is None else ks[2], W)
+                    out = out.at[:, :, d, i, j].set(
+                        jnp.max(a[:, :, d0:d1, h0:h1, w0:w1], axis=(2, 3, 4)))
         return out
     return apply_op("fractional_max_pool3d", f, x)
 
@@ -229,8 +244,13 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
             t = jnp.mod(v - lo, 2 * rng)
             return lo + (rng - jnp.abs(t - rng))   # triangle-wave fold
         if padding_mode == "reflection":
-            fx = reflect(fx, 0.0, W - 1.0)
-            fy = reflect(fy, 0.0, H - 1.0)
+            if align_corners:
+                fx = reflect(fx, 0.0, W - 1.0)
+                fy = reflect(fy, 0.0, H - 1.0)
+            else:
+                # torch convention: reflect about pixel EDGES, then clip
+                fx = jnp.clip(reflect(fx, -0.5, W - 0.5), 0, W - 1)
+                fy = jnp.clip(reflect(fy, -0.5, H - 0.5), 0, H - 1)
 
         def sample(ix, iy):
             okx = (ix >= 0) & (ix <= W - 1)

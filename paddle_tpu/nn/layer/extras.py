@@ -82,7 +82,10 @@ class HSigmoidLoss(Layer):
                  bias_attr=None, is_custom=False, is_sparse=False, name=None):
         super().__init__()
         self.num_classes = num_classes
-        n_nodes = num_classes  # heap rows 0..num_classes-1 cover internals
+        # the complete binary tree over num_classes leaves has exactly
+        # num_classes - 1 internal nodes (heap ids 1..C-1 -> rows 0..C-2),
+        # matching the reference's [num_classes - 1, feature_size] weight
+        n_nodes = num_classes - 1
         from ..initializer import XavierUniform, Constant
         self.weight = self.create_parameter(
             [n_nodes, feature_size], attr=weight_attr,

@@ -377,3 +377,53 @@ class _SparseNN:
 
 
 nn = _SparseNN()
+
+
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+isnan = _unary("isnan", jnp.isnan)
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix x dense vector (reference sparse/binary.py mv)."""
+    v = unwrap(vec)
+    return Tensor(_coo(x) @ v)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta * input + alpha * (x @ y) with sparse x (reference addmm)."""
+    dense_in = unwrap(input)
+    yv = unwrap(y)
+    return Tensor(beta * dense_in + alpha * (_coo(x) @ yv))
+
+
+def mask_as(x, mask, name=None):
+    """Keep x's entries at mask's nonzero coordinates (reference mask_as)."""
+    if isinstance(mask, (SparseCooTensor, SparseCsrTensor)):
+        idx = _coo(mask).indices.T                      # [ndim, nnz]
+    else:
+        mm = unwrap(mask)
+        idx = jnp.stack(jnp.nonzero(mm != 0), axis=0)
+    xv = unwrap(x) if isinstance(x, Tensor) else _coo(x).todense()
+    vals = xv[tuple(idx)]
+    return sparse_coo_tensor(idx, vals, xv.shape)
+
+
+def slice(x, axes, starts, ends, name=None):
+    """Dense-slice a sparse tensor, result sparse (reference sparse slice)."""
+    import builtins
+    dense = _coo(x).todense()
+    slices = [builtins.slice(None)] * dense.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        slices[ax] = builtins.slice(int(s), int(e))
+    out = dense[tuple(slices)]
+    idx = jnp.stack(jnp.nonzero(out != 0), axis=0)
+    return sparse_coo_tensor(idx, out[tuple(idx)], out.shape)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """reference sparse pca_lowrank: densify (tiny factor matrices) and run
+    the dense routine."""
+    from ..ops.linalg_extra import pca_lowrank as _dense_pca
+    dense = Tensor(_coo(x).todense())
+    return _dense_pca(dense, q=q, center=center, niter=niter)

@@ -166,3 +166,343 @@ class Executor:
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     from ..autograd import grad
     return grad(targets, inputs, grad_outputs=target_gradients, allow_unused=True)
+
+
+# ---- round-2 compat surface (reference python/paddle/static/__init__.py) ----
+Variable = Tensor            # the static Variable IS the capture-aware Tensor
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """reference static append_backward: run the tape backward over the
+    recorded program and return (param, grad) pairs."""
+    from ..autograd import backward as _bw
+    _bw([loss])
+    params = parameter_list or [
+        t for t in _iter_recorded_params(loss) if not t.stop_gradient]
+    return [(p, p.grad) for p in params if p.grad is not None]
+
+
+def _iter_recorded_params(root):
+    seen, out, stack = set(), [], [root._grad_node]
+    while stack:
+        node = stack.pop()
+        if node is None or id(node) in seen:
+            continue
+        seen.add(id(node))
+        for inp in node.inputs:
+            if inp is None:
+                continue
+            if inp._grad_node is None:
+                out.append(inp)
+            else:
+                stack.append(inp._grad_node)
+    return out
+
+
+class Scope:
+    """reference global_scope(): name -> variable store."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, Tensor(jnp.zeros(())))
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope():
+    return _scope_stack[-1]
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
+
+
+class BuildStrategy:
+    """reference BuildStrategy: fusion/memory knobs. XLA owns these choices;
+    the attributes are accepted and recorded."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.memory_optimize = True
+        self.fuse_elewise_add_act_ops = True
+        self.build_cinn_pass = False
+
+
+class CompiledProgram:
+    """reference CompiledProgram: wraps a Program for execution — here the
+    Program's replay graph is already the compiled artifact."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy or BuildStrategy()
+
+
+class ExponentialMovingAverage:
+    """reference static ExponentialMovingAverage: EMA shadow weights with
+    apply/restore (dygraph-friendly realization)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+        self._params = []
+
+    def update(self, parameters=None):
+        from .. import ops
+        params = parameters if parameters is not None else self._params
+        if parameters is not None:
+            self._params = list(parameters)
+        for p in params:
+            prev = self._shadow.get(id(p))
+            cur = p._data if hasattr(p, "_data") else p
+            self._shadow[id(p)] = cur if prev is None else \
+                self._decay * prev + (1 - self._decay) * cur
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {id(p): p._data for p in self._params}
+        for p in self._params:
+            if id(p) in self._shadow:
+                p._data = self._shadow[id(p)]
+        try:
+            yield self
+        finally:
+            if need_restore:
+                for p in self._params:
+                    p._data = self._backup.get(id(p), p._data)
+                self._backup = {}
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data = self._backup[id(p)]
+        self._backup = {}
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    t = Tensor(jnp.full(tuple(shape), value, dtype))
+    t.name = name
+    if name:
+        global_scope()._vars[name] = t
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..nn.initializer import _resolve, XavierUniform, Constant
+    default = default_initializer or (Constant(0.0) if is_bias
+                                      else XavierUniform())
+    pattr, init = _resolve(attr, default)
+    from ..core.tensor import Parameter
+    data = init(list(shape), dtype)
+    return Parameter(data, name=name or (pattr.name if pattr else None))
+
+
+def cpu_places(device_count=None):
+    import jax
+    from ..core.device import Place
+    n = device_count or len([d for d in jax.devices() if d.platform == "cpu"]) or 1
+    devs = [d for d in jax.devices() if d.platform == "cpu"] or jax.devices()
+    return [Place(devs[i % len(devs)]) for i in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Compat: resolves to the available accelerator devices on this build."""
+    import jax
+    from ..core.device import Place
+    devs = jax.devices()
+    ids = device_ids if device_ids is not None else range(len(devs))
+    return [Place(devs[i % len(devs)]) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """reference device_guard: op placement hint. XLA places ops; accepted
+    for compatibility."""
+    yield
+
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    yield
+
+
+def set_ipu_shard(layer, index=-1, stage=-1):
+    return layer
+
+
+class IpuStrategy:
+    def __init__(self):
+        raise NotImplementedError("IPU support is not part of the TPU build")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("IPU support is not part of the TPU build")
+
+
+class WeightNormParamAttr:
+    """reference WeightNormParamAttr: ParamAttr requesting weight-norm
+    reparameterization (dim recorded; use nn.utils.weight_norm for layers)."""
+
+    def __init__(self, dim=None, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=False,
+                 need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_layout=True, print_tensor_lod=True,
+          print_phase="both"):
+    """reference static.Print: debug-print a tensor (eager-executed here)."""
+    import numpy as _np
+    arr = _np.asarray(input._data) if hasattr(input, "_data") else _np.asarray(input)
+    prefix = (message + " ") if message else ""
+    print(f"{prefix}{'Tensor' if print_tensor_name else ''} "
+          f"shape={list(arr.shape) if print_tensor_shape else '...'} "
+          f"values={arr.reshape(-1)[:summarize]}")
+    return input
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference static.py_func: call a python function on tensors."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    res = func(*xs)
+    return res
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """reference static.accuracy."""
+    from .. import ops
+    import numpy as _np
+    lg = _np.asarray(input._data)
+    lb = _np.asarray(label._data).reshape(-1)
+    topk = _np.argsort(-lg, axis=-1)[:, :k]
+    acc = float((topk == lb[:, None]).any(axis=1).mean())
+    return Tensor(jnp.asarray(acc, jnp.float32))
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    """reference static.auc (binary ROC-AUC over probability column 1)."""
+    import numpy as _np
+    probs = _np.asarray(input._data)
+    p1 = probs[:, 1] if probs.ndim == 2 and probs.shape[1] == 2 else probs.reshape(-1)
+    y = _np.asarray(label._data).reshape(-1)
+    order = _np.argsort(-p1)
+    y_sorted = y[order]
+    tps = _np.cumsum(y_sorted)
+    fps = _np.cumsum(1 - y_sorted)
+    tpr = tps / max(tps[-1], 1)
+    fpr = fps / max(fps[-1], 1)
+    a = float(_np.trapezoid(tpr, fpr)) if hasattr(_np, "trapezoid") else \
+        float(_np.trapz(tpr, fpr))
+    t = Tensor(jnp.asarray(a, jnp.float32))
+    return t, t, [t]
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    raise NotImplementedError(
+        "ctr_metric_bundle is parameter-server-era; use static.auc / "
+        "paddle.metric instead")
+
+
+# ---- save/load (reference static/io.py) --------------------------------------
+def save(program, model_path, protocol=4, **configs):
+    """Persist the parameters recorded in the program scope."""
+    from ..framework.io import save as _save
+    state = {name: t for name, t in global_scope()._vars.items()}
+    _save(state, model_path + ".pdparams" if not str(model_path).endswith(
+        ".pdparams") else model_path)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    from ..framework.io import load as _load
+    path = model_path if str(model_path).endswith(".pdparams") else \
+        model_path + ".pdparams"
+    state = _load(path)
+    for k, v in state.items():
+        global_scope()._vars[k] = v if isinstance(v, Tensor) else Tensor(v)
+
+
+def load_program_state(model_path, var_list=None):
+    from ..framework.io import load as _load
+    path = model_path if str(model_path).endswith(".pdparams") else \
+        model_path + ".pdparams"
+    state = _load(path)
+    import numpy as _np
+    return {k: _np.asarray(v._data if isinstance(v, Tensor) else v)
+            for k, v in state.items()}
+
+
+def set_program_state(program, state_dict):
+    for k, v in state_dict.items():
+        global_scope()._vars[k] = Tensor(jnp.asarray(v))
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    """reference save_inference_model -> jit.save of the traced function."""
+    raise NotImplementedError(
+        "static save_inference_model: export with paddle.jit.save (StableHLO) "
+        "— the static Program here is a replay tape, not a serializable graph")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    raise NotImplementedError(
+        "static load_inference_model: use paddle.jit.load / paddle.inference")
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    raise NotImplementedError("serialize_program: use paddle.jit.save")
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None, **kwargs):
+    raise NotImplementedError("serialize_persistables: use paddle.save")
+
+
+def deserialize_program(data):
+    raise NotImplementedError("deserialize_program: use paddle.jit.load")
+
+
+def deserialize_persistables(program, data, executor=None):
+    raise NotImplementedError("deserialize_persistables: use paddle.load")
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content if isinstance(content, bytes) else bytes(content))
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    return program

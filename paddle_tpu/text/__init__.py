@@ -85,3 +85,5 @@ class ViterbiDecoder(Layer):
     def forward(self, potentials, lengths):
         return viterbi_decode(potentials, self.transitions, lengths,
                               self._include)
+from .datasets import (UCIHousing, Imdb, Imikolov, Conll05st, Movielens,  # noqa: F401,E402
+                       WMT14, WMT16)

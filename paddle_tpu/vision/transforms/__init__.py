@@ -3,6 +3,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from ...core.tensor import Tensor
+
 
 class Compose:
     def __init__(self, transforms):
@@ -353,3 +355,267 @@ class RandomResizedCrop:
                 return Resize(self.size, self.interpolation)(patch)
         return Resize(self.size, self.interpolation)(center_crop(
             arr, (min(H, W), min(H, W))))
+
+
+# ---- round-2 completion (reference vision/transforms/transforms.py) ----------
+class BaseTransform:
+    """reference transforms.py BaseTransform: keys-aware callable base. The
+    functional core here applies `_apply_image` to array inputs."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        if isinstance(inputs, (list, tuple)):
+            keys = list(self.keys) + ["__passthrough__"] * (
+                len(inputs) - len(self.keys))   # extras pass through untouched
+            return type(inputs)(
+                self._apply_image(v) if k == "image" else v
+                for k, v in zip(keys, inputs))
+        return self._apply_image(inputs)
+
+
+class Transpose(BaseTransform):
+    """reference Transpose: HWC -> CHW (or a custom order)."""
+
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = tuple(order)
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[..., None]
+        return arr.transpose(self.order)
+
+
+def adjust_hue(img, hue_factor):
+    """reference functional adjust_hue: shift hue by hue_factor in [-0.5, 0.5]
+    via RGB->HSV->RGB (vectorized numpy)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr = np.asarray(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[-1] not in (1, 3)
+    a = arr if not chw else arr.transpose(1, 2, 0)
+    maxv = 255.0 if a.dtype == np.uint8 else 1.0
+    rgb = a.astype(np.float32) / maxv
+    import colorsys  # noqa: F401 (documented algorithm; vectorized below)
+    mx = rgb.max(-1)
+    mn = rgb.min(-1)
+    diff = mx - mn + 1e-12
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    h = np.where(mx == r, (g - b) / diff % 6,
+                 np.where(mx == g, (b - r) / diff + 2, (r - g) / diff + 4)) / 6
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0.0)
+    v = mx
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6)
+    f = h * 6 - i
+    p, q, t = v * (1 - s), v * (1 - f * s), v * (1 - (1 - f) * s)
+    i = (i.astype(np.int32) % 6)[..., None]
+    out = np.select(
+        [i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+        [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+         np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+         np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    out = out * maxv
+    if arr.dtype == np.uint8:
+        out = np.round(out)        # truncation would bias the roundtrip -1
+    out = out.astype(arr.dtype)
+    return out.transpose(2, 0, 1) if chw else out
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        u = np.random.uniform(-self.value, self.value)
+        return adjust_hue(img, u)
+
+
+def _affine_matrix(angle, translate, scale, shear, center):
+    import math as _m
+    rot = _m.radians(angle)
+    sx, sy = (_m.radians(s) for s in shear)
+    cx, cy = center
+    # torch convention: M = T(center) R S Sh T(-center) + translate
+    a = _m.cos(rot - sy) / _m.cos(sy)
+    b = -_m.cos(rot - sy) * _m.tan(sx) / _m.cos(sy) - _m.sin(rot)
+    c = _m.sin(rot - sy) / _m.cos(sy)
+    d = -_m.sin(rot - sy) * _m.tan(sx) / _m.cos(sy) + _m.cos(rot)
+    mat = np.array([[a, b, 0.0], [c, d, 0.0]]) * scale
+    mat[0, 2] = translate[0] + cx - mat[0, 0] * cx - mat[0, 1] * cy
+    mat[1, 2] = translate[1] + cy - mat[1, 0] * cx - mat[1, 1] * cy
+    return mat
+
+
+def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="nearest", fill=0, center=None):
+    """reference functional affine: inverse-warp sampling with the affine
+    matrix (nearest/bilinear)."""
+    arr = np.asarray(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[-1] not in (1, 3)
+    a = arr if not chw else arr.transpose(1, 2, 0)
+    if a.ndim == 2:
+        a = a[..., None]
+    H, W = a.shape[:2]
+    if isinstance(shear, (int, float)):
+        shear = (float(shear), 0.0)
+    ctr = center if center is not None else ((W - 1) / 2, (H - 1) / 2)
+    M = _affine_matrix(angle, translate, scale, shear, ctr)
+    Mi = np.linalg.inv(np.vstack([M, [0, 0, 1]]))[:2]
+    ys, xs = np.mgrid[0:H, 0:W]
+    src = Mi @ np.stack([xs.ravel(), ys.ravel(), np.ones(H * W)])
+    sx, sy = src[0].reshape(H, W), src[1].reshape(H, W)
+    out = _warp_sample(a, sx, sy, interpolation, fill)
+    if arr.ndim == 2:
+        out = out[..., 0]
+    return out.transpose(2, 0, 1) if chw else out
+
+
+def _warp_sample(a, sx, sy, interpolation, fill):
+    """Inverse-warp gather shared by affine/perspective (HWC array in)."""
+    H, W = a.shape[:2]
+    if interpolation == "bilinear":
+        x0, y0 = np.floor(sx), np.floor(sy)
+        out = np.zeros_like(a, np.float32)
+        for dx, dy in ((0, 0), (1, 0), (0, 1), (1, 1)):
+            xi, yi = x0 + dx, y0 + dy
+            wgt = (1 - np.abs(sx - xi)) * (1 - np.abs(sy - yi))
+            ok = (xi >= 0) & (xi < W) & (yi >= 0) & (yi < H)
+            xi_c = np.clip(xi, 0, W - 1).astype(int)
+            yi_c = np.clip(yi, 0, H - 1).astype(int)
+            pix = np.where(ok[..., None], a[yi_c, xi_c].astype(np.float32),
+                           float(fill))
+            out = out + wgt[..., None] * pix
+        return out.astype(a.dtype)
+    xi = np.round(sx).astype(int)
+    yi = np.round(sy).astype(int)
+    ok = (xi >= 0) & (xi < W) & (yi >= 0) & (yi < H)
+    return np.where(ok[..., None],
+                    a[np.clip(yi, 0, H - 1), np.clip(xi, 0, W - 1)],
+                    np.asarray(fill, a.dtype))
+
+
+class RandomAffine(BaseTransform):
+    """reference RandomAffine."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if isinstance(degrees, (int, float)) \
+            else tuple(degrees)
+        self.translate, self.scale_rng = translate, scale
+        self.shear = shear
+        self.interpolation, self.fill, self.center = interpolation, fill, center
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        H, W = (arr.shape[-2:] if arr.shape[0] in (1, 3) and arr.ndim == 3
+                else arr.shape[:2])
+        angle = np.random.uniform(*self.degrees)
+        tr = (0, 0)
+        if self.translate:
+            tr = (np.random.uniform(-self.translate[0], self.translate[0]) * W,
+                  np.random.uniform(-self.translate[1], self.translate[1]) * H)
+        sc = np.random.uniform(*self.scale_rng) if self.scale_rng else 1.0
+        sh = (0.0, 0.0)
+        if self.shear is not None:
+            s = self.shear if isinstance(self.shear, (list, tuple)) \
+                else (-self.shear, self.shear)
+            sh = (np.random.uniform(s[0], s[1]), 0.0)
+        return affine(img, angle, tr, sc, sh, self.interpolation, self.fill,
+                      self.center)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    """reference functional perspective: 4-point homography warp."""
+    arr = np.asarray(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[-1] not in (1, 3)
+    a = arr if not chw else arr.transpose(1, 2, 0)
+    if a.ndim == 2:
+        a = a[..., None]
+    H, W = a.shape[:2]
+    # solve homography endpoints -> startpoints (inverse warp)
+    A, bvec = [], []
+    for (ex, ey), (sx_, sy_) in zip(endpoints, startpoints):
+        A.append([ex, ey, 1, 0, 0, 0, -sx_ * ex, -sx_ * ey]); bvec.append(sx_)
+        A.append([0, 0, 0, ex, ey, 1, -sy_ * ex, -sy_ * ey]); bvec.append(sy_)
+    h = np.linalg.solve(np.asarray(A, np.float64), np.asarray(bvec, np.float64))
+    Hm = np.append(h, 1.0).reshape(3, 3)
+    ys, xs = np.mgrid[0:H, 0:W]
+    pts = Hm @ np.stack([xs.ravel(), ys.ravel(), np.ones(H * W)])
+    sx = (pts[0] / pts[2]).reshape(H, W)
+    sy = (pts[1] / pts[2]).reshape(H, W)
+    out = _warp_sample(a, sx, sy, interpolation, fill)
+    if arr.ndim == 2:
+        out = out[..., 0]
+    return out.transpose(2, 0, 1) if chw else out
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob, self.scale = prob, distortion_scale
+        self.interpolation, self.fill = interpolation, fill
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = np.asarray(img)
+        H, W = (arr.shape[-2:] if arr.shape[0] in (1, 3) and arr.ndim == 3
+                else arr.shape[:2])
+        d = self.scale
+        dx = lambda: int(np.random.uniform(0, d * W / 2))
+        dy = lambda: int(np.random.uniform(0, d * H / 2))
+        start = [(0, 0), (W - 1, 0), (W - 1, H - 1), (0, H - 1)]
+        end = [(dx(), dy()), (W - 1 - dx(), dy()),
+               (W - 1 - dx(), H - 1 - dy()), (dx(), H - 1 - dy())]
+        return perspective(img, start, end, self.interpolation, self.fill)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """reference functional erase."""
+    if isinstance(img, Tensor):
+        out = img.clone() if not inplace else img
+        out[..., i:i + h, j:j + w] = v
+        return out
+    arr = np.asarray(img)
+    out = arr if inplace else arr.copy()
+    out[..., i:i + h, j:j + w] = v
+    return out
+
+
+class RandomErasing(BaseTransform):
+    """reference RandomErasing (CHW arrays/Tensors)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob, self.scale, self.ratio = prob, scale, ratio
+        self.value, self.inplace = value, inplace
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = np.asarray(img._data) if isinstance(img, Tensor) else np.asarray(img)
+        H, W = arr.shape[-2:]
+        area = H * W
+        for _ in range(10):
+            a = np.random.uniform(*self.scale) * area
+            r = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                         np.log(self.ratio[1])))
+            h, w = int(round(np.sqrt(a * r))), int(round(np.sqrt(a / r)))
+            if h < H and w < W:
+                i = np.random.randint(0, H - h)
+                j = np.random.randint(0, W - w)
+                return erase(img, i, j, h, w, self.value, self.inplace)
+        return img

@@ -1,0 +1,208 @@
+"""Sub-namespace parity sweep + behavior tests for the round-2 fills
+(reference __all__ of static/sparse/distribution/vision/transforms/text/io/
+jit — all names must resolve)."""
+import os
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+REF = "/root/reference/python/paddle"
+
+
+def _ref_all(rel):
+    path = f"{REF}/{rel}/__init__.py"
+    if not os.path.exists(path):
+        pytest.skip("reference checkout not present")
+    src = open(path).read()
+    m = re.search(r"__all__ = \[(.*?)\]", src, re.S)
+    return re.findall(r"'([A-Za-z0-9_]+)'", m.group(1)) if m else []
+
+
+@pytest.mark.parametrize("rel,mod", [
+    ("static", "paddle_tpu.static"),
+    ("sparse", "paddle_tpu.sparse"),
+    ("distribution", "paddle_tpu.distribution"),
+    ("vision", "paddle_tpu.vision"),
+    ("vision/transforms", "paddle_tpu.vision.transforms"),
+    ("text", "paddle_tpu.text"),
+    ("io", "paddle_tpu.io"),
+    ("jit", "paddle_tpu.jit"),
+    ("nn", "paddle_tpu.nn"),
+    ("nn/functional", "paddle_tpu.nn.functional"),
+    ("amp", "paddle_tpu.amp"),
+    ("metric", "paddle_tpu.metric"),
+    ("optimizer", "paddle_tpu.optimizer"),
+])
+def test_namespace_covers_reference(rel, mod):
+    import importlib
+    m = importlib.import_module(mod)
+    missing = [n for n in _ref_all(rel) if not hasattr(m, n)]
+    assert not missing, f"{rel} missing: {missing}"
+
+
+class TestStaticCompat:
+    def test_append_backward_and_scope(self):
+        import paddle_tpu.static as st
+        p = st.create_parameter([3], "float32")
+        p.stop_gradient = False
+        loss = (paddle.to_tensor(np.ones(3, np.float32)) * p).sum()
+        pairs = st.append_backward(loss)
+        assert pairs and pairs[0][1] is not None
+        np.testing.assert_allclose(np.asarray(pairs[0][1]._data), np.ones(3))
+        sc = st.Scope()
+        with st.scope_guard(sc):
+            assert st.global_scope() is sc
+        assert st.global_scope() is not sc
+
+    def test_ema_apply_restore(self):
+        import paddle_tpu.static as st
+        p = paddle.to_tensor(np.ones(2, np.float32))
+        ema = st.ExponentialMovingAverage(decay=0.5)
+        ema.update([p])
+        p._data = p._data * 3
+        ema.update()
+        cur = np.asarray(p._data).copy()
+        with ema.apply():
+            avg = np.asarray(p._data)
+            np.testing.assert_allclose(avg, [2.0, 2.0])  # 0.5*1 + 0.5*3
+        np.testing.assert_array_equal(np.asarray(p._data), cur)
+
+    def test_accuracy_and_auc(self):
+        import paddle_tpu.static as st
+        probs = paddle.to_tensor(np.array(
+            [[0.9, 0.1], [0.2, 0.8], [0.7, 0.3]], np.float32))
+        lbl = paddle.to_tensor(np.array([0, 1, 1]))
+        acc = float(st.accuracy(probs, lbl)._data)
+        np.testing.assert_allclose(acc, 2 / 3, rtol=1e-5)
+        auc_t, _, _ = st.auc(probs, lbl)
+        assert 0.0 <= float(auc_t._data) <= 1.0
+
+    def test_program_state_roundtrip(self, tmp_path):
+        import paddle_tpu.static as st
+        st.global_scope()._vars["w"] = paddle.to_tensor(
+            np.arange(4, dtype=np.float32))
+        st.save(None, str(tmp_path / "prog"))
+        st.global_scope()._vars["w"] = paddle.to_tensor(np.zeros(4, np.float32))
+        st.load(None, str(tmp_path / "prog"))
+        np.testing.assert_array_equal(
+            np.asarray(st.global_scope()._vars["w"]._data),
+            np.arange(4, dtype=np.float32))
+
+
+class TestSparseAdditions:
+    def _x(self):
+        import paddle_tpu.sparse as sp
+        return sp.sparse_coo_tensor(np.array([[0, 1], [1, 0]]),
+                                    np.array([2.0, 3.0], np.float32), (2, 2))
+
+    def test_mv_addmm_mask_slice(self):
+        import paddle_tpu.sparse as sp
+        x = self._x()
+        v = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        np.testing.assert_allclose(np.asarray(sp.mv(x, v)._data), [4.0, 3.0])
+        out = sp.addmm(paddle.to_tensor(np.ones((2, 2), np.float32)), x,
+                       paddle.to_tensor(np.eye(2, dtype=np.float32)))
+        np.testing.assert_allclose(np.asarray(out._data), [[1, 3], [4, 1]])
+        m = sp.mask_as(paddle.to_tensor(
+            np.arange(4).reshape(2, 2).astype(np.float32)), x)
+        assert m.nnz() == 2
+        s = sp.slice(x, [0], [0], [1])
+        assert s.shape == [1, 2]
+        assert sp.isnan(x).nnz() == 2          # values are False but present
+
+
+class TestDistributionAdditions:
+    def test_lkj_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        from paddle_tpu.distribution import LKJCholesky
+        paddle.seed(0)
+        for d, eta in [(3, 1.0), (4, 2.5)]:
+            dist = LKJCholesky(d, eta)
+            L = np.asarray(dist.sample()._data)
+            C = L @ L.T
+            np.testing.assert_allclose(np.diag(C), np.ones(d), atol=1e-5)
+            ours = float(dist.log_prob(paddle.to_tensor(L))._data)
+            ref = float(torch.distributions.LKJCholesky(d, eta).log_prob(
+                torch.tensor(L)))
+            np.testing.assert_allclose(ours, ref, atol=1e-3)
+
+    def test_exponential_family_entropy_bregman(self):
+        """Gaussian in natural form: Bregman entropy equals the closed form."""
+        import jax.numpy as jnp
+        from paddle_tpu.distribution import ExponentialFamily
+
+        class NatNormal(ExponentialFamily):
+            def __init__(self, mu, sigma):
+                self.mu, self.sigma = mu, sigma
+                super().__init__()
+
+            @property
+            def _natural_parameters(self):
+                return (jnp.asarray(self.mu / self.sigma ** 2),
+                        jnp.asarray(-0.5 / self.sigma ** 2))
+
+            def _log_normalizer(self, n1, n2):
+                return -n1 ** 2 / (4 * n2) - 0.5 * jnp.log(-2 * n2)
+
+            @property
+            def _mean_carrier_measure(self):
+                # E[log h(x)] with h = 1/sqrt(2*pi) (the 2*pi term lives in
+                # the carrier, not in this A)
+                return -0.5 * np.log(2 * np.pi)
+
+        mu, sigma = 1.3, 0.7
+        ent = float(np.asarray(NatNormal(mu, sigma).entropy()._data))
+        closed = 0.5 * np.log(2 * np.pi * np.e * sigma ** 2)
+        np.testing.assert_allclose(ent, closed, rtol=1e-5)
+
+
+class TestTransformsAdditions:
+    def test_hue_affine_perspective_erase(self):
+        import colorsys
+        import paddle_tpu.vision.transforms as T
+        img = np.random.RandomState(0).randint(0, 255, (8, 8, 3)).astype(np.uint8)
+        np.testing.assert_array_equal(T.adjust_hue(img, 0.0), img)
+        ref = np.zeros_like(img)
+        for y in range(8):
+            for x in range(8):
+                r, g, b = img[y, x] / 255.0
+                h, s, v = colorsys.rgb_to_hsv(r, g, b)
+                ref[y, x] = np.round(np.array(
+                    colorsys.hsv_to_rgb((h + 0.25) % 1.0, s, v)) * 255)
+        assert np.abs(T.adjust_hue(img, 0.25).astype(int)
+                      - ref.astype(int)).max() <= 1
+        np.testing.assert_array_equal(T.affine(img, angle=0.0), img)
+        pts = [(0, 0), (7, 0), (7, 7), (0, 7)]
+        np.testing.assert_array_equal(T.perspective(img, pts, pts), img)
+        chw = img.transpose(2, 0, 1)          # erase contract is [..., H, W]
+        e = T.erase(chw, 1, 2, 3, 4, 0)
+        assert (e[:, 1:4, 2:6] == 0).all()
+        np.random.seed(0)
+        assert T.RandomAffine(15)(img).shape == img.shape
+        assert T.RandomPerspective(prob=1.0)(img).shape == img.shape
+        assert T.RandomErasing(prob=1.0)(img).shape == img.shape
+        assert T.Transpose()(img).shape == (3, 8, 8)
+
+    def test_image_backend(self):
+        import paddle_tpu.vision as V
+        V.set_image_backend("pil")
+        assert V.get_image_backend() == "pil"
+        with pytest.raises(ValueError):
+            V.set_image_backend("bogus")
+
+
+class TestIoJitAdditions:
+    def test_subset_random_sampler(self):
+        from paddle_tpu.io import SubsetRandomSampler
+        s = SubsetRandomSampler([3, 5, 9])
+        out = list(iter(s))
+        assert sorted(out) == [3, 5, 9] and len(s) == 3
+
+    def test_jit_verbosity_knobs(self):
+        import paddle_tpu.jit as jit
+        jit.set_verbosity(1)
+        jit.set_code_level(50)
+        jit.set_verbosity(0)

@@ -78,6 +78,22 @@ class TestLossGoldens:
             reduction="mean"))
         np.testing.assert_allclose(ours, ref, rtol=1e-4)
 
+    def test_ctc_empty_label(self):
+        """Zero-length targets must not double-count the all-blank path."""
+        T, B, C = 6, 2, 5
+        logits = rng.randn(T, B, C).astype(np.float32)
+        labels = np.array([[1, 2], [0, 0]], np.int32)
+        in_len = np.array([6, 6], np.int64)
+        lab_len = np.array([2, 0], np.int64)
+        ours = float(F.ctc_loss(_t(logits), _t(labels), _t(in_len),
+                                _t(lab_len))._data)
+        ref = float(torch.nn.functional.ctc_loss(
+            torch.tensor(logits).log_softmax(-1),
+            torch.tensor(labels.astype(np.int64)),
+            torch.tensor(in_len), torch.tensor(lab_len), blank=0,
+            reduction="mean", zero_infinity=False))
+        np.testing.assert_allclose(ours, ref, rtol=1e-4)
+
     def test_rnnt_matches_bruteforce(self):
         """Tiny grid: enumerate all monotonic paths explicitly."""
         B, T, U, C = 1, 3, 2, 4
@@ -185,12 +201,15 @@ class TestVisionSampling:
         x = rng.randn(1, 2, 5, 5).astype(np.float32)
         grid = (rng.rand(1, 3, 3, 2).astype(np.float32) * 3 - 1.5)  # OOB too
         for pm in ("reflection", "border"):
-            ours = np.asarray(F.grid_sample(_t(x), _t(grid), padding_mode=pm,
-                                            align_corners=True)._data)
-            ref = torch.nn.functional.grid_sample(
-                torch.tensor(x), torch.tensor(grid), mode="bilinear",
-                padding_mode=pm, align_corners=True).numpy()
-            np.testing.assert_allclose(ours, ref, atol=1e-5, err_msg=pm)
+            for align in (True, False):
+                ours = np.asarray(F.grid_sample(
+                    _t(x), _t(grid), padding_mode=pm,
+                    align_corners=align)._data)
+                ref = torch.nn.functional.grid_sample(
+                    torch.tensor(x), torch.tensor(grid), mode="bilinear",
+                    padding_mode=pm, align_corners=align).numpy()
+                np.testing.assert_allclose(ours, ref, atol=1e-5,
+                                           err_msg=f"{pm} align={align}")
 
     def test_affine_grid_matches_torch(self):
         theta = rng.randn(2, 2, 3).astype(np.float32)
@@ -226,6 +245,11 @@ class TestPoolingVariants:
         out = F.fractional_max_pool2d(_t(x), 4, random_u=0.5)
         assert out.shape == [1, 2, 4, 4]
         assert np.asarray(out._data).max() <= x.max() + 1e-6
+        # kernel_size makes windows overlap: each output >= partition result
+        ov = np.asarray(F.fractional_max_pool2d(_t(x), 4, kernel_size=3,
+                                                random_u=0.5)._data)
+        assert (ov >= np.asarray(out._data) - 1e-6).all()
+        assert not np.allclose(ov, np.asarray(out._data))
         out3 = F.fractional_max_pool3d(
             _t(rng.randn(1, 1, 6, 6, 6).astype(np.float32)), 3, random_u=0.4)
         assert out3.shape == [1, 1, 3, 3, 3]
