@@ -177,7 +177,11 @@ def set_verbosity(level=0, also_to_stdout=False):
 
 
 def set_code_level(level=100, also_to_stdout=False):
-    """reference jit set_code_level: at >0, log the captured program (here:
-    the jaxpr of the compiled step) when compilation happens."""
+    """reference jit set_code_level: at >0, to_static prints the captured
+    program (the jaxpr of the compiled step) on each compilation."""
     global _code_level
     _code_level = int(level)
+
+
+def _code_level_value():
+    return _code_level

@@ -312,6 +312,10 @@ class StaticFunction:
         grad_in_arrays = self._grad_in_arrays(entry)
         # abstract trace now: surfaces graph breaks + fills out_treedef/out_mask
         jax.eval_shape(pure_fn, arg_arrays, mut_arrays, ro_arrays, grad_in_arrays)
+        from . import _code_level_value
+        if _code_level_value() > 0:
+            print(jax.make_jaxpr(pure_fn)(arg_arrays, mut_arrays, ro_arrays,
+                                          grad_in_arrays))
         entry.compiled = jax.jit(pure_fn, donate_argnums=donate)
 
     @staticmethod

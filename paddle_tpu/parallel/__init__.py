@@ -20,4 +20,5 @@ from .pipeline_layer import (PipelineLayer, LayerDesc, SharedLayerDesc,  # noqa:
 from .tensor_parallel import TensorParallel, SegmentParallel  # noqa: F401
 from .sharding import (group_sharded_parallel, save_group_sharded_model,  # noqa: F401
                        DygraphShardingOptimizer, GroupShardedStage2,
+                       GroupShardedStage3, GroupShardedOptimizerStage2,
                        shard_parameters, shard_accumulators)

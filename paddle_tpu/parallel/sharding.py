@@ -78,8 +78,85 @@ class DygraphShardingOptimizer:
         self._inner_opt.clear_grad(set_to_zero)
 
 
-class GroupShardedStage2(DygraphShardingOptimizer):
-    """Grads reduce-scattered (automatic under GSPMD once states are sharded)."""
+class GroupShardedOptimizerStage2(DygraphShardingOptimizer):
+    """reference group_sharded_optimizer_stage2.py:53 — optimizer whose
+    states live sharded on the sharding axis (same placement policy as
+    stage 1; gradients inherit it inside the compiled step).
+
+    Accepts the reference call shape (params, optim, group) as well as the
+    stage-1 wrapper's (optimizer, hcg)."""
+
+    def __init__(self, params=None, optim=None, group=None, **kw):
+        opt = optim if optim is not None and hasattr(optim, "_acc") else \
+            (params if hasattr(params, "_acc") else optim)
+        if opt is None or not hasattr(opt, "_acc"):
+            raise TypeError("GroupShardedOptimizerStage2 needs an optimizer "
+                            "(reference signature: params, optim, group)")
+        super().__init__(opt)
+
+
+class _ShardedModelWrapper:
+    """Model wrapper matching the reference GroupShardedStage2/3 call shape:
+    wraps the layer, delegates forward/state_dict, and applies the stage's
+    placement policy. The reduce-scatter/all-gather traffic the reference
+    hand-codes is emitted by XLA from these placements inside the compiled
+    train step."""
+
+    def __init__(self, layer, optimizer=None, group=None, sync_buffers=False,
+                 **kw):
+        self._layers = layer
+        self._optimizer = optimizer
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_layers"], item)
+
+
+class GroupShardedStage2(_ShardedModelWrapper):
+    """reference group_sharded_stage2.py:47 — grad + optimizer-state
+    sharding: wraps the model and shards the optimizer's accumulators; grads
+    reduce-scatter automatically under GSPMD."""
+
+    def __init__(self, layer, sharding_optimizer=None, group=None,
+                 sync_buffers=False, buffer_max_size=2 ** 23, **kw):
+        super().__init__(layer, sharding_optimizer, group)
+        if sharding_optimizer is not None and not isinstance(
+                sharding_optimizer, DygraphShardingOptimizer):
+            shard_accumulators(sharding_optimizer)
+
+
+class GroupShardedStage3(_ShardedModelWrapper):
+    """reference group_sharded_stage3.py:85 — parameter sharding (FSDP):
+    wraps the model, shards parameter storage AND optimizer state."""
+
+    def __init__(self, layer, optimizer=None, group=None, sync_buffers=False,
+                 segment_size=2 ** 20, offload=False, **kw):
+        super().__init__(layer, optimizer, group)
+        shard_parameters(layer)
+        if optimizer is not None:
+            shard_accumulators(optimizer)
 
 
 def group_sharded_parallel(model, optimizer, level="p_g_os", scaler=None,

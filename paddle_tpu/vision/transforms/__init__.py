@@ -530,7 +530,8 @@ class RandomAffine(BaseTransform):
         if self.shear is not None:
             s = self.shear if isinstance(self.shear, (list, tuple)) \
                 else (-self.shear, self.shear)
-            sh = (np.random.uniform(s[0], s[1]), 0.0)
+            sy = np.random.uniform(s[2], s[3]) if len(s) == 4 else 0.0
+            sh = (np.random.uniform(s[0], s[1]), sy)
         return affine(img, angle, tr, sc, sh, self.interpolation, self.fill,
                       self.center)
 
@@ -582,15 +583,30 @@ class RandomPerspective(BaseTransform):
         return perspective(img, start, end, self.interpolation, self.fill)
 
 
+def _spatial_axes(arr):
+    """(h_axis, w_axis) honoring the reference layout contract: np arrays
+    are HWC (or HW), Tensors/CHW arrays are [..., H, W]."""
+    if arr.ndim == 3 and arr.shape[-1] in (1, 3, 4) and \
+            arr.shape[0] not in (1, 3, 4):
+        return 0, 1                                    # HWC
+    if arr.ndim == 2:
+        return 0, 1
+    return arr.ndim - 2, arr.ndim - 1                  # CHW / batched CHW
+
+
 def erase(img, i, j, h, w, v, inplace=False):
-    """reference functional erase."""
+    """reference functional erase (Tensor: CHW; np.array: HWC)."""
     if isinstance(img, Tensor):
         out = img.clone() if not inplace else img
         out[..., i:i + h, j:j + w] = v
         return out
     arr = np.asarray(img)
     out = arr if inplace else arr.copy()
-    out[..., i:i + h, j:j + w] = v
+    ha, wa = _spatial_axes(out)
+    sl = [slice(None)] * out.ndim
+    sl[ha] = slice(i, i + h)
+    sl[wa] = slice(j, j + w)
+    out[tuple(sl)] = v
     return out
 
 
@@ -607,7 +623,11 @@ class RandomErasing(BaseTransform):
         if np.random.rand() >= self.prob:
             return img
         arr = np.asarray(img._data) if isinstance(img, Tensor) else np.asarray(img)
-        H, W = arr.shape[-2:]
+        if isinstance(img, Tensor):
+            H, W = arr.shape[-2:]
+        else:
+            ha, wa = _spatial_axes(arr)
+            H, W = arr.shape[ha], arr.shape[wa]
         area = H * W
         for _ in range(10):
             a = np.random.uniform(*self.scale) * area

@@ -114,8 +114,9 @@ def test_inplace_semantics():
 
 
 def test_coverage_floor():
-    """VERDICT #3 done-criterion: >= 380 registered ops with OpTest entries."""
+    """VERDICT #3 done-criterion: >= 380 registered ops with OpTest entries
+    (actual as of r2: 472 registered / 260 golden — floors ratchet up)."""
     rep = coverage_report()
-    assert rep["registered_ops"] >= 380, rep
-    assert rep["golden_tested"] >= 200, rep
+    assert rep["registered_ops"] >= 470, rep
+    assert rep["golden_tested"] >= 255, rep
     assert rep["grad_checked"] >= 60, rep
