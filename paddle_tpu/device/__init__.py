@@ -115,3 +115,35 @@ class cuda:
     @staticmethod
     def empty_cache():
         pass
+
+
+def get_cudnn_version():
+    """Compat: no cuDNN on the TPU build (reference returns None when absent)."""
+    return None
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_distribute():
+    return True
+
+
+def get_all_custom_device_type():
+    """No out-of-tree device plugins: TPU is first-class here."""
+    return []
+
+
+class XPUPlace(Place):
+    """Compat: Kunlun place; resolves to the default accelerator."""
+
+    def __init__(self, device_id=0):
+        import jax
+        devs = jax.devices()
+        super().__init__(devs[min(device_id, len(devs) - 1)])
+
+
+class IPUPlace:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("IPU support is not part of the TPU build")

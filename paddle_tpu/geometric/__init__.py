@@ -170,3 +170,76 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
     neighbors = np.concatenate(out_nb) if out_nb else np.zeros(0, r.dtype)
     return (Tensor(jnp.asarray(neighbors.astype(np.int64))),
             Tensor(jnp.asarray(np.array(out_cnt, np.int32))))
+
+
+def _first_seen_remap(arrays):
+    """Shared node remapping: order = xs first, then first-seen neighbors
+    (same contract as reindex_graph)."""
+    import numpy as _np
+    order = {}
+    for arr in arrays:
+        for v in arr.tolist():
+            if v not in order:
+                order[v] = len(order)
+
+    def remap(arr):
+        if arr.size == 0:
+            return _np.zeros(0, _np.int64)
+        return _np.asarray([order[v] for v in arr.tolist()], _np.int64)
+    nodes = _np.asarray(sorted(order, key=order.__getitem__))
+    return remap, nodes
+
+
+def reindex_heter_graph(x, neighbors_list, count_list=None, value_buffer=None,
+                        index_buffer=None, name=None):
+    """reference geometric/reindex.py reindex_heter_graph: reindex several
+    neighbor sets (one per edge type) against one shared node numbering."""
+    from ..core.dispatch import unwrap as _u
+    import numpy as _np
+    xs = _np.asarray(_u(x)).reshape(-1)
+    neigh = [_np.asarray(_u(n)).reshape(-1) for n in neighbors_list]
+    remap, nodes = _first_seen_remap([xs] + neigh)
+    outs = [Tensor(jnp.asarray(remap(n))) for n in neigh]
+    return outs, Tensor(jnp.asarray(remap(xs))), Tensor(jnp.asarray(nodes))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """reference geometric/sampling/neighbors.py weighted_sample_neighbors:
+    weight-proportional sampling without replacement (CSC graph). Zero-weight
+    edges are excluded from sampling; all-zero rows fall back to uniform."""
+    from ..core.dispatch import unwrap as _u
+    from ..core.rng import next_key
+    import numpy as _np
+    r = _np.asarray(_u(row)).reshape(-1)
+    cp = _np.asarray(_u(colptr)).reshape(-1)
+    w = _np.asarray(_u(edge_weight)).reshape(-1).astype(_np.float64)
+    nodes = _np.asarray(_u(input_nodes)).reshape(-1)
+    ev = _np.asarray(_u(eids)).reshape(-1) if eids is not None else None
+    seed = int(_np.uint32(_np.asarray(next_key())[-1]))
+    rng = _np.random.RandomState(seed)
+    out_n, out_cnt, out_e = [], [], []
+    for v in nodes:
+        lo, hi = int(cp[v]), int(cp[v + 1])
+        pos_all = _np.arange(lo, hi)
+        cw = w[lo:hi]
+        if cw.sum() > 0:
+            pos_all = pos_all[cw > 0]
+            cw = cw[cw > 0]
+        if sample_size < 0 or len(pos_all) <= sample_size:
+            picked = pos_all
+        else:
+            p = cw / cw.sum() if cw.sum() > 0 else None
+            picked = rng.choice(pos_all, size=sample_size, replace=False, p=p)
+        out_n.append(r[picked])
+        out_cnt.append(len(picked))
+        if return_eids:
+            out_e.append(ev[picked] if ev is not None else picked)
+    flat = _np.concatenate(out_n) if out_n else _np.zeros(0, r.dtype)
+    res = (Tensor(jnp.asarray(flat)),
+           Tensor(jnp.asarray(_np.asarray(out_cnt, _np.int32))))
+    if return_eids:
+        fe = _np.concatenate(out_e) if out_e else _np.zeros(0, _np.int64)
+        return res + (Tensor(jnp.asarray(fe)),)
+    return res

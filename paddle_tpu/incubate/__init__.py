@@ -5,3 +5,74 @@ from . import asp  # noqa: F401
 from . import autograd  # noqa: F401
 from . import optimizer  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
+
+# reference incubate/__init__.py __all__ surface
+from ..geometric import (segment_sum, segment_mean, segment_max,  # noqa: F401
+                         segment_min)
+from ..geometric import (send_u_recv as graph_send_recv,  # noqa: F401
+                         reindex_graph as graph_reindex,
+                         sample_neighbors as graph_sample_neighbors)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """reference incubate/operators/graph_khop_sampler.py: multi-hop neighbor
+    sampling — one sample_neighbors pass per hop, frontier = prior outputs."""
+    from ..geometric import sample_neighbors
+    import numpy as _np
+    from ..core.tensor import Tensor as _T
+    from ..core.dispatch import unwrap as _u
+    import jax.numpy as _jnp
+    frontier = input_nodes
+    rows_out, counts_out = [], []
+    if not list(sample_sizes):
+        z = _T(_jnp.zeros(0, _jnp.int32))
+        return z, _T(_jnp.zeros(0, _jnp.int32))
+    for k in sample_sizes:
+        n, c = sample_neighbors(row, colptr, frontier, sample_size=k)
+        rows_out.append(_np.asarray(_u(n)))
+        counts_out.append(_np.asarray(_u(c)))
+        frontier = _T(_jnp.asarray(_np.unique(_np.asarray(_u(n)))))
+    edges = _np.concatenate(rows_out) if rows_out else _np.zeros(0, _np.int64)
+    return (_T(_jnp.asarray(edges)),
+            _T(_jnp.asarray(_np.concatenate(counts_out).astype(_np.int32))))
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """reference incubate softmax_mask_fuse: softmax(x + mask) fused (XLA
+    fuses the add into the softmax; the CUDA op exists for the same reason)."""
+    from ..core.dispatch import apply_op
+    import jax
+    import jax.numpy as jnp
+
+    def f(a, m):
+        return jax.nn.softmax(a.astype(jnp.float32) + m.astype(jnp.float32),
+                              axis=-1).astype(a.dtype)
+    return apply_op("softmax_mask_fuse", f, x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """reference softmax_mask_fuse_upper_triangle: causal-masked softmax."""
+    from ..core.dispatch import apply_op
+    import jax
+    import jax.numpy as jnp
+
+    def f(a):
+        S = a.shape[-1]
+        mask = jnp.tril(jnp.ones((a.shape[-2], S), bool), k=S - a.shape[-2])
+        z = jnp.where(mask, a.astype(jnp.float32), -1e30)
+        return jax.nn.softmax(z, axis=-1).astype(a.dtype)
+    return apply_op("softmax_mask_fuse_upper_triangle", f, x)
+
+
+def identity_loss(x, reduction="none"):
+    """reference incubate identity_loss (IPU-era): pass-through loss marker."""
+    from .. import ops
+    if reduction in (0, "sum"):
+        return x.sum()
+    if reduction in (1, "mean"):
+        return x.mean()
+    return x
+
+
+from . import inference  # noqa: F401,E402

@@ -1,0 +1,21 @@
+"""incubate.inference (reference: python/paddle/incubate/inference/__init__.py
+exporting the `predictor` conversion decorator for LLM serving).
+
+TPU realization: the decorator jit-compiles the wrapped callable's forward
+via paddle.jit.to_static — the serving predictor path proper lives in
+paddle_tpu.inference (Config/Predictor over jit.save artifacts).
+"""
+from __future__ import annotations
+
+__all__ = ["predictor"]
+
+
+def predictor(function=None, *, cache_static_model=False, **kwargs):
+    """Decorator: compile a callable (or a Layer's forward) for serving."""
+    from ..jit import to_static
+
+    def deco(fn):
+        return to_static(fn)
+    if function is not None:
+        return deco(function)
+    return deco

@@ -140,3 +140,71 @@ class PredictorPool:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+# ---- round-2 compat surface (reference paddle/inference/__init__.py) --------
+class DataType:
+    """reference pybind PaddleDType enum."""
+    FLOAT64 = 0
+    FLOAT32 = 1
+    FLOAT16 = 2
+    BFLOAT16 = 3
+    INT64 = 4
+    INT32 = 5
+    INT8 = 6
+    UINT8 = 7
+    BOOL = 8
+
+
+class PlaceType:
+    kUNK = -1
+    kCPU = 0
+    kGPU = 1
+    kXPU = 2
+    kCUSTOM = 3
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+    Bfloat16 = 3
+
+
+def get_version():
+    from ..version import __version__
+    return f"paddle_tpu inference {__version__}"
+
+
+def get_num_bytes_of_data_type(dtype):
+    import numpy as np
+    sizes = {DataType.FLOAT64: 8, DataType.FLOAT32: 4, DataType.FLOAT16: 2,
+             DataType.BFLOAT16: 2, DataType.INT64: 8, DataType.INT32: 4,
+             DataType.INT8: 1, DataType.UINT8: 1, DataType.BOOL: 1}
+    return sizes.get(dtype, 4)
+
+
+def get_trt_compile_version():
+    return (0, 0, 0)     # no TensorRT on the TPU build
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def _get_phi_kernel_name(op_name):
+    return op_name       # XLA HLO names are the kernel names here
+
+
+def convert_to_mixed_precision(*a, **k):
+    raise NotImplementedError(
+        "convert_to_mixed_precision: export with paddle.jit.save under "
+        "amp.auto_cast instead (bf16 is the native serving dtype on TPU)")
+
+
+class XpuConfig:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("XPU inference is not part of the TPU build")
+
+
+from ..core.tensor import Tensor  # noqa: F401,E402  (zero-copy IO handle type)

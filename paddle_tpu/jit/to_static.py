@@ -310,12 +310,16 @@ class StaticFunction:
         mut_arrays = [t._buf for t in entry.mut_list]
         ro_arrays = [t._buf for t in entry.ro_list]
         grad_in_arrays = self._grad_in_arrays(entry)
-        # abstract trace now: surfaces graph breaks + fills out_treedef/out_mask
-        jax.eval_shape(pure_fn, arg_arrays, mut_arrays, ro_arrays, grad_in_arrays)
+        # abstract trace now: surfaces graph breaks + fills out_treedef/
+        # out_mask; at code_level>0 the SAME single trace yields the printed
+        # jaxpr (make_jaxpr instead of a second eval_shape pass)
         from . import _code_level_value
         if _code_level_value() > 0:
             print(jax.make_jaxpr(pure_fn)(arg_arrays, mut_arrays, ro_arrays,
                                           grad_in_arrays))
+        else:
+            jax.eval_shape(pure_fn, arg_arrays, mut_arrays, ro_arrays,
+                           grad_in_arrays)
         entry.compiled = jax.jit(pure_fn, donate_argnums=donate)
 
     @staticmethod

@@ -234,3 +234,16 @@ def load_profiler_result(filename):
     with open(filename) as f:
         d = json.load(f)
     return ProfilerResult(d.get("traceEvents", []), d.get("xplane_dir", ""))
+
+
+class SortedKeys:
+    """reference profiler/profiler_statistic.py SortedKeys enum: summary-table
+    sort orders."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7

@@ -39,3 +39,20 @@ def run_check():
 def flops(net, input_size, custom_ops=None, print_detail=False):
     from ..hapi.model import flops as _flops
     return _flops(net, input_size, custom_ops, print_detail)
+
+
+def require_version(min_version, max_version=None):
+    """reference utils/install_check-style version gate against this build's
+    version string."""
+    from ..version import __version__
+
+    def _key(v):
+        parts = [int(p) if p.isdigit() else 0 for p in str(v).split(".")[:3]]
+        return tuple(parts + [0] * (3 - len(parts)))   # zero-pad: 0.1 == 0.1.0
+    cur = _key(__version__)
+    if _key(min_version) > cur:
+        raise Exception(
+            f"paddle_tpu>={min_version} required, found {__version__}")
+    if max_version is not None and _key(max_version) < cur:
+        raise Exception(
+            f"paddle_tpu<={max_version} required, found {__version__}")

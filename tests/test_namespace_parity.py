@@ -22,6 +22,12 @@ def _ref_all(rel):
 
 
 @pytest.mark.parametrize("rel,mod", [
+    ("incubate", "paddle_tpu.incubate"),
+    ("utils", "paddle_tpu.utils"),
+    ("device", "paddle_tpu.device"),
+    ("geometric", "paddle_tpu.geometric"),
+    ("profiler", "paddle_tpu.profiler"),
+    ("inference", "paddle_tpu.inference"),
     ("static", "paddle_tpu.static"),
     ("sparse", "paddle_tpu.sparse"),
     ("distribution", "paddle_tpu.distribution"),
@@ -192,6 +198,52 @@ class TestTransformsAdditions:
         assert V.get_image_backend() == "pil"
         with pytest.raises(ValueError):
             V.set_image_backend("bogus")
+
+
+class TestIncubateSurface:
+    def test_softmax_mask_fuse(self):
+        from paddle_tpu.incubate import (softmax_mask_fuse,
+                                         softmax_mask_fuse_upper_triangle)
+        x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4, 4)
+                             .astype(np.float32))
+        m = paddle.to_tensor(np.zeros((2, 4, 4), np.float32))
+        out = np.asarray(softmax_mask_fuse(x, m)._data)
+        np.testing.assert_allclose(out.sum(-1), np.ones((2, 4)), rtol=1e-5)
+        tri = np.asarray(softmax_mask_fuse_upper_triangle(x)._data)
+        assert np.allclose(np.triu(tri[0], k=1), 0, atol=1e-6)
+        np.testing.assert_allclose(tri.sum(-1), np.ones((2, 4)), rtol=1e-5)
+
+    def test_graph_khop_and_weighted_sampling(self):
+        import paddle_tpu.geometric as G
+        from paddle_tpu.incubate import graph_khop_sampler
+        # chain graph 0->1->2->3 in CSC
+        row = paddle.to_tensor(np.array([1, 2, 3, 0], np.int64))
+        colptr = paddle.to_tensor(np.array([0, 1, 2, 3, 4], np.int64))
+        paddle.seed(0)
+        edges, counts = graph_khop_sampler(row, colptr,
+                                           paddle.to_tensor(np.array([0])),
+                                           [1, 1])
+        assert np.asarray(edges._data).size == 2
+        w = paddle.to_tensor(np.array([1.0, 1.0, 1.0, 1.0], np.float32))
+        n, c = G.weighted_sample_neighbors(row, colptr, w,
+                                           paddle.to_tensor(np.array([0, 1])),
+                                           sample_size=1)
+        assert np.asarray(c._data).tolist() == [1, 1]
+
+    def test_require_version_and_device_shims(self):
+        import paddle_tpu.utils as U
+        import paddle_tpu.device as D
+        U.require_version("0.0.0")
+        with pytest.raises(Exception):
+            U.require_version("999.0.0")
+        assert D.get_cudnn_version() is None
+        assert D.is_compiled_with_distribute() is True
+        assert D.get_all_custom_device_type() == []
+
+    def test_inference_enums(self):
+        import paddle_tpu.inference as I
+        assert I.get_num_bytes_of_data_type(I.DataType.BFLOAT16) == 2
+        assert "paddle_tpu" in I.get_version()
 
 
 class TestIoJitAdditions:
