@@ -17,7 +17,7 @@ from ..core.dispatch import apply_op, unwrap
 __all__ = [
     "segment_sum", "segment_mean", "segment_min", "segment_max",
     "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph",
-    "sample_neighbors",
+    "sample_neighbors", "reindex_heter_graph", "weighted_sample_neighbors",
 ]
 
 
@@ -190,17 +190,32 @@ def _first_seen_remap(arrays):
     return remap, nodes
 
 
-def reindex_heter_graph(x, neighbors_list, count_list=None, value_buffer=None,
+def _rng_seed():
+    """Host RNG seed drawn from the framework generator (follows paddle.seed;
+    shared by the neighbor samplers)."""
+    from ..core.rng import next_key
+    return int(np.asarray(jax.random.key_data(next_key())).ravel()[-1]
+               & 0x7FFFFFFF)
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
                         index_buffer=None, name=None):
-    """reference geometric/reindex.py reindex_heter_graph: reindex several
-    neighbor sets (one per edge type) against one shared node numbering."""
+    """reference geometric/reindex.py reindex_heter_graph -> (reindex_src,
+    reindex_dst, out_nodes): neighbors/count are per-edge-type lists; src is
+    every neighbor remapped into the shared numbering (x first, then
+    first-seen), dst repeats each x position by its per-type neighbor count."""
     from ..core.dispatch import unwrap as _u
     import numpy as _np
     xs = _np.asarray(_u(x)).reshape(-1)
-    neigh = [_np.asarray(_u(n)).reshape(-1) for n in neighbors_list]
+    neigh = [_np.asarray(_u(n)).reshape(-1) for n in neighbors]
+    cnts = [_np.asarray(_u(c)).reshape(-1) for c in count]
     remap, nodes = _first_seen_remap([xs] + neigh)
-    outs = [Tensor(jnp.asarray(remap(n))) for n in neigh]
-    return outs, Tensor(jnp.asarray(remap(xs))), Tensor(jnp.asarray(nodes))
+    src = _np.concatenate([remap(n) for n in neigh]) if neigh else         _np.zeros(0, _np.int64)
+    dst_parts = [_np.repeat(_np.arange(len(xs), dtype=_np.int64), c)
+                 for c in cnts]
+    dst = _np.concatenate(dst_parts) if dst_parts else _np.zeros(0, _np.int64)
+    return (Tensor(jnp.asarray(src)), Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(nodes)))
 
 
 def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
@@ -210,15 +225,13 @@ def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
     weight-proportional sampling without replacement (CSC graph). Zero-weight
     edges are excluded from sampling; all-zero rows fall back to uniform."""
     from ..core.dispatch import unwrap as _u
-    from ..core.rng import next_key
     import numpy as _np
     r = _np.asarray(_u(row)).reshape(-1)
     cp = _np.asarray(_u(colptr)).reshape(-1)
     w = _np.asarray(_u(edge_weight)).reshape(-1).astype(_np.float64)
     nodes = _np.asarray(_u(input_nodes)).reshape(-1)
     ev = _np.asarray(_u(eids)).reshape(-1) if eids is not None else None
-    seed = int(_np.uint32(_np.asarray(next_key())[-1]))
-    rng = _np.random.RandomState(seed)
+    rng = _np.random.RandomState(_rng_seed())
     out_n, out_cnt, out_e = [], [], []
     for v in nodes:
         lo, hi = int(cp[v]), int(cp[v + 1])

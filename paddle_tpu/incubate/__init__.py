@@ -16,26 +16,34 @@ from ..geometric import (send_u_recv as graph_send_recv,  # noqa: F401
 
 def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
                        sorted_eids=None, return_eids=False, name=None):
-    """reference incubate/operators/graph_khop_sampler.py: multi-hop neighbor
-    sampling — one sample_neighbors pass per hop, frontier = prior outputs."""
+    """reference incubate/operators/graph_khop_sampler.py -> (edge_src,
+    edge_dst, sample_index): multi-hop neighbor sampling, edges reindexed
+    into the unique-node numbering (input nodes first, then first-seen)."""
+    if return_eids:
+        raise NotImplementedError("graph_khop_sampler return_eids")
     from ..geometric import sample_neighbors
+    from ..geometric import _first_seen_remap
     import numpy as _np
     from ..core.tensor import Tensor as _T
     from ..core.dispatch import unwrap as _u
     import jax.numpy as _jnp
-    frontier = input_nodes
-    rows_out, counts_out = [], []
-    if not list(sample_sizes):
-        z = _T(_jnp.zeros(0, _jnp.int32))
-        return z, _T(_jnp.zeros(0, _jnp.int32))
-    for k in sample_sizes:
-        n, c = sample_neighbors(row, colptr, frontier, sample_size=k)
-        rows_out.append(_np.asarray(_u(n)))
-        counts_out.append(_np.asarray(_u(c)))
-        frontier = _T(_jnp.asarray(_np.unique(_np.asarray(_u(n)))))
-    edges = _np.concatenate(rows_out) if rows_out else _np.zeros(0, _np.int64)
-    return (_T(_jnp.asarray(edges)),
-            _T(_jnp.asarray(_np.concatenate(counts_out).astype(_np.int32))))
+    sizes = list(sample_sizes)
+    frontier = _np.asarray(_u(input_nodes)).reshape(-1)
+    src_parts, dst_parts = [], []
+    for k in sizes:
+        n, c = sample_neighbors(row, colptr, _T(_jnp.asarray(frontier)),
+                                sample_size=k)
+        nv = _np.asarray(_u(n)).reshape(-1)
+        cv = _np.asarray(_u(c)).reshape(-1)
+        src_parts.append(nv)
+        dst_parts.append(_np.repeat(frontier, cv))
+        frontier = _np.unique(nv) if nv.size else frontier
+    src = _np.concatenate(src_parts) if src_parts else _np.zeros(0, _np.int64)
+    dst = _np.concatenate(dst_parts) if dst_parts else _np.zeros(0, _np.int64)
+    start = _np.asarray(_u(input_nodes)).reshape(-1)
+    remap, nodes = _first_seen_remap([start, src, dst])
+    return (_T(_jnp.asarray(remap(src))), _T(_jnp.asarray(remap(dst))),
+            _T(_jnp.asarray(nodes)))
 
 
 def softmax_mask_fuse(x, mask, name=None):
@@ -67,7 +75,6 @@ def softmax_mask_fuse_upper_triangle(x, name=None):
 
 def identity_loss(x, reduction="none"):
     """reference incubate identity_loss (IPU-era): pass-through loss marker."""
-    from .. import ops
     if reduction in (0, "sum"):
         return x.sum()
     if reduction in (1, "mean"):

@@ -220,10 +220,16 @@ class TestIncubateSurface:
         row = paddle.to_tensor(np.array([1, 2, 3, 0], np.int64))
         colptr = paddle.to_tensor(np.array([0, 1, 2, 3, 4], np.int64))
         paddle.seed(0)
-        edges, counts = graph_khop_sampler(row, colptr,
-                                           paddle.to_tensor(np.array([0])),
-                                           [1, 1])
-        assert np.asarray(edges._data).size == 2
+        src, dst, sample_index = graph_khop_sampler(
+            row, colptr, paddle.to_tensor(np.array([0])), [1, 1])
+        # 2 hops from node 0 along the chain: edges (1<-0), (2<-1),
+        # reindexed so node 0 is index 0, first-seen neighbors follow
+        assert np.asarray(src._data).size == 2
+        assert np.asarray(dst._data).tolist()[0] == 0
+        assert np.asarray(sample_index._data).tolist()[0] == 0
+        with pytest.raises(NotImplementedError):
+            graph_khop_sampler(row, colptr, paddle.to_tensor(np.array([0])),
+                               [1], return_eids=True)
         w = paddle.to_tensor(np.array([1.0, 1.0, 1.0, 1.0], np.float32))
         n, c = G.weighted_sample_neighbors(row, colptr, w,
                                            paddle.to_tensor(np.array([0, 1])),
@@ -258,3 +264,21 @@ class TestIoJitAdditions:
         jit.set_verbosity(1)
         jit.set_code_level(50)
         jit.set_verbosity(0)
+
+
+class TestTimerHelper:
+    def test_timer_group_throughput(self):
+        from paddle_tpu.distributed.fleet.utils import get_timers
+        import time as _time
+        timers = get_timers()
+        t = timers("step")
+        for _ in range(3):
+            t.start()
+            _time.sleep(0.01)
+            t.stop()
+        thr = timers.throughput("step", items=300, reset=False)
+        assert 300 / 0.2 < thr < 300 / 0.02
+        msg = timers.log(["step"])
+        assert "step" in msg
+        with pytest.raises(RuntimeError):
+            t.stop()          # not started
