@@ -39,15 +39,14 @@ class Timer:
         self._started = False
 
     def elapsed(self, reset=True):
-        was_running = self._started
-        if was_running:                       # fold the in-flight interval in
-            self.stop()
+        if self._started:   # fold the in-flight interval, keep running
+            now = time.perf_counter()
+            self._elapsed += now - self._start_t
+            self._start_t = now               # reference _Timer restarts
         out = self._elapsed
         if reset:
             self._elapsed = 0.0
             self._count = 0
-        if was_running:                       # reference _Timer restarts
-            self.start()
         return out
 
     def mean(self, reset=True):

@@ -154,12 +154,7 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
     cp = np.asarray(unwrap(colptr))
     nodes = np.asarray(unwrap(input_nodes))
     out_nb, out_cnt = [], []
-    # seed from the framework RNG so draws differ per call but follow
-    # paddle.seed (reference samplers use the global generator)
-    from ..core.rng import next_key
-    rng = np.random.RandomState(
-        int(np.asarray(jax.random.key_data(next_key())).ravel()[-1]
-            & 0x7FFFFFFF))
+    rng = np.random.RandomState(_rng_seed())
     for v in nodes.tolist():
         beg, end = int(cp[v]), int(cp[v + 1])
         nbrs = r[beg:end]

@@ -50,6 +50,40 @@ class LlamaConfig:
         return cls(**kw)
 
     @classmethod
+    def qwen2_moe_a14b(cls, **kw):
+        """Qwen2-57B-A14B MoE geometry (public config: 64 experts, top-8,
+        GQA 28q/4kv, 3584 hidden) — BASELINE config #5 family."""
+        kw.setdefault("vocab_size", 151936)
+        kw.setdefault("hidden_size", 3584)
+        kw.setdefault("intermediate_size", 18944)
+        kw.setdefault("num_hidden_layers", 28)
+        kw.setdefault("num_attention_heads", 28)
+        kw.setdefault("num_key_value_heads", 4)
+        kw.setdefault("max_position_embeddings", 32768)
+        kw.setdefault("rope_theta", 1000000.0)
+        kw.setdefault("num_experts", 64)
+        kw.setdefault("num_experts_per_tok", 8)
+        kw.setdefault("moe_intermediate_size", 2560)
+        return cls(**kw)
+
+    @classmethod
+    def deepseek_moe_16b(cls, **kw):
+        """DeepSeekMoE-16B geometry (public config: 64 routed experts, top-6,
+        2048 hidden, 1408 moe-ffn) — BASELINE config #5 family."""
+        kw.setdefault("vocab_size", 102400)
+        kw.setdefault("hidden_size", 2048)
+        kw.setdefault("intermediate_size", 10944)
+        kw.setdefault("num_hidden_layers", 28)
+        kw.setdefault("num_attention_heads", 16)
+        kw.setdefault("num_key_value_heads", 16)
+        kw.setdefault("max_position_embeddings", 4096)
+        kw.setdefault("rope_theta", 10000.0)
+        kw.setdefault("num_experts", 64)
+        kw.setdefault("num_experts_per_tok", 6)
+        kw.setdefault("moe_intermediate_size", 1408)
+        return cls(**kw)
+
+    @classmethod
     def tiny(cls, **kw):
         kw.setdefault("vocab_size", 256)
         kw.setdefault("hidden_size", 64)
@@ -221,7 +255,8 @@ class LlamaDecoderLayer(Layer):
             from ..parallel.moe import MoELayer
             self.mlp = MoELayer(config.hidden_size, num_experts=config.num_experts,
                                 d_hidden=config.moe_intermediate_size
-                                or config.intermediate_size)
+                                or config.intermediate_size,
+                                top_k=config.num_experts_per_tok)
         else:
             self.mlp = LlamaMLP(config)
 

@@ -39,44 +39,51 @@ def _expert_axes():
     return "mp", None
 
 
-def top2_gating(logits, capacity):
-    """GShard top-2 gating: returns (combine [S,E,C], dispatch mask, aux_loss).
+def topk_gating(logits, capacity, k=2):
+    """GShard-style top-k gating: returns (combine [S,E,C], dispatch mask,
+    aux_loss). Generalizes the classic top-2 — slot s assigns each token its
+    s-th-choice expert, capacity-limited by cumsum position after the prior
+    slots' assignments (reference's number_count/limit_by_capacity/assign_pos
+    kernels collapse into this cumsum math; top-k for the DeepSeekMoE/Qwen2
+    top-6/top-8 routers).
 
-    logits: [S, E] float32. Dense and jit-friendly (reference's number_count/
-    limit_by_capacity/assign_pos kernels collapse into cumsum math).
+    logits: [S, E] float32. Dense and jit-friendly.
     """
     S, E = logits.shape
+    k = min(k, E)     # argmax over an exhausted row would re-pick expert 0
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    # top-1
-    idx1 = jnp.argmax(probs, axis=-1)
-    mask1 = jax.nn.one_hot(idx1, E, dtype=jnp.float32)
-    # top-2: mask out top-1 then argmax
-    probs2 = probs * (1 - mask1)
-    idx2 = jnp.argmax(probs2, axis=-1)
-    mask2 = jax.nn.one_hot(idx2, E, dtype=jnp.float32)
-    # aux load-balancing loss (Switch/GShard)
-    density = jnp.mean(mask1, axis=0)
-    density_proxy = jnp.mean(probs, axis=0)
-    aux_loss = jnp.sum(density * density_proxy) * E
-    # capacity positions via cumsum per expert
-    pos1 = (jnp.cumsum(mask1, axis=0) - 1) * mask1          # position within expert
-    mask1 = mask1 * (pos1 < capacity)
-    pos2 = (jnp.cumsum(mask2, axis=0) - 1 + jnp.sum(mask1, axis=0)) * mask2
-    mask2 = mask2 * (pos2 < capacity)
-    g1 = jnp.sum(probs * mask1, axis=-1)
-    g2 = jnp.sum(probs * mask2, axis=-1)
-    denom = jnp.maximum(g1 + g2, 1e-9)
-    g1, g2 = g1 / denom, g2 / denom
-    loc1 = jnp.sum(pos1, axis=-1).astype(jnp.int32)
-    loc2 = jnp.sum(pos2, axis=-1).astype(jnp.int32)
-    sel1 = jnp.sum(mask1, axis=-1)
-    sel2 = jnp.sum(mask2, axis=-1)
-    cap_oh1 = jax.nn.one_hot(loc1, capacity, dtype=jnp.float32) * sel1[:, None]
-    cap_oh2 = jax.nn.one_hot(loc2, capacity, dtype=jnp.float32) * sel2[:, None]
-    combine = (g1[:, None, None] * mask1[:, :, None] * cap_oh1[:, None, :]
-               + g2[:, None, None] * mask2[:, :, None] * cap_oh2[:, None, :])
+    remaining = probs
+    prior = jnp.zeros((E,), jnp.float32)     # capacity used by earlier slots
+    combine = jnp.zeros((S, E, capacity), jnp.float32)
+    gsum = jnp.zeros((S,), jnp.float32)
+    aux_loss = None
+    for slot in range(k):
+        idx = jnp.argmax(remaining, axis=-1)
+        mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+        remaining = remaining * (1 - mask)
+        if slot == 0:
+            # aux load-balancing loss (Switch/GShard): top-1 density
+            density = jnp.mean(mask, axis=0)
+            density_proxy = jnp.mean(probs, axis=0)
+            aux_loss = jnp.sum(density * density_proxy) * E
+        pos = (jnp.cumsum(mask, axis=0) - 1 + prior) * mask
+        mask = mask * (pos < capacity)
+        prior = prior + jnp.sum(mask, axis=0)
+        g = jnp.sum(probs * mask, axis=-1)
+        loc = jnp.sum(pos, axis=-1).astype(jnp.int32)
+        sel = jnp.sum(mask, axis=-1)
+        cap_oh = jax.nn.one_hot(loc, capacity, dtype=jnp.float32) * sel[:, None]
+        combine = combine + (g[:, None, None] * mask[:, :, None]
+                             * cap_oh[:, None, :])
+        gsum = gsum + g
+    combine = combine / jnp.maximum(gsum, 1e-9)[:, None, None]
     dispatch = combine > 0
     return combine, dispatch, aux_loss
+
+
+def top2_gating(logits, capacity):
+    """Classic GShard top-2 (kept as the named entry point)."""
+    return topk_gating(logits, capacity, k=2)
 
 
 class ExpertMLP(Layer):
@@ -103,15 +110,17 @@ class ExpertMLP(Layer):
 
 
 class MoELayer(Layer):
-    """reference: moe/moe_layer.py:263. gate='top2' GShard-style."""
+    """reference: moe/moe_layer.py:263. GShard-style gate, top_k selectable
+    (top-2 default; DeepSeek/Qwen2 MoE use 6/8)."""
 
     def __init__(self, d_model, experts=None, num_experts=8, d_hidden=None,
                  gate=None, moe_group=None, mp_group=None, recompute_interval=0,
-                 capacity_factor=1.25, name=None):
+                 capacity_factor=1.25, top_k=2, name=None):
         super().__init__()
         self.num_experts = num_experts
         self.d_model = d_model
         self.capacity_factor = capacity_factor
+        self.top_k = int(top_k)
         self.gate_w = self.create_parameter([d_model, num_experts],
                                             default_initializer=XavierUniform())
         self.experts = experts if experts is not None else \
@@ -122,13 +131,15 @@ class MoELayer(Layer):
         b, s, m = x.shape
         S = b * s
         E = self.num_experts
-        C = int(np.ceil(self.capacity_factor * S / E))
+        # GShard capacity scales with the router fan-out: top-k dispatches
+        # k*S assignments, so slots must scale by k or most are dropped
+        C = int(np.ceil(self.capacity_factor * self.top_k * S / E))
         cap = C
 
         def f(a, gw):
             flat = a.reshape(S, m)
             logits = flat.astype(jnp.float32) @ gw.astype(jnp.float32)
-            combine, dispatch, aux = top2_gating(logits, cap)
+            combine, dispatch, aux = topk_gating(logits, cap, self.top_k)
             # dispatch tokens -> [E, C, m] (alltoall emitted by GSPMD given the
             # expert-sharded weights downstream)
             exp_in = jnp.einsum("sec,sm->ecm", dispatch.astype(a.dtype), flat)

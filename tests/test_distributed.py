@@ -701,3 +701,61 @@ class TestGroupShardedWrappers:
                 getattr(getattr(t._buf, "sharding", None), "spec", (None,))[0]
                 == "sharding" for t in acc.values())
             assert any_sharded, cls.__name__
+
+
+class TestTopKGating:
+    def test_topk_reduces_to_top2(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu.parallel.moe import topk_gating, top2_gating
+        rng = np.random.RandomState(0)
+        logits = jnp.asarray(rng.randn(16, 4).astype(np.float32))
+        c2, d2, a2 = top2_gating(logits, 8)
+        ck, dk, ak = topk_gating(logits, 8, k=2)
+        np.testing.assert_allclose(np.asarray(c2), np.asarray(ck), atol=1e-6)
+        np.testing.assert_allclose(float(a2), float(ak), atol=1e-6)
+
+    def test_topk_routes_k_experts_and_respects_capacity(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu.parallel.moe import topk_gating
+        rng = np.random.RandomState(1)
+        S, E, C, K = 12, 8, 4, 4
+        logits = jnp.asarray(rng.randn(S, E).astype(np.float32))
+        combine, dispatch, aux = topk_gating(logits, C, k=K)
+        d = np.asarray(dispatch)
+        per_token = d.any(-1).sum(-1)          # experts hit per token
+        assert per_token.max() <= K and per_token.max() >= 2
+        # capacity: each (expert, slot) bucket holds at most one token
+        assert d.sum(axis=0).max() <= 1 + 1e-6
+        # combine weights normalized over selected experts
+        w = np.asarray(combine).sum(axis=(1, 2))
+        sel = per_token > 0
+        np.testing.assert_allclose(w[sel], np.ones(sel.sum()), rtol=1e-5)
+
+    def test_moe_model_with_top6_preset_trains(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        paddle.seed(0)
+        cfg = LlamaConfig.deepseek_moe_16b(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+            max_position_embeddings=64, num_experts=8,
+            moe_intermediate_size=32)
+        assert cfg.num_experts_per_tok == 6
+        model = LlamaForCausalLM(cfg)
+        assert model.llama.layers[0].mlp.top_k == 6
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=model.parameters())
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 128, (2, 17)).astype(np.int32)
+        losses = []
+        for _ in range(3):
+            _, loss = model(paddle.to_tensor(ids[:, :-1]),
+                            labels=paddle.to_tensor(ids[:, 1:]))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
