@@ -228,17 +228,23 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
         if id(node) in processed:
             continue
         processed.add(id(node))
-        if node.vjp_fn is None:
+        if node.freed or (node.vjp_fn is None and not node.deferred):
             raise RuntimeError(
                 f"grad graph for node '{node.name}' was already freed; "
                 "pass retain_graph=True to backward() to backprop twice.")
-        # collect output cotangents (zeros for unused outputs)
+        # collect output cotangents (zeros for unused outputs); a non-leaf
+        # output's accumulated cotangent is fully consumed here, so drop it
+        # from the accumulator to keep backward peak memory at the frontier
         out_cots = []
         for i, ref in enumerate(node.out_refs):
             t = ref() if ref is not None else None
             cot = None
             if t is not None:
                 cot = finalize(t)
+                if (cot is not None and t._grad_node is not None
+                        and not t._retain_grad):
+                    cots.pop(id(t), None)
+                    keepalive.pop(id(t), None)
             if cot is None:
                 shape, dt = node.out_avals[i]
                 cot = _const(jnp.zeros(shape, dtype=dt))
@@ -281,7 +287,8 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
             if create_graph:
                 arg = jax.tree_util.tree_map(
                     lambda c: c._data if isinstance(c, Tensor) else c, arg)
-            in_cots = node.vjp_fn(arg)
+            in_cots = node.pullback(arg)
+        del out_cots
         if not retain_graph and not create_graph:
             node.release()
         for inp, cot in zip(node.inputs, in_cots):
@@ -293,6 +300,12 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
                 dep[id(prod)] -= 1
                 if dep[id(prod)] == 0:
                     ready.append(node_by_id[id(prod)])
+        if not retain_graph and not create_graph and not node.keep_arrays:
+            # drop the node's strong refs to its input tensors so forward
+            # activations free progressively as the sweep walks the tape
+            # (keep_arrays = a static.program_guard recorder still needs the
+            # graph for Executor.run replay)
+            node.inputs = (None,) * len(node.inputs)
     # finalize leaves that never went through a node's out_refs; params whose
     # grads were deferred never entered `cots`, so this flushes only the
     # immediately-computed cotangents

@@ -9,16 +9,17 @@ from __future__ import annotations
 import weakref
 
 import numpy as np
+import jax
 
 from ..core.tensor import Tensor
 
 
 class GradNode:
     __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "out_refs", "n_outs",
-                 "raw_fn", "in_arrays")
+                 "raw_fn", "in_arrays", "deferred", "freed", "keep_arrays")
 
     def __init__(self, name, vjp_fn, inputs, out_arrays, raw_fn=None,
-                 in_arrays=None):
+                 in_arrays=None, deferred=False, keep_arrays=False):
         self.name = name
         self.vjp_fn = vjp_fn
         # keep only Tensor inputs' autograd linkage; raw arrays get None
@@ -31,12 +32,36 @@ class GradNode:
         # grad records grad ops the same way)
         self.raw_fn = raw_fn
         self.in_arrays = in_arrays
+        # deferred: vjp_fn is None by design — backward recomputes it from
+        # raw_fn+in_arrays (memory-light capture spy / recompute-grad mode)
+        self.deferred = deferred
+        self.freed = False
+        # static.program_guard replay needs raw_fn/in_arrays after backward
+        self.keep_arrays = keep_arrays
 
     def set_outputs(self, tensors):
         self.out_refs = tuple(weakref.ref(t) for t in tensors)
 
+    def pullback(self, arg):
+        """Output-cotangents -> input-cotangents. Deferred nodes recompute the
+        vjp here and drop the residuals immediately after."""
+        if self.vjp_fn is not None:
+            return self.vjp_fn(arg)
+        _, vjp_fn = jax.vjp(self.raw_fn, *self.in_arrays)
+        try:
+            return vjp_fn(arg)
+        finally:
+            del vjp_fn
+
     def release(self):
+        """Free grad resources after the sweep consumed this node. Keeps the
+        graph structure (inputs/avals) but drops residuals; also drops the
+        recompute closure unless a static replay recorder needs it."""
         self.vjp_fn = None
+        self.freed = True
+        if not self.keep_arrays:
+            self.raw_fn = None
+            self.in_arrays = None
 
     def __repr__(self):
         return f"GradNode({self.name}, n_outs={self.n_outs})"

@@ -92,15 +92,27 @@ def apply_op(name: str, fn: Callable, *inputs, out_treedef_hint=None):
 
     if needs_grad:
         from ..autograd.node import GradNode
+        tc = _state.trace_ctx
+        defer = ((tc is not None and getattr(tc, "mode", None) == "spy")
+                 or flags.flag("eager_recompute_grad"))
         try:
-            outs, vjp_fn = jax.vjp(fn, *arrays)
+            if defer:
+                # capture spy pass (or FLAGS_eager_recompute_grad): don't hold
+                # jax.vjp residuals per op — backward recomputes the vjp from
+                # raw_fn+in_arrays one node at a time, so peak memory during
+                # the eager discovery pass stays near the live-activation set
+                # instead of sum-of-residuals (the round-2 capture OOM wall)
+                outs, vjp_fn = fn(*arrays), None
+            else:
+                outs, vjp_fn = jax.vjp(fn, *arrays)
         except Exception as e:   # op-attributed errors (ref error summary)
             e.add_note(_op_error_note(name, arrays))
             raise
         single = not isinstance(outs, (tuple, list))
         outs_t = (outs,) if single else tuple(outs)
         node = GradNode(name, vjp_fn, inputs, outs_t, raw_fn=fn,
-                        in_arrays=arrays)
+                        in_arrays=arrays, deferred=defer,
+                        keep_arrays=_state.static_record)
         wrapped = []
         for i, o in enumerate(outs_t):
             diff = np.dtype(o.dtype).kind in _FLOAT_KINDS

@@ -80,6 +80,10 @@ define_flag("tpu_matmul_precision", "highest",
             "matmuls true fp32 on the MXU (multi-pass bf16); bf16 inputs are "
             "unaffected, so bf16 training keeps full MXU throughput")
 define_flag("log_level", 0, "VLOG-style verbosity for framework logging")
+define_flag("eager_recompute_grad", False,
+            "eager autograd stores op inputs only and recomputes each vjp at "
+            "backward time (2x forward FLOPs, far lower peak memory); the "
+            "to_static spy pass always runs in this mode")
 
 
 def _apply_matmul_precision(value):

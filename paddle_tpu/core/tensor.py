@@ -157,17 +157,30 @@ class Tensor:
             raise TypeError("len() of a 0-D tensor")
         return self._buf.shape[0]
 
+    def _convert_scalar(self, kind, caster):
+        """Host scalar conversion. Under program capture this is a GUARD
+        point (the SOT guard analog, jit/to_static.py): the spy pass records
+        the concrete value; replay emits the traced value as a program output
+        and the runtime re-specializes when a step's actual value diverges."""
+        from .dispatch import _state
+        tc = _state.trace_ctx
+        if tc is not None and hasattr(tc, "on_scalar"):
+            return tc.on_scalar(self, kind, caster)
+        return caster(self._data)
+
     def __bool__(self) -> bool:
-        return bool(self._data)  # raises TracerBoolConversionError under capture
+        return self._convert_scalar("bool", lambda a: bool(a))
 
     def __int__(self) -> int:
-        return int(self._data)
+        return self._convert_scalar("int", lambda a: int(a))
 
     def __float__(self) -> float:
+        # float guards would re-specialize on every distinct value; keep this
+        # a graph break (raises Tracer*Error under capture)
         return float(self._data)
 
     def __index__(self) -> int:
-        return int(self._data)
+        return self._convert_scalar("int", lambda a: int(a))
 
     def __format__(self, spec):
         if self.ndim == 0 and not _is_tracer(self._buf):

@@ -16,10 +16,21 @@ chokepoint; Tensor state reads/writes go through properties):
                     optimizer step.
 
 Guards: arg treedef + shapes/dtypes + static-arg values (the SOT guard analog) —
-a new signature re-traces. Graph breaks: TracerBoolConversionError /
-ConcretizationTypeError (data-dependent python control flow) or capture misses
-mark the signature eager-only — the SOT graph-break fallback analog. Shapes are
-static per signature; variable seq-len is handled by bucketing above (SURVEY §7).
+a new signature re-traces.
+
+Data-dependent Python control flow (the SOT graph-break case,
+reference python/paddle/jit/sot/translate.py:31): a bool()/int() conversion of a
+tensor inside the captured fn becomes a VALUE GUARD instead of a break. The spy
+records the conversion's concrete value; replay substitutes it (specializing the
+trace on that branch) and emits the traced scalar as an extra program output.
+At run time the compiled step's guard outputs are checked against the
+specialized values — on divergence the step's state writes are discarded, a
+variant specialized on the new values is looked up or traced, and the step
+re-runs. The whole function stays compiled on every path taken (vs the
+reference's SOT, which stitches compiled subgraphs around an eager region).
+float() conversions and .numpy() reads remain true graph breaks and mark the
+signature eager-only. Shapes are static per signature; variable seq-len is
+handled by bucketing above (SURVEY §7).
 """
 from __future__ import annotations
 
@@ -49,7 +60,7 @@ def _is_tensor(x):
 
 
 class _SpyContext:
-    """Eager pass-through that records external reads + writes."""
+    """Eager pass-through that records external reads + writes + guards."""
 
     mode = "spy"
 
@@ -59,6 +70,16 @@ class _SpyContext:
         self.grad_reads: dict[int, Tensor] = {}
         self.grad_writes: dict[int, Tensor] = {}
         self.created: set[int] = set()
+        self.guards: list[tuple[str, object]] = []  # (kind, concrete value)
+
+    def on_scalar(self, t, kind, caster):
+        # read through on_read so a tensor consumed ONLY via bool()/int() is
+        # still recorded as an external read (lifted to a program input);
+        # otherwise replay would bake the spy-time value in as a constant and
+        # the emitted guard could never diverge
+        v = caster(self.on_read(t))
+        self.guards.append((kind, v))
+        return v
 
     def on_create(self, t):
         self.created.add(id(t))
@@ -94,14 +115,36 @@ class _ReplayContext:
 
     mode = "replay"
 
-    def __init__(self, lifted: dict[int, object], grad_lifted=None):
+    def __init__(self, lifted: dict[int, object], grad_lifted=None,
+                 guard_plan=None):
         self.values = lifted                  # id(Tensor) -> traced array
         self.grad_lifted = grad_lifted or {}  # id(Tensor) -> traced grad array
         self.data_shadow: dict[int, object] = {}
         self.grad_shadow: dict[int, object] = {}
+        self.guard_plan = guard_plan or []    # [(kind, value)] from the spy
+        self.guard_idx = 0
+        self.guard_outs: list[object] = []    # traced guard scalars, in order
 
     def on_create(self, t):
         pass
+
+    def on_scalar(self, t, kind, caster):
+        i = self.guard_idx
+        if i >= len(self.guard_plan) or self.guard_plan[i][0] != kind:
+            raise MissedCapture(
+                "scalar-conversion sequence diverged from the spy pass")
+        self.guard_idx += 1
+        val = self.on_read(t)
+        # normalize to an int32 scalar matching python bool()/int() semantics
+        # (astype truncates toward zero, as int() does)
+        import jax.numpy as jnp
+        val = jnp.asarray(val).reshape(())
+        if kind == "bool":
+            out = (val != 0).astype(jnp.int32)
+        else:
+            out = val.astype(jnp.int32)
+        self.guard_outs.append(out)
+        return self.guard_plan[i][1]
 
     def on_read(self, t):
         k = id(t)
@@ -149,11 +192,31 @@ class _ReplayContext:
 
 class _CacheEntry:
     __slots__ = ("compiled", "mut_list", "ro_list", "write_list", "grad_list",
-                 "grad_in_list", "out_treedef", "out_mask", "eager_only", "treedef")
+                 "grad_in_list", "out_treedef", "out_mask",
+                 "treedef", "guard_kinds", "guard_ints")
 
     def __init__(self):
         self.compiled = None
+        self.guard_kinds = ()
+        self.guard_ints = ()     # specialized guard values, int-normalized
+
+
+class _SigGroup:
+    """All compiled variants for one argument signature. Multiple variants
+    exist only when the fn has value guards (data-dependent branches): one
+    per branch-combination actually taken."""
+    __slots__ = ("variants", "eager_only", "last")
+
+    MAX_VARIANTS = 8
+
+    def __init__(self):
+        self.variants: list[_CacheEntry] = []
         self.eager_only = False
+        self.last: _CacheEntry | None = None
+
+
+def _guard_ints(guards):
+    return tuple(int(v) for _, v in guards)
 
 
 def _sig_key(leaves, treedef):
@@ -180,7 +243,7 @@ class StaticFunction:
     def __init__(self, function, input_spec=None, build_strategy=None, backend=None,
                  full_graph=False, donate_state=True):
         self._fn = function
-        self._cache: dict[str, _CacheEntry] = {}
+        self._cache: dict[str, _SigGroup] = {}
         self._spy_attempts: dict[str, int] = {}
         self._donate = donate_state
         try:
@@ -202,20 +265,54 @@ class StaticFunction:
             return self._fn(*args, **kwargs)  # nested capture: inline
         leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
         key = _sig_key(leaves, treedef)
-        entry = self._cache.get(key)
-        if entry is None:
+        group = self._cache.get(key)
+        if group is None:
             return self._spy(key, leaves, treedef)
-        if entry.eager_only:
+        if group.eager_only:
             return self._fn(*args, **kwargs)
-        try:
-            return self._run(entry, leaves)
-        except MissedCapture:
-            logger.warning("to_static: capture miss; re-tracing")
-            del self._cache[key]
-            return self._spy(key, leaves, treedef)
+        entry = group.last if group.last is not None else group.variants[0]
+        tried: set[int] = set()
+        while True:
+            tried.add(id(entry))
+            try:
+                result, actual = self._run(entry, leaves)
+            except MissedCapture:
+                logger.warning("to_static: capture miss; re-tracing")
+                group.variants = [v for v in group.variants if v is not entry]
+                group.last = None
+                if not group.variants:
+                    del self._cache[key]
+                return self._spy(key, leaves, treedef)
+            if actual is None or actual == entry.guard_ints:
+                group.last = entry
+                return result
+            # guard divergence: this step took a different branch. The actual
+            # guard values are trustworthy only up to (and including) the
+            # first mismatch — after it the trace followed the wrong path.
+            k = next(i for i, (a, b) in enumerate(zip(actual, entry.guard_ints))
+                     if a != b)
+            prefix = actual[:k + 1]
+            nxt = next((v for v in group.variants
+                        if id(v) not in tried
+                        and v.guard_ints[:k + 1] == prefix), None)
+            if nxt is None:
+                logger.info("to_static: guard divergence at #%d; specializing "
+                            "a new variant", k)
+                return self._spy(key, leaves, treedef)
+            entry = nxt
 
     # ---- pass 1: eager spy ---------------------------------------------------
     def _spy(self, key, leaves, treedef):
+        group = self._cache.get(key)
+        if group is None:
+            group = self._cache[key] = _SigGroup()
+        if len(group.variants) >= _SigGroup.MAX_VARIANTS:
+            logger.warning(
+                "to_static: %d guard-specialized variants for one signature; "
+                "marking it eager-only", len(group.variants))
+            group.eager_only = True
+            args, kwargs = jax.tree_util.tree_unflatten(treedef, leaves)
+            return self._fn(*args, **kwargs)
         ctx = _SpyContext()
         prev = _state.trace_ctx
         _state.trace_ctx = ctx
@@ -236,35 +333,51 @@ class StaticFunction:
         entry.grad_list = list(ctx.grad_writes.values())
         entry.grad_in_list = [t for k, t in ctx.grad_reads.items()
                               if k not in arg_ids]
-        self._cache[key] = entry
+        entry.guard_kinds = tuple(k for k, _ in ctx.guards)
+        entry.guard_ints = _guard_ints(ctx.guards)
+        group.variants.append(entry)
+        group.last = entry
         try:
-            self._compile(entry, leaves)
+            self._compile(entry, leaves, ctx.guards)
         except _BREAKS as e:
             logger.info("to_static: graph break (%s); signature stays eager",
                         type(e).__name__)
-            entry.eager_only = True
+            group.eager_only = True
         except MissedCapture as e:
             attempts = self._spy_attempts.get(key, 0) + 1
             self._spy_attempts[key] = attempts
+            group.variants.remove(entry)
+            group.last = None
             if attempts < self.MAX_SPY_ATTEMPTS:
                 # state created during this spy (lazy-init accumulators) is
                 # external state next call — drop the entry so the next call
                 # re-spies with that state pre-existing and fully captured
                 logger.info("to_static: %s; re-spying on next call "
                             "(attempt %d)", e, attempts)
-                del self._cache[key]
+                if not group.variants:
+                    del self._cache[key]
             else:
                 logger.warning("to_static: %s after %d spy attempts; "
                                "signature stays eager", e, attempts)
-                entry.eager_only = True
+                group.eager_only = True
+        else:
+            if ctx.grad_writes:
+                # train-step pattern (fn ran backward internally): replay-path
+                # outputs are detached, so detach the spy outputs too — this
+                # frees the spy tape immediately instead of holding the whole
+                # step's activations until the caller drops the result
+                for leaf in jax.tree_util.tree_leaves(result, is_leaf=_is_tensor):
+                    if isinstance(leaf, Tensor):
+                        leaf._grad_node = None
         return result
 
     # ---- build + jit the pure function --------------------------------------
-    def _compile(self, entry, leaves):
+    def _compile(self, entry, leaves, guards=()):
         fn = self._fn
         treedef = entry.treedef
         tensor_pos = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
         arg_meta = [(leaves[i].stop_gradient, leaves[i].name) for i in tensor_pos]
+        guards = list(guards)
 
         def pure_fn(arg_arrays, mut_arrays, ro_arrays, grad_in_arrays):
             new_leaves = list(leaves)
@@ -280,7 +393,7 @@ class StaticFunction:
                 lifted[id(t)] = arr
             grad_lifted = {id(t): arr
                            for t, arr in zip(entry.grad_in_list, grad_in_arrays)}
-            ctx = _ReplayContext(lifted, grad_lifted)
+            ctx = _ReplayContext(lifted, grad_lifted, guard_plan=guards)
             prev = _state.trace_ctx
             _state.trace_ctx = ctx
             try:
@@ -301,11 +414,17 @@ class StaticFunction:
                     grad_out.append(g)
             finally:
                 _state.trace_ctx = prev
+            if ctx.guard_idx != len(guards):
+                raise MissedCapture(
+                    "replay consumed fewer scalar conversions than the spy "
+                    "pass recorded")
             entry.out_treedef = out_treedef
             entry.out_mask = out_mask
-            return out_vals, write_out, grad_out
+            return out_vals, write_out, grad_out, ctx.guard_outs
 
-        donate = (1,) if self._donate and entry.mut_list else ()
+        # guard-specialized variants re-run on divergence against the SAME
+        # pre-step state, so their inputs must not be donated
+        donate = (1,) if self._donate and entry.mut_list and not guards else ()
         arg_arrays = [leaves[i]._buf for i in tensor_pos]
         mut_arrays = [t._buf for t in entry.mut_list]
         ro_arrays = [t._buf for t in entry.ro_list]
@@ -335,19 +454,28 @@ class StaticFunction:
         return arrays
 
     def _run(self, entry, leaves):
+        """Run the compiled variant. Returns (result, actual_guard_values);
+        actual is None for guard-free entries. State writes COMMIT only when
+        the guards match (or there are none) — a diverged run leaves all
+        framework state untouched so the caller can re-run another variant."""
         tensor_pos = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
         arg_arrays = [leaves[i]._buf for i in tensor_pos]
         mut_arrays = [t._buf for t in entry.mut_list]
         ro_arrays = [t._buf for t in entry.ro_list]
-        out_vals, write_out, grad_out = entry.compiled(
+        out_vals, write_out, grad_out, guard_out = entry.compiled(
             arg_arrays, mut_arrays, ro_arrays, self._grad_in_arrays(entry))
+        actual = None
+        if entry.guard_kinds:
+            actual = tuple(int(v) for v in jax.device_get(guard_out))
+            if actual != entry.guard_ints:
+                return None, actual
         for t, arr in zip(entry.write_list, write_out):
             t._buf = arr
         for t, g in zip(entry.grad_list, grad_out):
             t._grad_buf = Tensor(g) if g is not None and not isinstance(g, Tensor) else g
         out_leaves = [Tensor(v) if m else v
                       for v, m in zip(out_vals, entry.out_mask)]
-        return jax.tree_util.tree_unflatten(entry.out_treedef, out_leaves)
+        return jax.tree_util.tree_unflatten(entry.out_treedef, out_leaves), actual
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None,

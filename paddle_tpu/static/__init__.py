@@ -177,9 +177,11 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     """reference static append_backward: run the tape backward over the
     recorded program and return (param, grad) pairs."""
     from ..autograd import backward as _bw
-    _bw([loss])
+    # walk the tape BEFORE the sweep: backward() releases node inputs
+    # progressively to free activations as it goes
     params = parameter_list or [
         t for t in _iter_recorded_params(loss) if not t.stop_gradient]
+    _bw([loss])
     return [(p, p.grad) for p in params if p.grad is not None]
 
 
