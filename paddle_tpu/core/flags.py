@@ -80,6 +80,10 @@ define_flag("tpu_matmul_precision", "highest",
             "matmuls true fp32 on the MXU (multi-pass bf16); bf16 inputs are "
             "unaffected, so bf16 training keeps full MXU throughput")
 define_flag("log_level", 0, "VLOG-style verbosity for framework logging")
+define_flag("flash_layout_direct", False,
+            "flash attention reads [B,S,H,D] operands directly (no relayout "
+            "copies) via in-kernel per-head lane slicing; measured slower on "
+            "v5e at GPT-2 shapes, may win at other geometries")
 define_flag("eager_recompute_grad", False,
             "eager autograd stores op inputs only and recomputes each vjp at "
             "backward time (2x forward FLOPs, far lower peak memory); the "

@@ -25,11 +25,16 @@ __all__ = [
 
 
 class SparseCooTensor:
-    """COO sparse tensor over BCOO (reference phi SparseCooTensor)."""
+    """COO sparse tensor over BCOO (reference phi SparseCooTensor).
 
-    def __init__(self, bcoo, stop_gradient=True):
+    `values_t` (optional) is a tape-connected dense Tensor over the stored
+    values: sparse.nn layers thread it through op dispatch so autograd flows
+    from sparse outputs back to layer weights and input values."""
+
+    def __init__(self, bcoo, stop_gradient=True, values_t=None):
         self._mat = bcoo
         self.stop_gradient = stop_gradient
+        self._vt = values_t
 
     # ---- introspection ------------------------------------------------------
     @property
@@ -51,7 +56,7 @@ class SparseCooTensor:
         return Tensor(self._mat.indices.T)      # [ndim, nnz] paddle layout
 
     def values(self):
-        return Tensor(self._mat.data)
+        return self._vt if self._vt is not None else Tensor(self._mat.data)
 
     def is_sparse(self):
         return True
@@ -66,7 +71,17 @@ class SparseCooTensor:
         return Tensor(self._mat.todense())
 
     def to_sparse_csr(self):
-        return SparseCsrTensor(jsparse.BCSR.from_bcoo(self._mat))
+        mat = self._mat
+        if len(mat.shape) == 3 and mat.n_batch == 0:
+            # batched CSR (reference 3D CSR): leading dim becomes the batch
+            mat = jsparse.bcoo_update_layout(mat, n_batch=1,
+                                             on_inefficient=None)
+        # NOTE: layout conversion may reorder entries; thread the
+        # tape-connected values through only when the order is unchanged
+        # (2D from_bcoo preserves row-major COO order)
+        vt = self._vt if len(self._mat.shape) == 2 else None
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(mat),
+                               self.stop_gradient, values_t=vt)
 
     def coalesce(self):
         return SparseCooTensor(self._mat.sum_duplicates(
@@ -104,9 +119,10 @@ class SparseCooTensor:
 class SparseCsrTensor:
     """CSR sparse tensor over BCSR (reference phi SparseCsrTensor)."""
 
-    def __init__(self, bcsr, stop_gradient=True):
+    def __init__(self, bcsr, stop_gradient=True, values_t=None):
         self._mat = bcsr
         self.stop_gradient = stop_gradient
+        self._vt = values_t
 
     @property
     def shape(self):
@@ -126,7 +142,7 @@ class SparseCsrTensor:
         return Tensor(self._mat.indices)
 
     def values(self):
-        return Tensor(self._mat.data)
+        return self._vt if self._vt is not None else Tensor(self._mat.data)
 
     def is_sparse(self):
         return True
@@ -141,7 +157,8 @@ class SparseCsrTensor:
         return Tensor(self._mat.todense())
 
     def to_sparse_coo(self, sparse_dim=None):
-        return SparseCooTensor(self._mat.to_bcoo())
+        return SparseCooTensor(self._mat.to_bcoo(), self.stop_gradient,
+                               values_t=self._vt)
 
     def numpy(self):
         return np.asarray(self._mat.todense())
@@ -158,13 +175,18 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
     indices: [ndim, nnz]; values: [nnz, ...]."""
     idx = np.asarray(unwrap(indices) if isinstance(indices, Tensor)
                      else indices)
+    vt = values if isinstance(values, Tensor) else None
     v = unwrap(values) if isinstance(values, Tensor) else jnp.asarray(values)
     if dtype is not None:
         v = v.astype(dtype)
+        vt = None
+    if vt is not None and not stop_gradient and vt.stop_gradient:
+        # fresh view over the same buffer: don't mutate the caller's tensor
+        vt = Tensor(vt._buf, stop_gradient=False)
     if shape is None:
         shape = tuple(int(i) + 1 for i in idx.max(axis=1))
     mat = jsparse.BCOO((v, jnp.asarray(idx.T)), shape=tuple(shape))
-    return SparseCooTensor(mat, stop_gradient)
+    return SparseCooTensor(mat, stop_gradient, values_t=vt)
 
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
@@ -174,11 +196,15 @@ def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
                                     else crows))
     idx = jnp.asarray(np.asarray(unwrap(cols) if isinstance(cols, Tensor)
                                  else cols))
+    vt = values if isinstance(values, Tensor) else None
     v = unwrap(values) if isinstance(values, Tensor) else jnp.asarray(values)
     if dtype is not None:
         v = v.astype(dtype)
+        vt = None
+    if vt is not None and not stop_gradient and vt.stop_gradient:
+        vt = Tensor(vt._buf, stop_gradient=False)
     mat = jsparse.BCSR((v, idx, indptr), shape=tuple(shape))
-    return SparseCsrTensor(mat, stop_gradient)
+    return SparseCsrTensor(mat, stop_gradient, values_t=vt)
 
 
 def is_same_shape(x, y):
@@ -338,45 +364,7 @@ def pow(x, factor, name=None):
     return x._map_values(lambda v: jnp.power(v, factor))
 
 
-class _SparseNN:
-    """paddle.sparse.nn shim: value-wise activation layers."""
-
-    class ReLU:
-        def __call__(self, x):
-            return relu(x)
-
-    class Softmax:
-        """Per-row softmax over STORED values only (reference sparse softmax
-        kernel semantics: explicit zeros participate, absent entries don't).
-        Runs as segment ops over the CSR value array — never densifies."""
-
-        def __init__(self, axis=-1):
-            self.axis = axis
-
-        def __call__(self, x):
-            if self.axis != -1:
-                raise ValueError(
-                    "sparse softmax supports axis=-1 only (2D CSR rows, "
-                    "matching the reference kernel)")
-            was_coo = isinstance(x, SparseCooTensor)
-            csr = x.to_sparse_csr() if was_coo else x
-            mat = csr._mat
-            if len(mat.shape) != 2:
-                raise ValueError("sparse softmax expects a 2D tensor")
-            nrows = mat.shape[0]
-            row = jnp.searchsorted(mat.indptr, jnp.arange(mat.nse),
-                                   side="right") - 1
-            vals = mat.data
-            rmax = jax.ops.segment_max(vals, row, num_segments=nrows)
-            ex = jnp.exp(vals - rmax[row])
-            denom = jax.ops.segment_sum(ex, row, num_segments=nrows)
-            out = jsparse.BCSR((ex / denom[row], mat.indices, mat.indptr),
-                               shape=tuple(mat.shape))
-            res = SparseCsrTensor(out)
-            return res.to_sparse_coo() if was_coo else res
-
-
-nn = _SparseNN()
+from . import nn  # real sparse.nn subpackage (conv/pool/norm/activation)  # noqa: E402
 
 
 deg2rad = _unary("deg2rad", jnp.deg2rad)

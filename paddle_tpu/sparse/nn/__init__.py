@@ -1,0 +1,169 @@
+"""paddle.sparse.nn — sparse layers (reference: python/paddle/sparse/nn/layer/
+{conv.py,pooling.py,norm.py,activation.py}).
+
+Layers hold dense Parameters; forward routes through
+paddle_tpu.sparse.nn.functional, so autograd flows from sparse outputs back
+to the weights (and to input values) through the op dispatch tape.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import functional  # noqa: F401
+from . import functional as F
+from ...nn import Layer
+
+__all__ = [
+    "ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm", "SyncBatchNorm",
+    "Conv2D", "Conv3D", "SubmConv2D", "SubmConv3D", "MaxPool3D",
+]
+
+
+def _ntuple(v, nd):
+    return tuple(v) if isinstance(v, (list, tuple)) else (int(v),) * nd
+
+
+class _SparseConv(Layer):
+    _nd = 3
+    _subm = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=None, key=None,
+                 padding_mode="zeros", weight_attr=None, bias_attr=None,
+                 data_format=None):
+        super().__init__()
+        nd = self._nd
+        if padding_mode != "zeros":
+            raise ValueError("sparse conv supports padding_mode='zeros'")
+        self._in_channels = in_channels
+        self._out_channels = out_channels
+        self._kernel_size = _ntuple(kernel_size, nd)
+        self._stride = _ntuple(stride, nd)
+        self._padding = padding
+        self._dilation = _ntuple(dilation, nd)
+        self._groups = groups
+        self._key = key
+        fan = int(np.prod(self._kernel_size)) * in_channels
+        wshape = list(self._kernel_size) + [in_channels, out_channels]
+        from ...nn.initializer import KaimingNormal, Constant
+        self.weight = self.create_parameter(
+            wshape, attr=weight_attr,
+            default_initializer=KaimingNormal(fan_in=fan))
+        self.bias = self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+
+    def forward(self, x):
+        fn = {
+            (2, False): F.conv2d, (2, True): F.subm_conv2d,
+            (3, False): F.conv3d, (3, True): F.subm_conv3d,
+        }[(self._nd, self._subm)]
+        kw = {"key": self._key} if self._subm else {}
+        return fn(x, self.weight, self.bias, stride=self._stride,
+                  padding=self._padding, dilation=self._dilation,
+                  groups=self._groups, **kw)
+
+    def extra_repr(self):
+        return (f"{self._in_channels}, {self._out_channels}, "
+                f"kernel_size={self._kernel_size}, subm={self._subm}")
+
+
+class Conv3D(_SparseConv):
+    _nd, _subm = 3, False
+
+
+class SubmConv3D(_SparseConv):
+    _nd, _subm = 3, True
+
+
+class Conv2D(_SparseConv):
+    _nd, _subm = 2, False
+
+
+class SubmConv2D(_SparseConv):
+    _nd, _subm = 2, True
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NDHWC", name=None):
+        super().__init__()
+        if return_mask:
+            raise NotImplementedError("sparse MaxPool3D: return_mask")
+        self._kernel_size = kernel_size
+        self._stride = stride
+        self._padding = padding
+        self._ceil_mode = ceil_mode
+
+    def forward(self, x):
+        return F.max_pool3d(x, self._kernel_size, self._stride,
+                            self._padding, self._ceil_mode)
+
+
+class BatchNorm(Layer):
+    """Sparse BatchNorm (reference sparse/nn/layer/norm.py BatchNorm):
+    normalizes VALUES per channel over the active sites — exactly dense
+    BatchNorm1D over the [nnz, C] value matrix."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from ...nn import BatchNorm1D
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon, weight_attr=weight_attr,
+                               bias_attr=bias_attr,
+                               use_global_stats=use_global_stats)
+
+    def forward(self, x):
+        from .. import sparse_coo_tensor
+        idx = np.asarray(x.indices().numpy())
+        out_vals = self._bn(x.values())
+        return sparse_coo_tensor(idx, out_vals, tuple(x.shape),
+                                 stop_gradient=out_vals.stop_gradient)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica sparse BatchNorm. Under pjit/GSPMD the value matrix is
+    globally visible to the compiler, so the dense batch statistics ARE the
+    synchronized statistics — no explicit collective needed (reference needs
+    NCCL all_reduce; SURVEY §7 maps this role to GSPMD)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        if isinstance(layer, BatchNorm) and not isinstance(layer,
+                                                           SyncBatchNorm):
+            new = SyncBatchNorm(layer._bn._num_features)
+            new._bn = layer._bn
+            return new
+        for name, sub in getattr(layer, "_sub_layers", {}).items():
+            setattr(layer, name, cls.convert_sync_batchnorm(sub))
+        return layer
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return F.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self._slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self._axis)

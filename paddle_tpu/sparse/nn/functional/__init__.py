@@ -1,0 +1,344 @@
+"""paddle.sparse.nn.functional — sparse conv/pool/activation/attention.
+
+Reference: python/paddle/sparse/nn/functional/{conv.py,pooling.py,
+activation.py,attention.py} over phi/kernels/sparse/ (gather-GEMM-scatter
+rulebook convolution, ~35k LoC CUDA).
+
+TPU-native formulation: the RULEBOOK (which input site feeds which output
+site through which kernel offset) is built on host with vectorized numpy —
+it is pure integer structure, data-independent of the values, and eager
+construction keeps XLA shapes static. The VALUE computation (gather ->
+per-offset GEMM -> scatter-add) runs on device through the op dispatch
+chokepoint, so it lands on the autograd tape and grads flow to weights and
+input values. Matmuls are [pairs, Cin] @ [Cin, Cout] — dense MXU work.
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+from ....core.dispatch import apply_op, unwrap
+
+__all__ = [
+    "conv2d", "conv3d", "subm_conv2d", "subm_conv2d_igemm", "subm_conv3d",
+    "subm_conv3d_igemm", "max_pool3d", "relu", "relu6", "leaky_relu",
+    "softmax", "attention",
+]
+
+
+def _tuple(v, nd):
+    if isinstance(v, (list, tuple)):
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(nd))
+        assert len(v) == nd, f"expected {nd} values, got {v}"
+        return tuple(int(x) for x in v)
+    return (int(v),) * nd
+
+
+def _encode(idx, dims):
+    """[n, 1+nd] (batch, spatial...) -> flat int64 keys."""
+    key = idx[:, 0].astype(np.int64)
+    for a, d in enumerate(dims):
+        key = key * int(d) + idx[:, a + 1].astype(np.int64)
+    return key
+
+
+def _rulebook(in_idx, spatial, kernel, stride, padding, dilation, subm):
+    """Host-side rulebook construction.
+
+    in_idx: np [nnz, 1+nd]; returns (out_idx [nnz_out, 1+nd],
+    pairs: list over kernel offsets of (in_sel, out_sel) int32 arrays,
+    out_spatial).
+    """
+    nd = len(spatial)
+    offsets = list(itertools.product(*(range(k) for k in kernel)))
+    if subm:
+        out_spatial = tuple(spatial)
+        out_idx = in_idx
+        keys = _encode(in_idx, out_spatial)
+        order = np.argsort(keys)
+        skeys = keys[order]
+        center = tuple((k - 1) // 2 for k in kernel)
+        pairs = []
+        for off in offsets:
+            # output site o takes input site o + (off - center) * dilation
+            shift = np.array([(off[a] - center[a]) * dilation[a]
+                              for a in range(nd)], np.int64)
+            cand = in_idx[:, 1:] + shift       # contributor coords per OUT site
+            ok = np.all((cand >= 0) & (cand < np.array(spatial)), axis=1)
+            cidx = np.concatenate([in_idx[:, :1], cand], axis=1)
+            ckeys = _encode(cidx, out_spatial)
+            pos = np.searchsorted(skeys, ckeys)
+            pos = np.clip(pos, 0, len(skeys) - 1)
+            hit = ok & (skeys[pos] == ckeys)
+            in_sel = order[pos[hit]].astype(np.int32)   # contributor row
+            out_sel = np.nonzero(hit)[0].astype(np.int32)
+            pairs.append((in_sel, out_sel))
+        return out_idx, pairs, out_spatial
+    out_spatial = tuple(
+        (spatial[a] + 2 * padding[a] - dilation[a] * (kernel[a] - 1) - 1)
+        // stride[a] + 1 for a in range(nd))
+    cand_idx, cand_off = [], []
+    for ki, off in enumerate(offsets):
+        # in = out*stride - pad + off*dil  =>  out = (in + pad - off*dil)/stride
+        num = in_idx[:, 1:] + np.array(
+            [padding[a] - off[a] * dilation[a] for a in range(nd)], np.int64)
+        ok = np.all(num % np.array(stride) == 0, axis=1)
+        out = num // np.array(stride)
+        ok &= np.all((out >= 0) & (out < np.array(out_spatial)), axis=1)
+        rows = np.nonzero(ok)[0].astype(np.int32)
+        cand_idx.append((rows, np.concatenate(
+            [in_idx[rows, :1], out[rows]], axis=1)))
+    all_keys = np.concatenate(
+        [_encode(c, out_spatial) for _, c in cand_idx]) \
+        if cand_idx else np.zeros((0,), np.int64)
+    ukeys = np.unique(all_keys)
+    nnz_out = len(ukeys)
+    out_idx = np.zeros((nnz_out, nd + 1), np.int64)
+    rem = ukeys.copy()
+    for a in range(nd - 1, -1, -1):
+        out_idx[:, a + 1] = rem % out_spatial[a]
+        rem //= out_spatial[a]
+    out_idx[:, 0] = rem
+    pairs = []
+    for rows, cidx in cand_idx:
+        ckeys = _encode(cidx, out_spatial)
+        out_sel = np.searchsorted(ukeys, ckeys).astype(np.int32)
+        pairs.append((rows, out_sel))
+    return out_idx, pairs, out_spatial
+
+
+def _sparse_conv(x, weight, bias, stride, padding, dilation, groups,
+                 subm, nd, op_name):
+    from ... import SparseCooTensor, sparse_coo_tensor
+    if groups != 1:
+        raise ValueError("sparse conv supports groups=1 (reference parity)")
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError(f"{op_name} expects a SparseCooTensor input")
+    w = weight if isinstance(weight, Tensor) else Tensor(jnp.asarray(weight))
+    wshape = tuple(w.shape)                 # [*kernel, Cin, Cout]
+    kernel = tuple(int(k) for k in wshape[:nd])
+    cin, cout = int(wshape[nd]), int(wshape[nd + 1])
+    stride = _tuple(stride, nd)
+    padding = _tuple(padding, nd)
+    dilation = _tuple(dilation, nd)
+    shape = x.shape                         # [N, *spatial, C]
+    spatial = tuple(int(s) for s in shape[1:1 + nd])
+    if int(shape[-1]) != cin:
+        raise ValueError(f"in_channels mismatch: x has {shape[-1]}, "
+                         f"weight expects {cin}")
+    in_idx = np.asarray(x.indices().numpy()).T      # [nnz, 1+nd]
+    out_idx, pairs, out_spatial = _rulebook(
+        in_idx, spatial, kernel, stride, padding, dilation, subm)
+    nnz_out = len(out_idx)
+    K = len(pairs)
+    vals_t = x.values()
+    dev_pairs = [(jnp.asarray(i), jnp.asarray(o)) for i, o in pairs]
+
+    def f(vals, wk, *maybe_bias):
+        w3 = wk.reshape(K, cin, cout)
+        out = jnp.zeros((nnz_out, cout), vals.dtype)
+        for k, (in_sel, out_sel) in enumerate(dev_pairs):
+            if in_sel.shape[0] == 0:
+                continue
+            out = out.at[out_sel].add(
+                vals[in_sel] @ w3[k].astype(vals.dtype))
+        if maybe_bias:
+            out = out + maybe_bias[0].astype(vals.dtype)
+        return out
+
+    args = (vals_t, w) + ((bias,) if bias is not None else ())
+    out_vals = apply_op(op_name, f, *args)
+    out_shape = (int(shape[0]),) + out_spatial + (cout,)
+    return sparse_coo_tensor(out_idx.T, out_vals, out_shape,
+                             stop_gradient=out_vals.stop_gradient)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    """Sparse 3D conv (reference sparse/nn/functional/conv.py conv3d);
+    weight [kd, kh, kw, Cin, Cout], x [N, D, H, W, C] COO."""
+    assert data_format == "NDHWC", "sparse conv3d supports NDHWC"
+    return _sparse_conv(x, weight, bias, stride, padding, dilation, groups,
+                        False, 3, "sparse_conv3d")
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """Submanifold conv: output sites == input sites (no dilation of the
+    active set), the standard trick that keeps sparsity through deep nets."""
+    assert data_format == "NDHWC", "subm_conv3d supports NDHWC"
+    return _sparse_conv(x, weight, bias, stride, padding, dilation, groups,
+                        True, 3, "sparse_subm_conv3d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC", name=None):
+    assert data_format == "NHWC", "sparse conv2d supports NHWC"
+    return _sparse_conv(x, weight, bias, stride, padding, dilation, groups,
+                        False, 2, "sparse_conv2d")
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    assert data_format == "NHWC", "subm_conv2d supports NHWC"
+    return _sparse_conv(x, weight, bias, stride, padding, dilation, groups,
+                        True, 2, "sparse_subm_conv2d")
+
+
+# igemm variants: same math; the reference's implicit-GEMM kernel choice is a
+# CUDA scheduling detail — on TPU both route to the rulebook GEMM.
+def subm_conv2d_igemm(*args, **kwargs):
+    return subm_conv2d(*args, **kwargs)
+
+
+def subm_conv3d_igemm(*args, **kwargs):
+    return subm_conv3d(*args, **kwargs)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    """Sparse max pool (reference sparse/nn/functional/pooling.py)."""
+    from ... import SparseCooTensor, sparse_coo_tensor
+    assert data_format == "NDHWC", "sparse max_pool3d supports NDHWC"
+    if ceil_mode:
+        raise NotImplementedError("sparse max_pool3d: ceil_mode not supported")
+    nd = 3
+    kernel = _tuple(kernel_size, nd)
+    stride = _tuple(stride if stride is not None else kernel_size, nd)
+    padding = _tuple(padding, nd)
+    shape = x.shape
+    spatial = tuple(int(s) for s in shape[1:1 + nd])
+    C = int(shape[-1])
+    in_idx = np.asarray(x.indices().numpy()).T
+    out_idx, pairs, out_spatial = _rulebook(
+        in_idx, spatial, kernel, stride, padding, (1, 1, 1), False)
+    nnz_out = len(out_idx)
+    dev_pairs = [(jnp.asarray(i), jnp.asarray(o)) for i, o in pairs]
+
+    def f(vals):
+        out = jnp.full((nnz_out, C), -jnp.inf, vals.dtype)
+        for in_sel, out_sel in dev_pairs:
+            if in_sel.shape[0] == 0:
+                continue
+            out = out.at[out_sel].max(vals[in_sel])
+        return out
+
+    out_vals = apply_op("sparse_max_pool3d", f, x.values())
+    out_shape = (int(shape[0]),) + out_spatial + (C,)
+    return sparse_coo_tensor(out_idx.T, out_vals, out_shape,
+                             stop_gradient=out_vals.stop_gradient)
+
+
+def _value_unary(op_name, fn):
+    def op(x, *args, **kwargs):
+        kwargs.pop("name", None)
+        from ... import SparseCooTensor, SparseCsrTensor, sparse_coo_tensor, \
+            sparse_csr_tensor
+        if isinstance(x, SparseCsrTensor):
+            mat = x._mat
+            out_vals = apply_op(op_name,
+                                lambda v: fn(v, *args, **kwargs), x.values())
+            return sparse_csr_tensor(mat.indptr, mat.indices, out_vals,
+                                     tuple(mat.shape))
+        idx = np.asarray(x.indices().numpy())
+        out_vals = apply_op(op_name,
+                            lambda v: fn(v, *args, **kwargs), x.values())
+        return sparse_coo_tensor(idx, out_vals, tuple(x.shape),
+                                 stop_gradient=out_vals.stop_gradient)
+    op.__name__ = op_name
+    return op
+
+
+relu = _value_unary("sparse_relu", jax.nn.relu)
+relu6 = _value_unary("sparse_relu6", lambda v: jnp.clip(v, 0.0, 6.0))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _value_unary(
+        "sparse_leaky_relu",
+        lambda v: jax.nn.leaky_relu(v, negative_slope))(x)
+
+
+def softmax(x, axis=-1, name=None):
+    """Per-row softmax over stored values (reference sparse softmax kernel:
+    explicit zeros participate, absent entries don't). Segment ops over the
+    CSR value array — never densifies."""
+    from ... import SparseCooTensor, SparseCsrTensor, sparse_csr_tensor
+    if axis != -1:
+        raise ValueError("sparse softmax supports axis=-1 only (CSR rows)")
+    was_coo = isinstance(x, SparseCooTensor)
+    csr = x.to_sparse_csr() if was_coo else x
+    mat = csr._mat
+    if len(mat.shape) != 2:
+        raise ValueError("sparse softmax expects a 2D tensor")
+    nrows = mat.shape[0]
+    indptr, cols = mat.indptr, mat.indices
+    nse = mat.nse
+
+    def f(vals):
+        row = jnp.searchsorted(indptr, jnp.arange(nse), side="right") - 1
+        rmax = jax.ops.segment_max(vals, row, num_segments=nrows)
+        ex = jnp.exp(vals - rmax[row])
+        denom = jax.ops.segment_sum(ex, row, num_segments=nrows)
+        return ex / denom[row]
+
+    out_vals = apply_op("sparse_softmax", f, csr.values())
+    res = sparse_csr_tensor(indptr, cols, out_vals, tuple(mat.shape))
+    return res.to_sparse_coo() if was_coo else res
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-mask attention (reference sparse/nn/functional/attention.py:
+    fused_attention over a CSR mask [B*H, S, S]): scores computed ONLY at the
+    mask's nnz coordinates, per-row segment softmax, weighted gather-sum of V.
+
+    query/key/value: [B, H, S, D] dense; sparse_mask: SparseCsrTensor with
+    shape [B*H, S, S]. key_padding_mask/attn_mask: optional dense additive
+    masks ([B, S] and [S, S])."""
+    from ... import SparseCsrTensor, SparseCooTensor, _coo
+    from jax.experimental import sparse as jsparse
+    if not isinstance(sparse_mask, (SparseCsrTensor, SparseCooTensor)):
+        raise TypeError("sparse_mask must be a sparse tensor")
+    bco = _coo(sparse_mask)
+    BH, S, S2 = (int(d) for d in bco.shape)
+    if bco.n_batch:
+        bco = jsparse.bcoo_update_layout(bco, n_batch=0,
+                                         on_inefficient=None)
+    midx = np.asarray(bco.indices)                   # [nnz, 3] (bh, i, j)
+    rows_d = jnp.asarray(midx[:, 0].astype(np.int64) * S + midx[:, 1])
+    cols_d = jnp.asarray(midx[:, 2].astype(np.int64))
+    kpm = unwrap(key_padding_mask) if key_padding_mask is not None else None
+    am = unwrap(attn_mask) if attn_mask is not None else None
+
+    def f(q, k, v):
+        B, H, Sq, D = q.shape
+        qf = q.reshape(B * H * Sq, D)
+        kf = k.reshape(B * H, Sq, D)
+        vf = v.reshape(B * H, Sq, D)
+        bh = rows_d // Sq
+        qi = qf[rows_d]                              # [nnz, D]
+        kj = kf[bh, cols_d]                          # [nnz, D]
+        s = jnp.sum(qi.astype(jnp.float32) * kj.astype(jnp.float32),
+                    axis=-1) / jnp.sqrt(jnp.float32(D))
+        if kpm is not None:
+            b = bh // H
+            s = s + kpm[b, cols_d].astype(jnp.float32)
+        if am is not None:
+            i = rows_d % Sq
+            s = s + am[i, cols_d].astype(jnp.float32)
+        nrows = B * H * Sq
+        rmax = jax.ops.segment_max(s, rows_d, num_segments=nrows)
+        ex = jnp.exp(s - rmax[rows_d])
+        denom = jax.ops.segment_sum(ex, rows_d, num_segments=nrows)
+        p = (ex / jnp.maximum(denom[rows_d], 1e-30)).astype(v.dtype)
+        contrib = p[:, None] * vf[bh, cols_d]
+        out = jax.ops.segment_sum(contrib, rows_d, num_segments=nrows)
+        return out.reshape(B, H, Sq, D).astype(v.dtype)
+
+    return apply_op("sparse_attention", f, query, key, value)

@@ -124,3 +124,34 @@ def test_supported_predicate():
     assert not supported((1, 256, 8, 100))
     assert not supported((1, 256, 8, 64), (1, 100, 8, 64))
     assert not supported((1, 256, 8, 64), (1, 256, 3, 64))  # 8 % 3 != 0
+
+
+def test_layout_direct_bshd_path_matches_reference():
+    """FLAGS_flash_layout_direct engages the [B,S,H,D] lane-sliced kernels;
+    numerics must match the default [B*H,S,D] path (fwd + grads)."""
+    import paddle_tpu as pt
+    rng = np.random.RandomState(7)
+    B, S, H, D = 2, 128, 4, 64
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32) * 0.3)
+    k = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32) * 0.3)
+    v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32) * 0.3)
+
+    def loss(qq, kk, vv):
+        return jnp.sum(flash_attention_bshd(qq, kk, vv, causal=True)
+                       .astype(jnp.float32) ** 2)
+
+    o_ref = flash_attention_bshd(q, k, v, causal=True)
+    g_ref = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    pt.set_flags({"FLAGS_flash_layout_direct": True})
+    try:
+        from paddle_tpu.ops.pallas.flash_attention import _bshd_config
+        assert _bshd_config(B, S, S, H, D, q.dtype) is not None
+        o_new = flash_attention_bshd(q, k, v, causal=True)
+        g_new = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    finally:
+        pt.set_flags({"FLAGS_flash_layout_direct": False})
+    np.testing.assert_allclose(np.asarray(o_new), np.asarray(o_ref),
+                               rtol=1e-4, atol=1e-4)
+    for a, b in zip(g_new, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-3)

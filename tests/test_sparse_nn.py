@@ -1,0 +1,214 @@
+"""sparse.nn tests (reference test analog: test/legacy_test/test_sparse_conv_op.py,
+test_sparse_pooling_op.py, test_sparse_norm_op.py — dense-equivalence + grads)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.sparse as sparse
+
+
+def _rand_sparse(rng, shape, density=0.2, channels=4):
+    """Random [N, *spatial, C] COO tensor with given site density."""
+    nd = len(shape) - 2
+    n = shape[0]
+    spatial = shape[1:1 + nd]
+    mask = rng.rand(n, *spatial) < density
+    idx = np.stack(np.nonzero(mask), axis=0)          # [1+nd, nnz]
+    vals = rng.randn(idx.shape[1], channels).astype(np.float32)
+    x = sparse.sparse_coo_tensor(idx, pt.to_tensor(vals), shape,
+                                 stop_gradient=False)
+    dense = np.zeros(shape, np.float32)
+    dense[tuple(idx)] = vals
+    return x, dense
+
+
+def _dense_conv(dense, w, stride, padding, nd):
+    """Reference dense conv via lax (NDHWC x [*k, Cin, Cout])."""
+    dn = jax.lax.conv_dimension_numbers(
+        dense.shape, w.shape,
+        ("NDHWC", "DHWIO", "NDHWC") if nd == 3 else ("NHWC", "HWIO", "NHWC"))
+    return np.asarray(jax.lax.conv_general_dilated(
+        dense, w, (stride,) * nd, [(padding, padding)] * nd,
+        dimension_numbers=dn))
+
+
+@pytest.mark.parametrize("nd", [2, 3])
+def test_conv_matches_dense(nd):
+    rng = np.random.RandomState(0)
+    shape = (2,) + (6,) * nd + (4,)
+    x, dense = _rand_sparse(rng, shape)
+    cout = 5
+    w = rng.randn(*((3,) * nd), 4, cout).astype(np.float32) * 0.3
+    f = sparse.nn.functional.conv3d if nd == 3 else sparse.nn.functional.conv2d
+    out = f(x, pt.to_tensor(w), stride=1, padding=1)
+    ref = _dense_conv(dense, w, 1, 1, nd)
+    got = np.asarray(out.to_dense().numpy())
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_subm_conv3d_matches_dense_at_sites():
+    rng = np.random.RandomState(1)
+    shape = (2, 5, 6, 7, 3)
+    x, dense = _rand_sparse(rng, shape, channels=3)
+    w = rng.randn(3, 3, 3, 3, 4).astype(np.float32) * 0.3
+    out = sparse.nn.functional.subm_conv3d(x, pt.to_tensor(w), padding=1)
+    # subm: output sites == input sites; values equal dense conv there
+    assert out.nnz() == x.nnz()
+    ref = _dense_conv(dense, w, 1, 1, 3)
+    idx = np.asarray(x.indices().numpy())
+    got = np.asarray(out.to_dense().numpy())
+    np.testing.assert_allclose(got[tuple(idx)], ref[tuple(idx)],
+                               rtol=1e-4, atol=1e-4)
+    # everything off the active set stays empty
+    mask = np.zeros(shape[:4], bool)
+    mask[tuple(idx)] = True
+    assert np.all(got[~mask] == 0)
+
+
+def test_sparse_conv_grads_flow_to_weight_and_values():
+    rng = np.random.RandomState(2)
+    shape = (1, 4, 4, 4, 2)
+    x, dense = _rand_sparse(rng, shape, density=0.3, channels=2)
+    conv = sparse.nn.SubmConv3D(2, 3, 3, padding=1)
+    out = conv(x)
+    loss = (out.values() ** 2).sum()
+    loss.backward()
+    g = conv.weight.grad
+    assert g is not None and float(np.abs(np.asarray(g._data)).sum()) > 0
+    gx = x.values().grad
+    assert gx is not None and gx.shape == list(x.values().shape)
+    # finite-difference check one weight element
+    w0 = np.asarray(conv.weight._data).copy()
+    eps = 1e-3
+    def loss_at(wval):
+        conv.weight.set_value(pt.to_tensor(wval))
+        return float((conv(x).values() ** 2).sum())
+    w1 = w0.copy(); w1[0, 0, 0, 0, 0] += eps
+    w2 = w0.copy(); w2[0, 0, 0, 0, 0] -= eps
+    fd = (loss_at(w1) - loss_at(w2)) / (2 * eps)
+    np.testing.assert_allclose(float(np.asarray(g._data)[0, 0, 0, 0, 0]), fd,
+                               rtol=2e-2, atol=1e-2)
+
+
+def test_max_pool3d_matches_dense():
+    rng = np.random.RandomState(3)
+    shape = (2, 4, 4, 4, 3)
+    x, dense = _rand_sparse(rng, shape, density=0.4, channels=3)
+    out = sparse.nn.functional.max_pool3d(x, kernel_size=2, stride=2)
+    got = np.asarray(out.to_dense().numpy())
+    # dense max pool over ONLY the active sites (empty sites don't contribute)
+    big = np.where(np.any(dense != 0, axis=-1, keepdims=True) |
+                   (dense != 0), dense, -np.inf)
+    N, D, H, W, C = shape
+    ref = big.reshape(N, D // 2, 2, H // 2, 2, W // 2, 2, C).max((2, 4, 6))
+    mask = np.isfinite(ref)
+    np.testing.assert_allclose(got[mask], ref[mask], rtol=1e-5)
+    assert np.all(got[~mask] == 0)
+
+
+def test_batchnorm_and_activations():
+    rng = np.random.RandomState(4)
+    shape = (2, 4, 4, 4, 6)
+    x, _ = _rand_sparse(rng, shape, channels=6)
+    bn = sparse.nn.BatchNorm(6)
+    out = bn(x)
+    v = np.asarray(out.values().numpy())
+    np.testing.assert_allclose(v.mean(0), 0, atol=1e-4)
+    np.testing.assert_allclose(v.std(0), 1, atol=1e-2)
+    r = sparse.nn.ReLU()(out)
+    assert np.all(np.asarray(r.values().numpy()) >= 0)
+    r6 = sparse.nn.ReLU6()(out)
+    assert np.all(np.asarray(r6.values().numpy()) <= 6)
+    lr = sparse.nn.LeakyReLU(0.1)(out)
+    neg = v < 0
+    np.testing.assert_allclose(np.asarray(lr.values().numpy())[neg],
+                               v[neg] * 0.1, rtol=1e-5)
+
+
+def test_sparse_net_trains():
+    """VERDICT r2 done-criterion: a small sparse conv net trains on CPU."""
+    rng = np.random.RandomState(5)
+    pt.seed(0)
+
+    class Net(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.c1 = sparse.nn.SubmConv3D(2, 8, 3, padding=1)
+            self.bn = sparse.nn.BatchNorm(8)
+            self.act = sparse.nn.ReLU()
+            self.c2 = sparse.nn.SubmConv3D(8, 4, 3, padding=1)
+            self.head = pt.nn.Linear(4, 1)
+
+        def forward(self, x):
+            h = self.act(self.bn(self.c1(x)))
+            h = self.c2(h)
+            pooled = h.values().mean(axis=0)     # global mean over sites
+            return self.head(pooled)
+
+    net = Net()
+    opt = pt.optimizer.Adam(learning_rate=0.01,
+                            parameters=net.parameters())
+    shape = (1, 4, 4, 4, 2)
+    x, _ = _rand_sparse(rng, shape, density=0.4, channels=2)
+    target = pt.to_tensor(np.array([0.7], np.float32))
+    losses = []
+    for _ in range(30):
+        y = net(x)
+        loss = ((y - target) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.1, losses[::10]
+
+
+def test_sparse_softmax_and_attention():
+    rng = np.random.RandomState(6)
+    # csr softmax rows sum to 1
+    dense = (rng.rand(4, 6) * (rng.rand(4, 6) < 0.5)).astype(np.float32)
+    idx = np.stack(np.nonzero(dense), 0)
+    coo = sparse.sparse_coo_tensor(idx, dense[tuple(idx)], dense.shape)
+    sm = sparse.nn.functional.softmax(coo.to_sparse_csr())
+    v = np.asarray(sm.values().numpy())
+    crows = np.asarray(sm.crows().numpy())
+    for r in range(4):
+        seg = v[crows[r]:crows[r + 1]]
+        if len(seg):
+            np.testing.assert_allclose(seg.sum(), 1.0, rtol=1e-5)
+    # sparse-mask attention == dense attention when the mask is causal-full
+    B, H, S, D = 1, 2, 4, 8
+    q = pt.to_tensor(rng.randn(B, H, S, D).astype(np.float32))
+    k = pt.to_tensor(rng.randn(B, H, S, D).astype(np.float32))
+    vv = pt.to_tensor(rng.randn(B, H, S, D).astype(np.float32))
+    tri = np.tril(np.ones((S, S), np.float32))
+    full = np.broadcast_to(tri, (B * H, S, S))
+    idx3 = np.stack(np.nonzero(full), 0)
+    mask = sparse.sparse_coo_tensor(idx3, full[tuple(idx3)],
+                                    full.shape).to_sparse_csr()
+    out = sparse.nn.functional.attention(q, k, vv, mask)
+    qa, ka, va = (np.asarray(t.numpy()) for t in (q, k, vv))
+    s = np.einsum("bhid,bhjd->bhij", qa, ka) / np.sqrt(D)
+    s = np.where(tri > 0, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhij,bhjd->bhid", p, va)
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_sparse_softmax_keeps_gradient():
+    """COO->CSR->COO conversions must not detach the tape: softmax between
+    sparse layers trains."""
+    rng = np.random.RandomState(8)
+    dense = (rng.rand(4, 6) * (rng.rand(4, 6) < 0.6)).astype(np.float32)
+    idx = np.stack(np.nonzero(dense), 0)
+    vals = pt.to_tensor(dense[tuple(idx)])
+    vals.stop_gradient = False
+    coo = sparse.sparse_coo_tensor(idx, vals, dense.shape,
+                                   stop_gradient=False)
+    out = sparse.nn.functional.softmax(coo)
+    (out.values() ** 2).sum().backward()
+    assert vals.grad is not None
+    assert float(np.abs(np.asarray(vals.grad._data)).sum()) > 0
