@@ -237,6 +237,9 @@ def main():
     for batch, seqlen in shapes:
         if (batch, seqlen) == shapes[-1]:
             try:      # last resort runs in-process (works even if fork fails)
+                if on_tpu and batch * seqlen <= 16 * 1024:
+                    cfg.loss_chunk_size = batch * seqlen
+                    cfg.loss_recompute = False
                 result = _train(paddle, nn, cfg, batch, seqlen, steps)
                 break
             except Exception as e:  # noqa: BLE001

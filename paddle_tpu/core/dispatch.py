@@ -32,6 +32,7 @@ class _State(threading.local):
         self.trace_ctx = None          # active program-capture context (jit/)
         self.amp_state = None          # active autocast state (amp/)
         self.static_record = False     # static.program_guard replay recording
+        self.op_recorder = None        # profiler host-op timing hook
 
 
 _state = _State()
@@ -80,6 +81,17 @@ def apply_op(name: str, fn: Callable, *inputs, out_treedef_hint=None):
     Returns Tensor or tuple-of-Tensors mirroring fn's output structure.
     Attrs must be closed over inside `fn`.
     """
+    if _state.op_recorder is not None:
+        import time as _time
+        t0 = _time.perf_counter()
+        try:
+            return _apply_op_inner(name, fn, *inputs)
+        finally:
+            _state.op_recorder.record(name, _time.perf_counter() - t0)
+    return _apply_op_inner(name, fn, *inputs)
+
+
+def _apply_op_inner(name, fn, *inputs):
     arrays = tuple(unwrap(a) for a in inputs)
     if _state.amp_state is not None:
         from ..amp import maybe_cast_inputs

@@ -316,8 +316,29 @@ def send(tensor, dst=0, group=None, sync_op=True):
     seq = _p2p_seq.get(k, 0)
     _p2p_seq[k] = seq + 1
     arr = np.asarray(unwrap(tensor))
+    _warn_large_p2p(arr.nbytes)
     store.set(f"p2p/{me}->{dst}/{seq}", pickle.dumps(arr))
     return tensor
+
+
+_P2P_WARN_BYTES = 16 * 1024 * 1024
+_p2p_warned = False
+
+
+def _warn_large_p2p(nbytes):
+    """send/recv are a CONTROL plane (pickle over the TCPStore) — fine for
+    small messages, ~1000x slower than ICI for activations. Warn once so a
+    user porting NCCL-style activation passing finds the compiled path
+    (shard_map + ppermute) instead of silent slowness."""
+    global _p2p_warned
+    if nbytes > _P2P_WARN_BYTES and not _p2p_warned:
+        _p2p_warned = True
+        import warnings
+        warnings.warn(
+            f"dist.send/recv moved a {nbytes/1e6:.0f} MB tensor over the "
+            "TCPStore control plane; for activation-sized transfers use the "
+            "compiled collectives (shard_map + ppermute / all_to_all) which "
+            "ride ICI", RuntimeWarning, stacklevel=3)
 
 
 def recv(tensor, src=0, group=None, sync_op=True):

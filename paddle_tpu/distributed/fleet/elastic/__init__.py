@@ -117,3 +117,133 @@ class ElasticManager:
 
     def mark_finished(self):
         self.store.set(f"{self.prefix}/finished/{self.rank}", 1)
+
+
+class PreemptionCheckpointer:
+    """Preemption-aware checkpoint-restart (SURVEY §7 "preemption-aware
+    checkpoint-restart (TPU maintenance events)"; reference capability:
+    fleet/elastic/manager.py fault-tolerance levels).
+
+    A TPU maintenance event / preemption delivers SIGTERM (to every worker on
+    the machine) with notice. The signal handler only sets a flag; at the
+    next step boundary the rank writes its checkpoint shard through
+    paddle_tpu.distributed.checkpoint and exits with EXIT_CODE — nonzero, so
+    `launch --max_restarts` respawns the group — and resume() continues from
+    the newest checkpoint COMPLETE across all ranks. Data-parallel training
+    synchronizes ranks every step (grad allreduce), so all ranks reach the
+    same boundary and the per-rank shards form a consistent step.
+
+    Layout: root/step_{k}/rank_{r}/ (per-rank orbax tree) + rank_{r}.done
+    markers; a step is complete when all world ranks' markers exist.
+    """
+
+    EXIT_CODE = 75        # EX_TEMPFAIL: restartable failure
+
+    def __init__(self, root, get_state, set_state, rank=None, world=None,
+                 signals=None):
+        import os
+        import signal as _signal
+        from ... import get_rank, get_world_size
+        self.root = os.path.abspath(root)
+        self.get_state = get_state
+        self.set_state = set_state
+        self.rank = get_rank() if rank is None else rank
+        self.world = get_world_size() if world is None else world
+        self.signals = signals if signals is not None else [_signal.SIGTERM]
+        self._flag = False
+
+    # -- signal plane ---------------------------------------------------------
+    def install(self):
+        import signal as _signal
+        for s in self.signals:
+            _signal.signal(s, self._on_signal)
+        return self
+
+    def _on_signal(self, signum, frame):
+        self._flag = True
+
+    @property
+    def preempted(self):
+        return self._flag
+
+    # -- step-boundary protocol -----------------------------------------------
+    def maybe_checkpoint(self, step):
+        """Call at the TOP of each training step with the step about to run.
+        Returns normally when training should continue; checkpoints and
+        exits the process when a preemption was delivered."""
+        import os
+        import sys
+        if not self._flag:
+            return
+        self._save(step)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        # os._exit, NOT sys.exit: the jax.distributed atexit shutdown is a
+        # cross-process barrier, and peers exit at their own boundaries — a
+        # preempting rank must not wait on it
+        os._exit(self.EXIT_CODE)
+
+    def _save(self, step):
+        """Per-rank host-state shard as npz + json meta. Deliberately NOT the
+        orbax path: orbax coordinates multihost commits globally, but each
+        rank here saves independently while peers may already be gone."""
+        import os
+        import json
+        import numpy as np
+        d = os.path.join(self.root, f"step_{step}")
+        os.makedirs(d, exist_ok=True)
+        state = self.get_state()
+        arrays = {k: np.asarray(v._data if hasattr(v, "_data") else v)
+                  for k, v in state.items()}
+        tmp = os.path.join(d, f"rank_{self.rank}.npz.tmp")
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, os.path.join(d, f"rank_{self.rank}.npz"))
+        with open(os.path.join(d, f"rank_{self.rank}.done"), "w") as f:
+            json.dump({"rank": self.rank, "step": step}, f)
+
+    # -- restart plane --------------------------------------------------------
+    def latest_complete_step(self):
+        import glob
+        import os
+        best = None
+        for d in glob.glob(os.path.join(self.root, "step_*")):
+            try:
+                k = int(os.path.basename(d).split("_")[1])
+            except ValueError:
+                continue
+            done = [os.path.exists(os.path.join(d, f"rank_{r}.done"))
+                    for r in range(self.world)]
+            if all(done) and (best is None or k > best):
+                best = k
+        return best
+
+    def resume(self):
+        """Load the newest complete checkpoint into the live state (in place
+        on the get_state() tensors, then set_state for anything else).
+        Returns the step to continue FROM, or None when no complete
+        checkpoint exists (fresh start)."""
+        import os
+        import numpy as np
+        import jax.numpy as jnp
+        k = self.latest_complete_step()
+        if k is None:
+            return None
+        state = self.get_state()
+        with np.load(os.path.join(self.root, f"step_{k}",
+                                  f"rank_{self.rank}.npz")) as z:
+            for key, dst in state.items():
+                if key not in z:
+                    raise KeyError(f"checkpoint missing key {key}")
+                arr = jnp.asarray(z[key])
+                if hasattr(dst, "_data"):
+                    dst._data = arr.astype(dst._data.dtype)
+                else:
+                    # non-tensor state (step counters, numpy buffers):
+                    # hand the restored value to set_state
+                    state[key] = np.asarray(z[key])
+        self.set_state(state)
+        return k
+
+
+__all__ += ["PreemptionCheckpointer"]

@@ -15,14 +15,37 @@ class HybridParallelOptimizer:
         self._inner_opt = optimizer
         self._hcg = hcg
         self._strategy = strategy
+        # DistributedStrategy.gradient_merge (reference
+        # meta_optimizers/gradient_merge_optimizer.py): accumulate k_steps
+        # micro-batches of grads, apply the update on the k-th step, divide
+        # by k when avg=True. clear_grad mid-merge is suppressed so the
+        # canonical `step(); clear_grad()` loop keeps accumulating.
+        gm = bool(strategy is not None and
+                  getattr(strategy, "gradient_merge", False))
+        cfg = getattr(strategy, "gradient_merge_configs", {}) if gm else {}
+        self._gm_steps = max(1, int(cfg.get("k_steps", 1))) if gm else 1
+        self._gm_avg = bool(cfg.get("avg", True))
+        self._gm_counter = 0
 
     def __getattr__(self, name):
         return getattr(self.__dict__["_inner_opt"], name)
 
     def step(self):
+        if self._gm_steps > 1:
+            self._gm_counter += 1
+            if self._gm_counter < self._gm_steps:
+                return                  # merge window open: accumulate only
+            self._gm_counter = 0
+            if self._gm_avg:
+                k = float(self._gm_steps)
+                for p in getattr(self._inner_opt, "_parameter_list", []):
+                    if p.grad is not None:
+                        p.grad.set_value(p.grad / k)
         self._inner_opt.step()
 
     def clear_grad(self, set_to_zero=False):
+        if self._gm_steps > 1 and self._gm_counter != 0:
+            return                      # mid-merge: keep accumulated grads
         self._inner_opt.clear_grad(set_to_zero)
 
     clear_gradients = clear_grad

@@ -64,15 +64,18 @@ def pipeline_forward(stage_fn, stacked_params, x_micro, *, mesh, axis_name="pp")
             return (buf_next, outs), None
 
         (buf, outs), _ = jax.lax.scan(tick, (buf, outs), jnp.arange(n_ticks))
-        # outs is only valid on the last stage; zero elsewhere + psum = broadcast
-        outs = jnp.where(idx == P_ - 1, outs, jnp.zeros_like(outs))
-        return jax.lax.psum(outs, axis_name)
+        # per-stage output shard; only the last stage's slice is meaningful.
+        # Returning it SHARDED (leading pp axis) instead of zero+psum avoids
+        # an O(M*B*hidden) all-reduce every forward (r2 weak #8): the [P-1]
+        # slice below moves just the last stage's copy, and only when a
+        # consumer actually needs it elsewhere.
+        return outs[None]
 
     pspec_params = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
     f = shard_map(body, mesh=mesh,
                   in_specs=(pspec_params, P()),
-                  out_specs=P(), check_vma=False)
-    return f(stacked_params, x_micro)
+                  out_specs=P(axis_name), check_vma=False)
+    return f(stacked_params, x_micro)[P_ - 1]
 
 
 def pipeline_call(stage_fn, stacked_params, x_micro, mesh, axis_name="pp"):

@@ -119,16 +119,23 @@ class Profiler:
         self._active = False
         self._step_times = []
         self._last_step_t = None
+        self._op_recorder = None
 
     def start(self):
         self._dir = self._export_dir or os.path.join("/tmp", "paddle_tpu_profile")
         if not self._timer_only:
             jax.profiler.start_trace(self._dir)
             self._active = True
+        from .statistic import HostOpRecorder
+        from ..core.dispatch import _state
+        self._op_recorder = HostOpRecorder()
+        _state.op_recorder = self._op_recorder
         self._last_step_t = time.perf_counter()
         return self
 
     def stop(self):
+        from ..core.dispatch import _state
+        _state.op_recorder = None
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
@@ -152,17 +159,33 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms", views=None):
+        """Reference-style sorted report (profiler_statistic.py): overview
+        (step-time breakdown), device HLO table (when the xplane parsed),
+        host operator table, user RecordEvent table."""
         import numpy as np
-        lines = ["--------- profiler summary (host events) ---------"]
-        for name, times in sorted(_host_events.items(),
-                                  key=lambda kv: -sum(kv[1])):
-            arr = np.asarray(times)
-            lines.append(f"{name:40s} calls={len(arr):6d} total={arr.sum()*1000:10.3f}ms "
-                         f"avg={arr.mean()*1000:8.3f}ms")
+        lines = []
         if self._step_times:
             arr = np.asarray(self._step_times)
-            lines.append(f"{'[step]':40s} calls={len(arr):6d} "
-                         f"total={arr.sum()*1000:10.3f}ms avg={arr.mean()*1000:8.3f}ms")
+            lines += ["-------- Overview (step-time breakdown) --------",
+                      f"steps={len(arr)} total={arr.sum()*1e3:.3f}ms "
+                      f"avg={arr.mean()*1e3:.3f}ms "
+                      f"min={arr.min()*1e3:.3f}ms max={arr.max()*1e3:.3f}ms"]
+        if op_detail and getattr(self, "_op_recorder", None) is not None \
+                and self._op_recorder.ops:
+            lines.append(self._op_recorder.table(sorted_by=sorted_by))
+        from .statistic import device_summary
+        dev = device_summary(self._dir) if not self._timer_only else None
+        if dev:
+            lines.append(dev)
+        if _host_events:
+            lines += ["", "-------- User events (RecordEvent) --------"]
+            for name, times in sorted(_host_events.items(),
+                                      key=lambda kv: -sum(kv[1])):
+                arr = np.asarray(times)
+                lines.append(
+                    f"{name:40s} calls={len(arr):6d} "
+                    f"total={arr.sum()*1000:10.3f}ms "
+                    f"avg={arr.mean()*1000:8.3f}ms")
         out = "\n".join(lines)
         print(out)
         return out

@@ -1,0 +1,106 @@
+"""Profiler statistics (reference: python/paddle/profiler/profiler_statistic.py
+— aggregated per-op/kernel time tables and the sorted summary report).
+
+Two data planes:
+- DEVICE: the XPlane protobuf jax.profiler wrote is parsed (via the xprof
+  tooling when installed) into per-HLO-op rows: self time, occurrences,
+  category, bound-by. This is the kernel table the reference builds from
+  CUPTI records.
+- HOST: the op-dispatch chokepoint (core/dispatch.py apply_op) records
+  per-op dispatch wall time while a Profiler is active — the eager "CPU"
+  column of the reference's operator table. XLA dispatch is asynchronous, so
+  host time is dispatch cost, not device latency (stated in the header).
+"""
+from __future__ import annotations
+
+import glob
+import os
+from collections import defaultdict
+
+
+def collect_device_ops(xplane_dir):
+    """Parse the xplane dump into rows:
+    (op_name, category, occurrences, total_self_us, avg_self_us, bound_by).
+    Returns [] when no dump or no parser is available."""
+    if not xplane_dir:
+        return []
+    files = sorted(glob.glob(os.path.join(
+        xplane_dir, "plugins", "profile", "*", "*.xplane.pb")))
+    if not files:
+        return []
+    try:
+        from xprof.convert import raw_to_tool_data as rtd
+        import json
+        data, _ = rtd.xspace_to_tool_data([files[-1]], "hlo_stats", {})
+        d = json.loads(data if isinstance(data, str) else data.decode())
+        cols = [c["id"] for c in d["cols"]]
+        ix = {k: cols.index(k) for k in
+              ("category", "hlo_op_name", "total_self_time", "avg_self_time",
+               "occurrences", "bound_by")}
+        rows = []
+        for r in d["rows"]:
+            c = r["c"]
+            rows.append((
+                str(c[ix["hlo_op_name"]]["v"]),
+                str(c[ix["category"]]["v"]),
+                float(c[ix["occurrences"]]["v"] or 0),
+                float(c[ix["total_self_time"]]["v"] or 0),
+                float(c[ix["avg_self_time"]]["v"] or 0),
+                str(c[ix["bound_by"]]["v"]),
+            ))
+        return rows
+    except Exception:       # parser optional; statistics degrade gracefully
+        return []
+
+
+def device_summary(xplane_dir, top=25):
+    rows = collect_device_ops(xplane_dir)
+    if not rows:
+        return None
+    total = sum(r[3] for r in rows) or 1.0
+    by_cat = defaultdict(float)
+    for r in rows:
+        by_cat[r[1]] += r[3]
+    lines = ["", "-------- Device (XLA HLO self-time) by category --------",
+             f"{'category':32s} {'total_ms':>12s} {'%':>7s}"]
+    for cat, t in sorted(by_cat.items(), key=lambda kv: -kv[1]):
+        lines.append(f"{cat:32s} {t/1e3:12.3f} {100*t/total:6.1f}%")
+    lines += ["", f"-------- Device top {top} HLO ops --------",
+              f"{'op':44s} {'calls':>7s} {'total_ms':>10s} {'avg_us':>9s} "
+              f"{'%':>6s} {'bound':>8s}"]
+    for name, cat, occ, tot, avg, bound in sorted(
+            rows, key=lambda r: -r[3])[:top]:
+        lines.append(f"{name[:44]:44s} {int(occ):7d} {tot/1e3:10.3f} "
+                     f"{avg:9.1f} {100*tot/total:5.1f}% {bound[:8]:>8s}")
+    return "\n".join(lines)
+
+
+class HostOpRecorder:
+    """Per-op dispatch timing, installed by Profiler via dispatch hooks."""
+
+    def __init__(self):
+        self.ops: dict[str, list] = defaultdict(lambda: [0, 0.0, 0.0, 1e30])
+
+    def record(self, name, dt):
+        e = self.ops[name]
+        e[0] += 1
+        e[1] += dt
+        e[2] = max(e[2], dt)
+        e[3] = min(e[3], dt)
+
+    def table(self, sorted_by=None, top=30):
+        from . import SortedKeys
+        key = {
+            SortedKeys.CPUTotal: lambda kv: -kv[1][1],
+            SortedKeys.CPUAvg: lambda kv: -(kv[1][1] / kv[1][0]),
+            SortedKeys.CPUMax: lambda kv: -kv[1][2],
+            SortedKeys.CPUMin: lambda kv: kv[1][3],
+        }.get(sorted_by, lambda kv: -kv[1][1])
+        lines = ["", "-------- Operator (host dispatch; async — dispatch "
+                     "cost, not device latency) --------",
+                 f"{'op':36s} {'calls':>7s} {'total_ms':>10s} {'avg_us':>9s} "
+                 f"{'max_us':>9s} {'min_us':>9s}"]
+        for name, (n, tot, mx, mn) in sorted(self.ops.items(), key=key)[:top]:
+            lines.append(f"{name[:36]:36s} {n:7d} {tot*1e3:10.3f} "
+                         f"{tot/n*1e6:9.1f} {mx*1e6:9.1f} {mn*1e6:9.1f}")
+        return "\n".join(lines)

@@ -76,10 +76,17 @@ class SparseCooTensor:
             # batched CSR (reference 3D CSR): leading dim becomes the batch
             mat = jsparse.bcoo_update_layout(mat, n_batch=1,
                                              on_inefficient=None)
-        # NOTE: layout conversion may reorder entries; thread the
-        # tape-connected values through only when the order is unchanged
-        # (2D from_bcoo preserves row-major COO order)
-        vt = self._vt if len(self._mat.shape) == 2 else None
+        # layout conversion may reorder entries: thread the tape-connected
+        # values through ONLY when the 2D COO indices are already row-major
+        # sorted (then from_bcoo preserves order; otherwise values() on the
+        # CSR would silently pair values with the wrong coordinates)
+        vt = None
+        if self._vt is not None and len(self._mat.shape) == 2:
+            idx = np.asarray(self._mat.indices)
+            keys = idx[:, 0].astype(np.int64) * int(self._mat.shape[1]) \
+                + idx[:, 1]
+            if len(keys) < 2 or bool((keys[1:] >= keys[:-1]).all()):
+                vt = self._vt
         return SparseCsrTensor(jsparse.BCSR.from_bcoo(mat),
                                self.stop_gradient, values_t=vt)
 

@@ -57,16 +57,20 @@ def _rulebook(in_idx, spatial, kernel, stride, padding, dilation, subm):
     nd = len(spatial)
     offsets = list(itertools.product(*(range(k) for k in kernel)))
     if subm:
+        if any(st != 1 for st in stride):
+            raise ValueError(
+                "submanifold conv requires stride=1 (output sites == input "
+                "sites); use the regular sparse conv for strided downsampling")
         out_spatial = tuple(spatial)
         out_idx = in_idx
         keys = _encode(in_idx, out_spatial)
         order = np.argsort(keys)
         skeys = keys[order]
-        center = tuple((k - 1) // 2 for k in kernel)
         pairs = []
         for off in offsets:
-            # output site o takes input site o + (off - center) * dilation
-            shift = np.array([(off[a] - center[a]) * dilation[a]
+            # output site o takes input site o - padding + off * dilation
+            # (centered kernels pass padding = (k-1)//2 * dilation)
+            shift = np.array([off[a] * dilation[a] - padding[a]
                               for a in range(nd)], np.int64)
             cand = in_idx[:, 1:] + shift       # contributor coords per OUT site
             ok = np.all((cand >= 0) & (cand < np.array(spatial)), axis=1)
@@ -244,7 +248,8 @@ def _value_unary(op_name, fn):
             out_vals = apply_op(op_name,
                                 lambda v: fn(v, *args, **kwargs), x.values())
             return sparse_csr_tensor(mat.indptr, mat.indices, out_vals,
-                                     tuple(mat.shape))
+                                     tuple(mat.shape),
+                                     stop_gradient=out_vals.stop_gradient)
         idx = np.asarray(x.indices().numpy())
         out_vals = apply_op(op_name,
                             lambda v: fn(v, *args, **kwargs), x.values())
@@ -288,7 +293,8 @@ def softmax(x, axis=-1, name=None):
         return ex / denom[row]
 
     out_vals = apply_op("sparse_softmax", f, csr.values())
-    res = sparse_csr_tensor(indptr, cols, out_vals, tuple(mat.shape))
+    res = sparse_csr_tensor(indptr, cols, out_vals, tuple(mat.shape),
+                            stop_gradient=out_vals.stop_gradient)
     return res.to_sparse_coo() if was_coo else res
 
 

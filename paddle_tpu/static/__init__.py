@@ -54,6 +54,47 @@ class Program:
     def clone(self, for_test=False):
         return self
 
+    def drop(self):
+        """Release this program's placeholders from the module registry so a
+        finished program's tape can be garbage collected (use release_tape on
+        the fetch targets to free the op graph eagerly)."""
+        for t in list(self._placeholders.values()):
+            _placeholder_regs.pop(id(t), None)
+        self._placeholders = weakref.WeakValueDictionary()
+
+
+def release_tape(*tensors):
+    """Eagerly free the replay op-graph reachable from `tensors` (r2 weak #7:
+    a long static program retains every op's inputs via _replay_node until
+    the last fetch target dies). After this, Executor.run on these targets
+    raises instead of replaying stale state."""
+    stack = []
+    for t in tensors:
+        for n in (t._replay_node[0] if t._replay_node else None,
+                  t._grad_node):
+            if n is not None:
+                stack.append(n)
+        t._replay_node = None
+        t._grad_node = None
+    seen = set()
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        for inp in node.inputs:
+            if inp is None:
+                continue
+            for n in (inp._replay_node[0] if inp._replay_node else None,
+                      inp._grad_node):
+                if n is not None:
+                    stack.append(n)
+            inp._replay_node = None
+            inp._grad_node = None
+        node.keep_arrays = False
+        node.release()
+        node.inputs = (None,) * len(node.inputs)
+
 
 _default_main = Program()
 _default_startup = Program()

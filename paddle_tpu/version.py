@@ -1,6 +1,6 @@
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 full_version = __version__
-major, minor, patch = 0, 1, 0
+major, minor, patch = 0, 3, 0
 
 
 def show():

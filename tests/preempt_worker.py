@@ -1,0 +1,82 @@
+"""Worker for test_preemption.py: deterministic 2-rank DP training with a
+PreemptionCheckpointer. Writes its PID (so the test can SIGTERM it) and a
+per-attempt loss log; on restart resumes from the newest complete checkpoint.
+"""
+import json
+import os
+import time
+
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.fleet.elastic import PreemptionCheckpointer
+
+WORK = os.environ["PREEMPT_DIR"]
+STEPS = int(os.environ.get("PREEMPT_STEPS", "24"))
+SLEEP = float(os.environ.get("PREEMPT_SLEEP", "0.1"))
+
+dist.init_parallel_env()
+rank = dist.get_rank()
+
+with open(os.path.join(WORK, f"pid_rank{rank}.txt"), "w") as f:
+    f.write(str(os.getpid()))
+
+paddle.seed(0)
+model = paddle.nn.Linear(8, 1)
+opt = paddle.optimizer.Adam(learning_rate=0.05,
+                            parameters=model.parameters())
+rng = np.random.RandomState(0)
+X = paddle.to_tensor(rng.rand(16, 8).astype(np.float32))
+Y = paddle.to_tensor(rng.rand(16, 1).astype(np.float32))
+
+# one warmup step materializes the lazy Adam accumulators so the state dict
+# is complete, then weights reset to the step-0 values (both runs do this,
+# so the loss sequence stays deterministic)
+loss = ((model(X) - Y) ** 2).mean()
+loss.backward()
+opt.step()
+opt.clear_grad()
+paddle.seed(0)
+model.set_state_dict(paddle.nn.Linear(8, 1).state_dict())
+
+
+def get_state():
+    st = {f"model.{k}": v for k, v in model.state_dict().items()}
+    for k, v in opt.state_dict().items():
+        if hasattr(v, "_data"):
+            st[f"opt.{k}"] = v
+    return st
+
+
+pc = PreemptionCheckpointer(
+    os.path.join(WORK, "ckpt"),
+    get_state=get_state,
+    set_state=lambda s: None,       # load_state_dict restores in place
+).install()
+
+start = pc.resume()
+begin = 0 if start is None else start
+log = open(os.path.join(WORK, f"loss_rank{rank}_pid{os.getpid()}.jsonl"), "w")
+
+for step in range(begin, STEPS):
+    pc.maybe_checkpoint(step)
+    loss = ((model(X) - Y) ** 2).mean()
+    loss.backward()
+    for p in model.parameters():            # DP grad sync
+        if p.grad is not None:
+            dist.all_reduce(p.grad)
+            p.grad.set_value(p.grad / dist.get_world_size())
+    opt.step()
+    opt.clear_grad()
+    log.write(json.dumps({"step": step, "loss": float(loss)}) + "\n")
+    log.flush()
+    time.sleep(SLEEP)
+
+log.close()
+print("PREEMPT_WORKER_DONE", flush=True)

@@ -282,3 +282,27 @@ class TestTimerHelper:
         assert "step" in msg
         with pytest.raises(RuntimeError):
             t.stop()          # not started
+
+
+def test_static_release_tape_frees_graph():
+    """r2 weak #7: a finished static program's op tape can be dropped."""
+    import gc
+    import paddle_tpu.static as st
+
+    main = st.Program()
+    with st.program_guard(main):
+        x = st.data("x", [4])
+        h = x * 2.0
+        loss = (h + 1.0).sum()
+    exe = st.Executor()
+    (out,) = exe.run(main, feed={"x": np.ones(4, np.float32)},
+                     fetch_list=[loss])
+    np.testing.assert_allclose(np.asarray(out), 12.0)
+    node = loss._replay_node[0]
+    st.release_tape(loss, h)
+    main.drop()
+    del h
+    gc.collect()
+    assert loss._replay_node is None
+    assert node.in_arrays is None and node.raw_fn is None
+    assert all(i is None for i in node.inputs)
