@@ -137,14 +137,81 @@ def _train(paddle, nn, cfg, batch, seqlen, steps, multi=4):
         float(np.asarray(loss._data, np.float32))
         return time.perf_counter() - t0
 
-    t_small = timed(max(1, steps // 5))
-    t_full = timed(steps)
-    dt = (t_full - t_small) / (steps - max(1, steps // 5)) / multi
-    if dt <= 0:  # latency-dominated; fall back to the full-loop average
-        dt = t_full / (steps * multi)
+    best = None
+    for _ in range(2):       # best-of-2: tunnel throughput varies run-to-run
+        t_small = timed(max(1, steps // 5))
+        t_full = timed(steps)
+        d = (t_full - t_small) / (steps - max(1, steps // 5)) / multi
+        if d <= 0:  # latency-dominated; fall back to the full-loop average
+            d = t_full / (steps * multi)
+        best = d if best is None else min(best, d)
+    dt = best
     loss = static_step(*data[0])
     final_loss = float(np.asarray(loss._data, np.float32))
     return batch * seqlen / dt, dt, final_loss, n_params
+
+
+def _weight_only_bench(jax, on_tpu):
+    """Pallas int8 weight-only matmul vs the XLA dequant path at a
+    Llama-shaped decode GEMM (M=8, 4096x4096). Each chain iteration streams
+    a DISTINCT weight copy — with one shared weight XLA hoists the dequant
+    out of the loop and the comparison measures nothing. Returns the
+    per-call times + speedup, or None."""
+    if not on_tpu:
+        return None
+    try:
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.quant_matmul import quant_matmul
+        rng = np.random.RandomState(0)
+        M, K, N, COPIES = 8, 4096, 4096, 24
+        x = jnp.asarray(rng.randn(M, K).astype(np.float32)).astype(jnp.bfloat16)
+        w = rng.randn(K, N).astype(np.float32) * 0.02
+        s = np.maximum(np.abs(w).max(0) / 127.0, 1e-9)
+        q1 = np.clip(np.round(w / s), -127, 127).astype(np.int8)
+        qws = jnp.asarray(np.stack([q1] * COPIES))       # [C, K, N] int8
+        sc = jnp.asarray(s.astype(np.float32))
+
+        def chain(x, qws, fn, n):
+            for i in range(n):
+                x = fn(x, qws[i % COPIES])[:, :K] * 1e-2
+            return x.astype(jnp.float32).sum()
+
+        def dequant(x, qw):
+            return (x @ qw.astype(x.dtype)) * sc.astype(x.dtype)
+
+        def kern(x, qw):
+            return quant_matmul(x, qw, sc)
+
+        def timed(fn, n_lo=4, n_hi=COPIES):
+            # qws rides as a jit ARGUMENT — as a closure constant the 400MB
+            # of weights lower into the module and the tunnel's
+            # remote-compile endpoint rejects the payload (HTTP 413)
+            lo = jax.jit(lambda x, q: chain(x, q, fn, n_lo))
+            hi = jax.jit(lambda x, q: chain(x, q, fn, n_hi))
+            float(np.asarray(lo(x, qws))), float(np.asarray(hi(x, qws)))
+            best = None
+            for _ in range(4):
+                t0 = time.perf_counter()
+                float(np.asarray(lo(x, qws)))
+                a = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                float(np.asarray(hi(x, qws)))
+                b = time.perf_counter() - t0
+                if b > a:
+                    best = min(best or 9e9, (b - a) / (n_hi - n_lo))
+            return best
+
+        t_deq = timed(dequant)
+        t_kern = timed(kern)
+        if not t_deq or not t_kern:
+            return None
+        return {"dequant_us": round(t_deq * 1e6, 1),
+                "kernel_us": round(t_kern * 1e6, 1),
+                "speedup": round(t_deq / t_kern, 2)}
+    except Exception as e:  # noqa: BLE001 — extras must not kill the bench
+        print(f"weight-only bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return None
 
 
 def _decode_bench(paddle, on_tpu):
@@ -275,6 +342,7 @@ def main():
     mfu = achieved / spec_peak
 
     decode_tps = _decode_bench(paddle, on_tpu)
+    wo_bench = _weight_only_bench(jax, on_tpu)
 
     print(json.dumps({
         "metric": "gpt2_124m_pretrain_tokens_per_sec_per_chip",
@@ -290,6 +358,7 @@ def main():
                   "mfu_vs_measured_peak":
                       round(achieved / meas_peak, 4) if meas_peak else None,
                   "decode_tokens_per_sec": decode_tps,
+                  "weight_only_int8": wo_bench,
                   "final_loss": final_loss},
     }))
 

@@ -166,8 +166,20 @@ class GradScaler:
             return
         inv = 1.0 / self._scale
         found_inf = False
+        from ..core.selected_rows import SelectedRows
         for p in optimizer._parameter_list:
             if p.grad is None:
+                continue
+            if isinstance(p.grad, SelectedRows):
+                # row-sparse grad: unscale the values in place, keep sparsity
+                sr = p.grad
+                v = sr.values.astype(jnp.float32)
+                if self._scale != 1.0:
+                    v = v * inv
+                if not bool(jnp.all(jnp.isfinite(v))):
+                    found_inf = True
+                p.grad = SelectedRows(sr.rows, v.astype(sr.values.dtype),
+                                      sr.height)
                 continue
             g = unwrap(p.grad).astype(jnp.float32)
             if self._scale != 1.0:

@@ -139,7 +139,14 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
             return
         k = id(t)
         if k in cots:
-            cots[k] = cots[k] + _same_device(cots[k], cot)
+            from ..core.selected_rows import SelectedRows
+            prev = cots[k]
+            if isinstance(prev, SelectedRows) or isinstance(cot, SelectedRows):
+                # row-sparse cotangent: SR+SR concatenates; mixed densifies
+                cots[k] = prev + cot if isinstance(prev, SelectedRows) \
+                    else cot + prev
+            else:
+                cots[k] = prev + _same_device(prev, cot)
         else:
             cots[k] = cot
             keepalive[k] = t
@@ -152,6 +159,28 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
         cot = cots.get(id(t))
         if cot is None:
             return None
+        from ..core.selected_rows import SelectedRows
+        if isinstance(cot, SelectedRows):
+            # leaf row-sparse grad: .grad IS the SelectedRows (reference
+            # embedding sparse grads). Hooks see the densified view and the
+            # cotangent continues DENSE (falls through to the generic path);
+            # without hooks, honor the _only filter like the dense path.
+            if t._hooks:
+                cot = cot.to_dense()
+                cots[id(t)] = cot
+            else:
+                if _only is not None and id(t) not in _only \
+                        and not t._retain_grad:
+                    return cot
+                if (t._grad_node is None and not t.stop_gradient) \
+                        or t._retain_grad:
+                    if t.grad is None:
+                        t.grad = cot
+                    elif isinstance(t.grad, SelectedRows):
+                        t.grad = t.grad + cot
+                    else:            # dense existing grad: densify-add
+                        t.grad = Tensor(cot + t.grad, stop_gradient=True)
+                return cot
         if t._hooks:
             g = cot if isinstance(cot, Tensor) else Tensor(cot,
                                                            stop_gradient=True)

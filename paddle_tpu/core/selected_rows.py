@@ -1,0 +1,72 @@
+"""SelectedRows — row-sparse gradient container (reference:
+paddle/phi/core/selected_rows.h; produced by embedding backward when
+`sparse=True` so a [V, D] table update touches only the looked-up rows).
+
+The autograd engine carries it as a cotangent: SelectedRows + SelectedRows
+concatenates (dedup is deferred to the consumer), mixing with a dense
+array densifies. Optimizers apply it via their sparse path (SGD scatters
+row updates; others densify — the reference restricts sparse grads to a
+subset of optimizers the same way).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["SelectedRows"]
+
+
+class SelectedRows:
+    def __init__(self, rows, values, height):
+        self.rows = jnp.asarray(rows, jnp.int32).reshape(-1)
+        self.values = jnp.asarray(values)          # [nnz, D]
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return [self.height, int(self.values.shape[-1])]
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def is_selected_rows(self):
+        return True
+
+    def merge(self):
+        """Coalesce duplicate rows (reference scatter::MergeAdd).
+        Eager-only (concrete rows), so numpy unique gives an exact-size
+        result — no padded entries that a consumer could misapply."""
+        rows_np = np.asarray(self.rows)
+        uniq, inv = np.unique(rows_np, return_inverse=True)
+        summed = jnp.zeros((len(uniq), self.values.shape[-1]),
+                           self.values.dtype).at[jnp.asarray(inv)].add(
+            self.values)
+        return SelectedRows(jnp.asarray(uniq.astype(np.int32)), summed,
+                            self.height)
+
+    def to_dense(self):
+        dense = jnp.zeros((self.height, self.values.shape[-1]),
+                          self.values.dtype)
+        return dense.at[self.rows].add(self.values)
+
+    def astype(self, dtype):
+        return SelectedRows(self.rows, self.values.astype(dtype), self.height)
+
+    def __add__(self, other):
+        if isinstance(other, SelectedRows):
+            return SelectedRows(jnp.concatenate([self.rows, other.rows]),
+                                jnp.concatenate([self.values, other.values]),
+                                self.height)
+        arr = other._data if hasattr(other, "_data") else jnp.asarray(other)
+        return arr.at[self.rows].add(self.values.astype(arr.dtype))
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def numpy(self):
+        return np.asarray(self.to_dense())
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, nnz={self.rows.shape[0]},"
+                f" dim={self.values.shape[-1]})")

@@ -28,6 +28,7 @@ from . import mesh_utils  # noqa: F401
 from .mesh_utils import create_mesh, create_hybrid_mesh  # noqa: F401
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import ps_sparse  # noqa: F401  (host-resident sparse embedding PS)
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):

@@ -1,0 +1,434 @@
+"""Host-resident sparse embedding parameter server (reference capability:
+paddle/fluid/distributed/ps/table/memory_sparse_table.cc +
+ssd_sparse_table.cc + brpc PS services, ~35k LoC — the "100B features"
+workload).
+
+TPU framing: dense training scales on XLA collectives; what stays
+PS-shaped is embedding tables too large for HBM (or even host RAM). Each
+server process owns a row-hash shard as a HASH table (ids are sparse
+feature hashes, not [0, rows) indices): hot rows live in a bounded
+in-memory pool (LRU), cold rows spill to a per-shard sqlite file (the SSD
+table analog), misses initialize on first touch. Optimizers (sgd/adagrad)
+run SERVER-side on push, like the reference accessors. Servers speak a
+length-prefixed pickle protocol on their own socket — independent of the
+trainer world, so a server can be killed and restarted from its
+checkpoint while trainers reconnect.
+
+Trainer integration: PsEmbedding pulls rows for the unique ids in the
+batch onto device and pushes row gradients from a backward hook.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import sqlite3
+import struct
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["SparseShard", "serve", "start_server_process", "SparsePsClient",
+           "PsEmbedding"]
+
+
+# =============================== server side ================================
+
+class SparseShard:
+    """One server's shard of one table: bounded LRU pool + sqlite spill."""
+
+    def __init__(self, name, dim, capacity_rows, data_dir, lr=0.1,
+                 optimizer="sgd", initializer="uniform", seed=0):
+        self.name = name
+        self.dim = int(dim)
+        self.capacity = int(capacity_rows)
+        self.lr = float(lr)
+        self.optimizer = optimizer
+        self.initializer = initializer
+        self._rng = np.random.RandomState(seed)
+        os.makedirs(data_dir, exist_ok=True)
+        self._db_path = os.path.join(data_dir, f"{name}.spill.sqlite")
+        self._db = sqlite3.connect(self._db_path, check_same_thread=False)
+        self._db.execute("CREATE TABLE IF NOT EXISTS rows ("
+                         "id INTEGER PRIMARY KEY, row BLOB, accum REAL)")
+        # resident pool: id -> pool slot; LRU tick per slot
+        self.pool = np.zeros((self.capacity, self.dim), np.float32)
+        self.accum = np.zeros((self.capacity,), np.float32)   # adagrad state
+        self.slot_of: dict[int, int] = {}
+        self.id_of = np.full((self.capacity,), -1, np.int64)
+        self.tick_of = np.zeros((self.capacity,), np.int64)
+        self._free = list(range(self.capacity - 1, -1, -1))
+        self._tick = 0
+        self.lock = threading.Lock()
+
+    # -- row lifecycle --------------------------------------------------------
+    def _init_row(self):
+        if self.initializer == "zeros":
+            return np.zeros((self.dim,), np.float32)
+        return (self._rng.rand(self.dim).astype(np.float32) - 0.5) * 0.02
+
+    def _evict_one(self):
+        slot = int(np.argmin(self.tick_of))
+        rid = int(self.id_of[slot])
+        if rid >= 0:
+            self._db.execute(
+                "INSERT OR REPLACE INTO rows VALUES (?, ?, ?)",
+                (rid, self.pool[slot].tobytes(), float(self.accum[slot])))
+            del self.slot_of[rid]
+        self.id_of[slot] = -1
+        return slot
+
+    def _resident(self, rid):
+        """Slot of row `rid`, faulting it in (spill or fresh init)."""
+        slot = self.slot_of.get(rid)
+        if slot is None:
+            slot = self._free.pop() if self._free else self._evict_one()
+            cur = self._db.execute(
+                "SELECT row, accum FROM rows WHERE id=?", (rid,)).fetchone()
+            if cur is not None:
+                self.pool[slot] = np.frombuffer(cur[0], np.float32)
+                self.accum[slot] = cur[1]
+                self._db.execute("DELETE FROM rows WHERE id=?", (rid,))
+            else:
+                self.pool[slot] = self._init_row()
+                self.accum[slot] = 0.0
+            self.slot_of[rid] = slot
+            self.id_of[slot] = rid
+        self._tick += 1
+        self.tick_of[slot] = self._tick
+        return slot
+
+    # -- serving --------------------------------------------------------------
+    def pull(self, ids):
+        ids = np.asarray(ids, np.int64)
+        out = np.empty((len(ids), self.dim), np.float32)
+        with self.lock:
+            for i, rid in enumerate(ids):
+                out[i] = self.pool[self._resident(int(rid))]
+        return out
+
+    def push(self, ids, grads):
+        """Sparse server-side update; duplicate ids accumulate."""
+        ids = np.asarray(ids, np.int64)
+        g = np.asarray(grads, np.float32)
+        with self.lock:
+            agg: dict[int, np.ndarray] = {}
+            for i, rid in enumerate(ids):
+                rid = int(rid)
+                agg[rid] = agg.get(rid, 0) + g[i]
+            for rid, gr in agg.items():
+                slot = self._resident(rid)
+                if self.optimizer == "adagrad":
+                    self.accum[slot] += float((gr * gr).mean())
+                    scale = self.lr / (np.sqrt(self.accum[slot]) + 1e-8)
+                    self.pool[slot] -= scale * gr
+                else:
+                    self.pool[slot] -= self.lr * gr
+
+    # -- persistence ----------------------------------------------------------
+    def save(self, path):
+        """Checkpoint = spill EVERYTHING to the sqlite + copy it to `path`
+        atomically (reference: table save to afs/local fs)."""
+        with self.lock:
+            for rid in list(self.slot_of):
+                slot = self.slot_of[rid]
+                self._db.execute(
+                    "INSERT OR REPLACE INTO rows VALUES (?, ?, ?)",
+                    (rid, self.pool[slot].tobytes(), float(self.accum[slot])))
+            self._db.commit()
+            tmp = path + ".tmp"
+            dst = sqlite3.connect(tmp)
+            with dst:
+                self._db.backup(dst)
+            dst.close()
+            os.replace(tmp, path)
+        return True
+
+    def load(self, path):
+        with self.lock:
+            src = sqlite3.connect(path)
+            self._db.execute("DELETE FROM rows")
+            self._db.commit()       # backup needs no open txn on the dest
+            src.backup(self._db)
+            src.close()
+            self.slot_of.clear()
+            self.id_of[:] = -1
+            self.tick_of[:] = 0
+            self._free = list(range(self.capacity - 1, -1, -1))
+        return True
+
+    def stats(self):
+        with self.lock:
+            spilled = self._db.execute("SELECT COUNT(*) FROM rows").fetchone()[0]
+            return {"resident": len(self.slot_of), "spilled": int(spilled),
+                    "capacity": self.capacity, "dim": self.dim}
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    (n,) = struct.unpack("!Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("!Q", len(payload)) + payload)
+
+
+def serve(port, data_dir, host="127.0.0.1", ready_file=None):
+    """Run a PS server (blocking): one process = one shard of every table."""
+    os.makedirs(data_dir, exist_ok=True)
+    shards: dict[str, SparseShard] = {}
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((host, port))
+    srv.listen(64)
+    stop = threading.Event()
+
+    def handle(conn):
+        try:
+            while not stop.is_set():
+                msg = _recv_msg(conn)
+                if msg is None:
+                    return
+                op = msg["op"]
+                try:
+                    if op == "create":
+                        name = msg["name"]
+                        if name not in shards:
+                            shards[name] = SparseShard(
+                                name, msg["dim"], msg["capacity"], data_dir,
+                                lr=msg.get("lr", 0.1),
+                                optimizer=msg.get("optimizer", "sgd"),
+                                initializer=msg.get("initializer", "uniform"),
+                                seed=msg.get("seed", 0))
+                        _send_msg(conn, {"ok": True})
+                    elif op == "pull":
+                        _send_msg(conn, {"ok": True, "rows":
+                                         shards[msg["name"]].pull(msg["ids"])})
+                    elif op == "push":
+                        shards[msg["name"]].push(msg["ids"], msg["grads"])
+                        _send_msg(conn, {"ok": True})
+                    elif op == "save":
+                        for name, sh in shards.items():
+                            sh.save(os.path.join(
+                                msg["path"], f"{name}.shard.sqlite"))
+                        _send_msg(conn, {"ok": True})
+                    elif op == "load":
+                        name = msg["name"]
+                        shards[name].load(os.path.join(
+                            msg["path"], f"{name}.shard.sqlite"))
+                        _send_msg(conn, {"ok": True})
+                    elif op == "stats":
+                        _send_msg(conn, {"ok": True, "stats": {
+                            n: s.stats() for n, s in shards.items()}})
+                    elif op == "shutdown":
+                        _send_msg(conn, {"ok": True})
+                        stop.set()
+                        return
+                    else:
+                        _send_msg(conn, {"ok": False,
+                                         "error": f"unknown op {op}"})
+                except Exception as e:   # noqa: BLE001 — report to client
+                    _send_msg(conn, {"ok": False, "error": repr(e)})
+        finally:
+            conn.close()
+
+    if ready_file:
+        with open(ready_file, "w") as f:
+            f.write(str(os.getpid()))
+    srv.settimeout(0.2)
+    while not stop.is_set():
+        try:
+            conn, _ = srv.accept()
+        except socket.timeout:
+            continue
+        threading.Thread(target=handle, args=(conn,), daemon=True).start()
+    srv.close()
+
+
+def start_server_process(port, data_dir, ready_timeout=30.0):
+    """Spawn a PS server as a child process; returns the Popen handle."""
+    import subprocess
+    import sys
+    ready = os.path.join(data_dir, f"ps_ready_{port}.txt")
+    if os.path.exists(ready):
+        os.remove(ready)
+    p = subprocess.Popen(
+        [sys.executable, "-c",
+         "import sys; sys.path.insert(0, %r); "
+         "from paddle_tpu.distributed.ps_sparse import serve; "
+         "serve(%d, %r, ready_file=%r)" % (
+             os.path.dirname(os.path.dirname(os.path.dirname(
+                 os.path.abspath(__file__)))), port, data_dir, ready)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    deadline = time.time() + ready_timeout
+    while time.time() < deadline:
+        if os.path.exists(ready):
+            return p
+        if p.poll() is not None:
+            raise RuntimeError(f"PS server on port {port} died at startup")
+        time.sleep(0.05)
+    raise TimeoutError(f"PS server on port {port} not ready")
+
+
+# =============================== client side ================================
+
+class SparsePsClient:
+    """Trainer handle to N shard servers; reconnects on failure so a killed
+    and restarted server resumes transparently."""
+
+    def __init__(self, endpoints, retry=30.0):
+        self.endpoints = [(h, int(p)) for h, p in
+                          (e.split(":") for e in endpoints)]
+        self._socks: list = [None] * len(self.endpoints)
+        self.retry = retry
+
+    def _sock(self, si):
+        if self._socks[si] is None:
+            deadline = time.time() + self.retry
+            while True:
+                try:
+                    s = socket.create_connection(self.endpoints[si],
+                                                 timeout=5)
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    s.settimeout(None)
+                    self._socks[si] = s
+                    break
+                except OSError:
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.1)
+        return self._socks[si]
+
+    def _call(self, si, msg):
+        deadline = time.time() + self.retry
+        while True:
+            try:
+                s = self._sock(si)
+                _send_msg(s, msg)
+                rep = _recv_msg(s)
+                if rep is None:
+                    raise ConnectionError("server closed")
+                if not rep.get("ok"):
+                    raise RuntimeError(rep.get("error"))
+                return rep
+            except (ConnectionError, OSError):
+                self._socks[si] = None       # reconnect (restarted server)
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.2)
+
+    # -- table API ------------------------------------------------------------
+    def create_table(self, name, dim, capacity_rows_per_server, lr=0.1,
+                     optimizer="sgd", initializer="uniform"):
+        for si in range(len(self.endpoints)):
+            self._call(si, {"op": "create", "name": name, "dim": dim,
+                            "capacity": capacity_rows_per_server, "lr": lr,
+                            "optimizer": optimizer,
+                            "initializer": initializer, "seed": si})
+
+    def _split(self, ids):
+        ids = np.asarray(ids, np.int64)
+        shard = ids % len(self.endpoints)
+        return [(si, np.nonzero(shard == si)[0], ids[shard == si])
+                for si in range(len(self.endpoints))]
+
+    def pull(self, name, ids):
+        ids = np.asarray(ids, np.int64)
+        out = None
+        for si, pos, sub in self._split(ids):
+            if not len(sub):
+                continue
+            rows = self._call(si, {"op": "pull", "name": name,
+                                   "ids": sub})["rows"]
+            if out is None:
+                out = np.empty((len(ids), rows.shape[1]), np.float32)
+            out[pos] = rows
+        return out
+
+    def push(self, name, ids, grads):
+        g = np.asarray(grads, np.float32)
+        for si, pos, sub in self._split(ids):
+            if len(sub):
+                self._call(si, {"op": "push", "name": name, "ids": sub,
+                                "grads": g[pos]})
+
+    def save(self, path):
+        os.makedirs(path, exist_ok=True)
+        for si in range(len(self.endpoints)):
+            d = os.path.join(path, f"server_{si}")
+            os.makedirs(d, exist_ok=True)
+            self._call(si, {"op": "save", "path": d})
+
+    def load(self, name, path):
+        for si in range(len(self.endpoints)):
+            self._call(si, {"op": "load", "name": name,
+                            "path": os.path.join(path, f"server_{si}")})
+
+    def stats(self):
+        return [self._call(si, {"op": "stats"})["stats"]
+                for si in range(len(self.endpoints))]
+
+    def shutdown(self, si=None):
+        for i in ([si] if si is not None else range(len(self.endpoints))):
+            try:
+                self._call(i, {"op": "shutdown"})
+            except Exception:
+                pass
+            self._socks[i] = None
+
+
+# ============================ device integration ============================
+
+class PsEmbedding:
+    """Embedding lookup against a PS table (reference: the PS-mode
+    paddle.static.nn.sparse_embedding).
+
+    forward: unique ids in the batch -> pull rows (host) -> device gather.
+    backward: a hook on the pulled-rows leaf tensor pushes per-row grads
+    back to the servers (server-side optimizer applies them), so the
+    embedding "trains" without the table ever living on device."""
+
+    def __init__(self, client, table, dim, lr=0.1, optimizer="sgd",
+                 capacity_rows_per_server=2 ** 20):
+        self.client = client
+        self.table = table
+        self.dim = dim
+        client.create_table(table, dim,
+                            capacity_rows_per_server=capacity_rows_per_server,
+                            lr=lr, optimizer=optimizer)
+
+    def __call__(self, ids):
+        from ..core.tensor import Tensor
+        from ..ops import manipulation as _m  # noqa: F401 (op registry)
+        import paddle_tpu as paddle
+        ids_np = np.asarray(ids.numpy() if hasattr(ids, "numpy") else ids,
+                            np.int64)
+        flat = ids_np.reshape(-1)
+        uniq, inv = np.unique(flat, return_inverse=True)
+        rows_np = self.client.pull(self.table, uniq)
+        rows = Tensor(np.asarray(rows_np), stop_gradient=False)
+        client, table = self.client, self.table
+
+        def _push(grad):
+            g = np.asarray(grad._data if hasattr(grad, "_data") else grad,
+                           np.float32)
+            client.push(table, uniq, g)
+            return grad
+
+        rows.register_hook(_push)
+        gathered = rows[paddle.to_tensor(inv.astype(np.int32))]
+        return gathered.reshape(list(ids_np.shape) + [self.dim])

@@ -1,0 +1,292 @@
+"""Serving runtime for (sharded) LLMs — the role the reference fills with
+the FleetExecutor actor/interceptor pipeline for multi-stage inference
+(paddle/fluid/distributed/fleet_executor/carrier.cc) plus the paged
+KV-cache fused ops (phi/kernels/fusion block_multi_head_attention).
+
+TPU-native design:
+- ONE jitted token step serves the whole engine. Requests are admitted into
+  fixed slots; a slot still consuming its prompt feeds prompt tokens, a slot
+  past its prompt feeds its last generated token — token-level continuous
+  batching (Orca-style) with no separate prefill program or shape buckets.
+- KV lives in PAGES [L, n_pages, page, KVH, D] with host-managed per-slot
+  page tables; decode attention runs against the paged cache
+  (ops/pallas/paged_attention kernel on a single TPU chip; the partitionable
+  jnp formulation under GSPMD meshes, where XLA shards the gathers).
+- Weights are extracted from the model once, stacked [L, ...] and placed
+  with NamedShardings: layers sharded over the pp axis (stage-partitioned
+  memory), head/ffn dims over the mp axis. The step function is pure jax
+  over those arrays; GSPMD inserts the collectives.
+"""
+from __future__ import annotations
+
+import math
+from collections import deque
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["LLMEngine", "Request"]
+
+
+class Request:
+    def __init__(self, rid, prompt_ids, max_new_tokens, eos_token_id=None):
+        self.rid = rid
+        self.prompt = list(int(t) for t in np.asarray(prompt_ids).reshape(-1))
+        self.max_new = int(max_new_tokens)
+        self.eos = eos_token_id
+        self.out: list[int] = []
+        self.pos = 0                 # tokens already fed to the engine
+        self.slot = None
+        self.done = False
+
+
+def _rope(x, pos, theta):
+    """neox-style RoPE at integer positions pos [B] (x [B, Hn, D])."""
+    D = x.shape[-1]
+    inv = 1.0 / (theta ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+    freqs = pos.astype(jnp.float32)[:, None] * inv[None, :]      # [B, D/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)               # [B, D]
+    s, c = jnp.sin(emb)[:, None, :], jnp.cos(emb)[:, None, :]
+    xf = x.astype(jnp.float32)
+    half = D // 2
+    rot = jnp.concatenate([-xf[..., half:], xf[..., :half]], axis=-1)
+    return (xf * c + rot * s).astype(x.dtype)
+
+
+def _rms(x, w, eps):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps) * w.astype(jnp.float32)).astype(
+        x.dtype)
+
+
+class LLMEngine:
+    """Continuous-batching paged-KV engine over a LlamaForCausalLM."""
+
+    def __init__(self, model, mesh=None, mp_axis="mp", pp_axis="pp",
+                 max_batch=4, max_len=256, page_size=16, use_kernel=None):
+        cfg = model.config
+        self.cfg = cfg
+        self.max_batch = max_batch
+        self.max_len = max_len
+        self.page = page_size
+        self.pages_per_slot = math.ceil(max_len / page_size)
+        # +1: a trash page absorbing the (masked-out) writes of inactive slots
+        self.n_pages = max_batch * self.pages_per_slot + 1
+        self.trash_page = self.n_pages - 1
+        self.mesh = mesh
+        L = cfg.num_hidden_layers
+        H = cfg.hidden_size
+        nh, kvh = cfg.num_attention_heads, cfg.num_key_value_heads
+        D = H // nh
+        self.nh, self.kvh, self.D = nh, kvh, D
+        if use_kernel is None:
+            use_kernel = (mesh is None and
+                          jax.devices()[0].platform in ("tpu", "axon"))
+        self.use_kernel = use_kernel
+
+        def wb(lin):        # Linear stores weight [in, out]
+            return np.asarray(lin.weight._data)
+
+        lay = model.llama.layers
+        W = {
+            "embed": np.asarray(model.llama.embed_tokens.weight._data),
+            "norm": np.asarray(model.llama.norm.weight._data),
+            "wq": np.stack([wb(l.self_attn.q_proj) for l in lay]),
+            "wk": np.stack([wb(l.self_attn.k_proj) for l in lay]),
+            "wv": np.stack([wb(l.self_attn.v_proj) for l in lay]),
+            "wo": np.stack([wb(l.self_attn.o_proj) for l in lay]),
+            "ln1": np.stack([np.asarray(l.input_layernorm.weight._data)
+                             for l in lay]),
+            "ln2": np.stack([np.asarray(
+                l.post_attention_layernorm.weight._data) for l in lay]),
+            "wg": np.stack([wb(l.mlp.gate_proj) for l in lay]),
+            "wu": np.stack([wb(l.mlp.up_proj) for l in lay]),
+            "wd": np.stack([wb(l.mlp.down_proj) for l in lay]),
+        }
+        W["head"] = (np.asarray(model.lm_head.weight._data)
+                     if model.lm_head is not None else W["embed"].T)
+        dtype = W["wq"].dtype
+        if mesh is not None:
+            pp = pp_axis if pp_axis in mesh.axis_names else None
+            mp = mp_axis if mp_axis in mesh.axis_names else None
+
+            def put(name, arr, spec):
+                return jax.device_put(jnp.asarray(arr),
+                                      NamedSharding(mesh, spec))
+            specs = {
+                "embed": P(), "norm": P(), "head": P(None, mp),
+                "wq": P(pp, None, mp), "wk": P(pp, None, mp),
+                "wv": P(pp, None, mp), "wo": P(pp, mp, None),
+                "ln1": P(pp, None), "ln2": P(pp, None),
+                "wg": P(pp, None, mp), "wu": P(pp, None, mp),
+                "wd": P(pp, mp, None),
+            }
+            self.W = {k: put(k, v, specs[k]) for k, v in W.items()}
+            cache_spec = NamedSharding(mesh, P(pp))
+        else:
+            self.W = {k: jnp.asarray(v) for k, v in W.items()}
+            cache_spec = None
+        kp = jnp.zeros((L, self.n_pages, page_size, kvh, D), dtype)
+        vp = jnp.zeros_like(kp)
+        if cache_spec is not None:
+            kp = jax.device_put(kp, cache_spec)
+            vp = jax.device_put(vp, cache_spec)
+        self.kp, self.vp = kp, vp
+
+        # host scheduler state (trash page is never allocated)
+        self._free_pages = deque(range(self.n_pages - 1))
+        self._slots: list = [None] * max_batch
+        self._slot_tables = np.zeros((max_batch, self.pages_per_slot),
+                                     np.int32)
+        self._lens = np.zeros((max_batch,), np.int32)
+        self._waiting: deque = deque()
+        self._finished: dict = {}
+        self._next_rid = 0
+        self._step = self._build_step()
+
+    # ------------------------------------------------------------------ step
+    def _build_step(self):
+        cfg = self.cfg
+        nh, kvh, D = self.nh, self.kvh, self.D
+        page = self.page
+        eps = cfg.rms_norm_eps
+        theta = cfg.rope_theta
+        use_kernel = self.use_kernel
+        trash = self.trash_page
+
+        def step(W, kp, vp, tokens, lens, tables, active):
+            # tokens [B] int32; lens [B] tokens already cached; tables
+            # [B, S] page ids; active [B] 0/1
+            x = W["embed"][tokens]                       # [B, H]
+            pos = lens.astype(jnp.int32)
+            page_idx = jnp.take_along_axis(
+                tables, (pos // page)[:, None], axis=1)[:, 0]
+            # inactive slots write into the trash page, never a live one
+            page_idx = jnp.where(active > 0, page_idx, trash)
+            within = pos % page
+            ctx = jnp.where(active > 0, pos + 1, 1).astype(jnp.int32)
+
+            def layer(carry, wl):
+                x, = carry
+                h = _rms(x, wl["ln1"], eps)
+                q = (h @ wl["wq"]).reshape(-1, nh, D)
+                k = (h @ wl["wk"]).reshape(-1, kvh, D)
+                v = (h @ wl["wv"]).reshape(-1, kvh, D)
+                q = _rope(q, pos, theta)
+                k = _rope(k, pos, theta)
+                kpl = wl["kp"].at[page_idx, within].set(k)
+                vpl = wl["vp"].at[page_idx, within].set(v)
+                if use_kernel:
+                    from ..ops.pallas.paged_attention import paged_attention
+                    att = paged_attention(q, kpl, vpl, tables, ctx)
+                else:
+                    from ..ops.pallas.paged_attention import \
+                        paged_attention_ref
+                    att = paged_attention_ref(q, kpl, vpl, tables, ctx)
+                x = x + att.reshape(-1, nh * D) @ wl["wo"]
+                h = _rms(x, wl["ln2"], eps)
+                gate = h @ wl["wg"]
+                up = h @ wl["wu"]
+                x = x + (jax.nn.silu(gate.astype(jnp.float32)).astype(
+                    up.dtype) * up) @ wl["wd"]
+                return (x,), (kpl, vpl)
+
+            per_layer = {k: W[k] for k in
+                         ("wq", "wk", "wv", "wo", "ln1", "ln2",
+                          "wg", "wu", "wd")}
+            per_layer["kp"] = kp
+            per_layer["vp"] = vp
+            (x,), (kp2, vp2) = jax.lax.scan(layer, (x,), per_layer)
+            h = _rms(x, W["norm"], eps)
+            logits = h.astype(jnp.float32) @ W["head"].astype(jnp.float32)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return nxt, kp2, vp2
+
+        return jax.jit(step, donate_argnums=(1, 2))
+
+    # ------------------------------------------------------------- scheduling
+    def add_request(self, prompt_ids, max_new_tokens=16, eos_token_id=None):
+        n_prompt = int(np.asarray(prompt_ids).reshape(-1).shape[0])
+        if n_prompt >= self.max_len:
+            raise ValueError(
+                f"prompt length {n_prompt} >= engine max_len {self.max_len}; "
+                "raise max_len or truncate the prompt")
+        r = Request(self._next_rid, prompt_ids, max_new_tokens, eos_token_id)
+        self._next_rid += 1
+        self._waiting.append(r)
+        return r.rid
+
+    def _admit(self):
+        for slot in range(self.max_batch):
+            if self._slots[slot] is not None or not self._waiting:
+                continue
+            r = self._waiting[0]
+            need = math.ceil(min(len(r.prompt) + r.max_new,
+                                 self.max_len) / self.page)
+            if len(self._free_pages) < need:
+                break
+            self._waiting.popleft()
+            pages = [self._free_pages.popleft() for _ in range(need)]
+            self._slot_tables[slot, :need] = pages
+            self._slot_tables[slot, need:] = pages[-1] if pages else 0
+            self._lens[slot] = 0
+            r.slot = slot
+            self._slots[slot] = r
+
+    def _release(self, slot):
+        r = self._slots[slot]
+        need = math.ceil(min(len(r.prompt) + r.max_new,
+                             self.max_len) / self.page)
+        for p in self._slot_tables[slot, :need]:
+            self._free_pages.append(int(p))
+        self._slots[slot] = None
+        self._lens[slot] = 0
+        r.done = True
+        self._finished[r.rid] = r
+
+    def step(self):
+        """One engine token-step. Returns #active slots served."""
+        self._admit()
+        active = np.zeros((self.max_batch,), np.int32)
+        tokens = np.zeros((self.max_batch,), np.int32)
+        for slot, r in enumerate(self._slots):
+            if r is None:
+                continue
+            active[slot] = 1
+            if r.pos < len(r.prompt):
+                tokens[slot] = r.prompt[r.pos]
+            else:
+                tokens[slot] = r.out[-1]
+        if not active.any():
+            return 0
+        nxt, self.kp, self.vp = self._step(
+            self.W, self.kp, self.vp, jnp.asarray(tokens),
+            jnp.asarray(self._lens), jnp.asarray(self._slot_tables),
+            jnp.asarray(active))
+        nxt = np.asarray(nxt)
+        for slot, r in enumerate(self._slots):
+            if r is None:
+                continue
+            self._lens[slot] += 1
+            r.pos += 1
+            if r.pos >= len(r.prompt):          # past prefill: token emitted
+                r.out.append(int(nxt[slot]))
+                hit_eos = (r.eos is not None and r.out[-1] == r.eos)
+                if (len(r.out) >= r.max_new or hit_eos or
+                        self._lens[slot] >= self.max_len):
+                    self._release(slot)
+        return int(active.sum())
+
+    def run_until_done(self, max_steps=10000):
+        steps = 0
+        while (self._waiting or any(s is not None for s in self._slots)) \
+                and steps < max_steps:
+            self.step()
+            steps += 1
+        return steps
+
+    def result(self, rid):
+        return self._finished[rid].out

@@ -83,6 +83,38 @@ def feature_alpha_dropout(x, p=0.5, training=True, name=None):
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     idx = unwrap(x)
+    from ...core.dispatch import _state, grad_enabled
+    if sparse and _state.trace_ctx is None and grad_enabled() \
+            and not weight.stop_gradient:
+        # row-sparse gradient path (reference: embedding with sparse=True
+        # emits a SelectedRows grad): the weight cotangent is
+        # (looked-up rows, per-row grads) instead of a dense [V, D] scatter.
+        # Eager-only — under capture the dense formulation is used (XLA
+        # fuses the scatter anyway).
+        from ...autograd.node import GradNode
+        from ...core.selected_rows import SelectedRows
+        wa = unwrap(weight)
+        out = jnp.take(wa, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+        height, dim = wa.shape
+
+        def vjp(dout):
+            vals = dout.reshape(-1, dim)
+            rows = idx.reshape(-1)
+            if padding_idx is not None:
+                keep = (rows != padding_idx)[:, None].astype(vals.dtype)
+                vals = vals * keep
+            return (SelectedRows(rows, vals, height),)
+
+        t = Tensor(out, stop_gradient=False)
+        node = GradNode("sparse_embedding", vjp, (weight,), (out,))
+        t._grad_node = node
+        t._out_slot = 0
+        node.set_outputs([t])
+        return t
+
     def f(w):
         out = jnp.take(w, idx, axis=0)
         if padding_idx is not None:

@@ -42,6 +42,7 @@ class Embedding(Layer):
         super().__init__()
         self._num_embeddings, self._embedding_dim = num_embeddings, embedding_dim
         self._padding_idx = padding_idx
+        self._sparse = sparse
         self.weight = self.create_parameter(
             [num_embeddings, embedding_dim], attr=weight_attr,
             default_initializer=XavierUniform())
@@ -49,7 +50,8 @@ class Embedding(Layer):
             self.weight._data = self.weight._data.at[padding_idx].set(0.0)
 
     def forward(self, x):
-        return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+        return F.embedding(x, self.weight, padding_idx=self._padding_idx,
+                           sparse=self._sparse)
 
     def extra_repr(self):
         return f"{self._num_embeddings}, {self._embedding_dim}"

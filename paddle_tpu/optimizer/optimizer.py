@@ -89,11 +89,19 @@ class Optimizer:
         # clip over ALL groups at once so ClipGradByGlobalNorm sees the true
         # global norm (reference: Optimizer._create_optimization_pass clips the
         # concatenated params_grads)
+        from ..core.selected_rows import SelectedRows
         all_pg = [(p, p.grad) for g in self._param_groups for p in g["params"]
                   if not p.stop_gradient and p.grad is not None]
+        sparse_pg = [(p, g) for p, g in all_pg
+                     if isinstance(g, SelectedRows)]
+        all_pg = [(p, g) for p, g in all_pg
+                  if not isinstance(g, SelectedRows)]
         if self._grad_clip is not None:
+            # global-norm clip skips row-sparse grads (reference restricts
+            # sparse grads the same way)
             all_pg = self._grad_clip(all_pg)
         clipped = {id(p): g for p, g in all_pg}
+        clipped.update({id(p): g for p, g in sparse_pg})
         for group in self._param_groups:
             glr = lr * group.get("learning_rate", 1.0)
             wd = group.get("weight_decay", self._weight_decay)
@@ -103,11 +111,20 @@ class Optimizer:
                     continue
                 plr = glr * p.optimize_attr.get("learning_rate", 1.0) \
                     if isinstance(p, Parameter) else glr
-                self._update_param(p, unwrap(g), plr, wd)
+                if isinstance(g, SelectedRows):
+                    self._update_param_sparse(p, g, plr, wd)
+                else:
+                    self._update_param(p, unwrap(g), plr, wd)
         self._global_step._data = unwrap(self._global_step) + 1
 
     def _update_param(self, p, g, lr, weight_decay):
         raise NotImplementedError
+
+    def _update_param_sparse(self, p, g, lr, weight_decay):
+        """Row-sparse (SelectedRows) update. Optimizers with a true sparse
+        rule override this (SGD scatters row deltas); the default densifies
+        — correct for any optimizer, without the bandwidth win."""
+        self._update_param(p, g.to_dense(), lr, weight_decay)
 
     # ---- master weights ------------------------------------------------------
     def _master(self, p):

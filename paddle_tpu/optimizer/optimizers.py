@@ -26,6 +26,17 @@ class SGD(Optimizer):
         g = self._apply_weight_decay_l2(pw, g.astype(jnp.float32), wd)
         self._commit(p, mw, pw - lr * g)
 
+    def _update_param_sparse(self, p, g, lr, wd):
+        """True sparse SGD: only the touched rows move (reference
+        sgd sparse kernel over SelectedRows)."""
+        sr = g.merge()
+        mw, pw = self._master(p)
+        rows = sr.rows
+        delta = lr * sr.values.astype(jnp.float32)
+        if wd:
+            delta = delta + lr * wd * pw[rows].astype(jnp.float32)
+        self._commit(p, mw, pw.at[rows].add(-delta.astype(pw.dtype)))
+
 
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,

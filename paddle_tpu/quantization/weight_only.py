@@ -8,6 +8,7 @@ XLA fuses `(x @ int8.astype(bf16)) * scale` into one MXU op, halving the
 weight-streaming bandwidth that dominates decode."""
 from __future__ import annotations
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
@@ -47,17 +48,40 @@ def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float32"):
 
 def weight_only_linear(x, weight, bias=None, weight_scale=None,
                        weight_dtype="int8", arch=None, group_size=-1):
-    """y = x @ dequant(weight) + bias (reference: quantized_linear.py:33)."""
+    """y = x @ dequant(weight) + bias (reference: quantized_linear.py:33).
+
+    On TPU, no-grad calls with block-divisible shapes run the Pallas
+    quant-matmul kernel: int8/int4 tiles dequantize in VMEM and feed the
+    MXU directly, so the bf16 weight copy is NEVER materialized in HBM —
+    the weight stream (what bounds decode) stays at the quantized width.
+    Other cases use the XLA dequant formulation."""
     is4 = str(weight_dtype) == "int4"
+    import jax
+    from ..core.dispatch import _requires_grad
+    from ..ops.pallas import quant_matmul as qmm
+    K_in = (unwrap(weight).shape[0] * (2 if is4 else 1))
+    N = unwrap(weight).shape[1]
+    xa = unwrap(x)
+    M = int(np.prod(xa.shape[:-1])) if xa.ndim > 1 else 1
+    use_kernel = (jax.default_backend() in ("tpu", "axon")
+                  and not _requires_grad((x, weight, weight_scale))
+                  and xa.shape[-1] == K_in
+                  and qmm.supported(M, K_in, N, int4=is4))
 
     def f(a, qw, s, *b):
-        if is4:
-            lo = (qw << 4).astype(jnp.int8) >> 4
-            hi = qw >> 4
-            wq = jnp.stack([lo, hi], axis=1).reshape(-1, qw.shape[-1])
+        lead = a.shape[:-1]
+        if use_kernel:
+            y2 = qmm.quant_matmul(a.reshape(-1, a.shape[-1]), qw,
+                                  s.astype(jnp.float32), int4=is4)
+            y = y2.reshape(*lead, qw.shape[-1])
         else:
-            wq = qw
-        y = (a @ wq.astype(a.dtype)) * s.astype(a.dtype)
+            if is4:
+                lo = (qw << 4).astype(jnp.int8) >> 4
+                hi = qw >> 4
+                wq = jnp.stack([lo, hi], axis=1).reshape(-1, qw.shape[-1])
+            else:
+                wq = qw
+            y = (a @ wq.astype(a.dtype)) * s.astype(a.dtype)
         return y + b[0].astype(a.dtype) if b else y
 
     args = (x, weight, weight_scale) + ((bias,) if bias is not None else ())

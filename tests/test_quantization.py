@@ -1,6 +1,7 @@
 """paddle.quantization tests: fake-quant STE numerics, QAT training,
 PTQ calibrate+convert, weight-only int8/int4 serving path."""
 import numpy as np
+import jax.numpy as jnp
 import pytest
 
 import paddle_tpu as paddle
@@ -273,3 +274,39 @@ class TestQATCapture:
         s_after = [float(q.scales()) for q in quanters]
         assert l1 < l0
         assert any(a != b for a, b in zip(s_before, s_after))
+
+
+class TestQuantMatmulKernel:
+    """Pallas weight-only matmul (VERDICT r2 #4): in-kernel tile dequant,
+    numerics vs the XLA dequant reference for int8 and packed int4."""
+
+    def _data(self, M=8, K=256, N=256, seed=0):
+        rng = np.random.RandomState(seed)
+        x = jnp.asarray(rng.randn(M, K).astype(np.float32) * 0.3)
+        w = rng.randn(K, N).astype(np.float32) * 0.1
+        return x, w
+
+    @pytest.mark.parametrize("algo", ["weight_only_int8", "weight_only_int4"])
+    def test_kernel_matches_dequant_reference(self, algo):
+        import paddle_tpu as pt
+        from paddle_tpu.quantization.weight_only import (weight_quantize,
+                                                         weight_dequantize)
+        from paddle_tpu.ops.pallas.quant_matmul import quant_matmul
+        x, w = self._data()
+        qw, s = weight_quantize(pt.to_tensor(w), algo=algo)
+        int4 = algo.endswith("int4")
+        y = quant_matmul(x, jnp.asarray(np.asarray(qw.numpy())),
+                         jnp.asarray(np.asarray(s.numpy())), int4=int4)
+        wd = np.asarray(weight_dequantize(qw, s, algo=algo).numpy())
+        ref = np.asarray(x) @ wd
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=2e-2, atol=2e-2)
+
+    def test_tiny_m_padding(self):
+        import paddle_tpu as pt
+        from paddle_tpu.quantization.weight_only import weight_quantize
+        from paddle_tpu.ops.pallas.quant_matmul import quant_matmul
+        x, w = self._data(M=1)
+        qw, s = weight_quantize(pt.to_tensor(w))
+        y = quant_matmul(x, jnp.asarray(np.asarray(qw.numpy())),
+                         jnp.asarray(np.asarray(s.numpy())))
+        assert y.shape == (1, 256)

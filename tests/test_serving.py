@@ -191,3 +191,74 @@ class TestPredictor:
         a = pool.retrieve(0).run([x])[0]
         b = pool.retrieve(1).run([x])[0]
         np.testing.assert_allclose(a, b)
+
+
+class TestLLMEngine:
+    """Serving runtime (VERDICT r2 #9): continuous batching over a paged KV
+    cache; parity with model.generate; runs sharded on a pp=2 x mp=2 mesh."""
+
+    def _model(self):
+        import paddle_tpu as pt
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        pt.seed(0)
+        cfg = LlamaConfig(vocab_size=128, hidden_size=64,
+                          intermediate_size=176, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=128)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        return m
+
+    def test_engine_matches_model_generate(self):
+        import numpy as np
+        import paddle_tpu as pt
+        from paddle_tpu.inference.serving import LLMEngine
+        m = self._model()
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(1, 128, (n,)).astype(np.int32)
+                   for n in (5, 9, 3)]
+        ref = []
+        for p in prompts:
+            out = m.generate(pt.to_tensor(p[None, :]), max_new_tokens=6)
+            ref.append(np.asarray(out.numpy())[0, len(p):].tolist())
+        eng = LLMEngine(m, max_batch=2, max_len=64, page_size=8)
+        rids = [eng.add_request(p, max_new_tokens=6) for p in prompts]
+        eng.run_until_done()
+        for rid, r in zip(rids, ref):
+            assert eng.result(rid) == r, (rid, eng.result(rid), r)
+
+    def test_continuous_batching_interleaves(self):
+        import numpy as np
+        from paddle_tpu.inference.serving import LLMEngine
+        m = self._model()
+        rng = np.random.RandomState(1)
+        eng = LLMEngine(m, max_batch=2, max_len=32, page_size=8)
+        # 4 requests through 2 slots: pages must recycle, results per-request
+        rids = [eng.add_request(rng.randint(1, 128, (4 + i,)),
+                                max_new_tokens=4) for i in range(4)]
+        steps = eng.run_until_done()
+        assert steps > 0 and len(eng._finished) == 4
+        assert all(len(eng.result(r)) == 4 for r in rids)
+        assert len(eng._free_pages) == eng.n_pages - 1  # all pages recycled
+
+    def test_engine_on_pp_mp_mesh(self):
+        import numpy as np
+        import jax
+        from jax.sharding import Mesh
+        from paddle_tpu.inference.serving import LLMEngine
+        if len(jax.devices()) < 4:
+            import pytest
+            pytest.skip("needs 4 virtual devices")
+        m = self._model()
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("pp", "mp"))
+        rng = np.random.RandomState(2)
+        prompt = rng.randint(1, 128, (6,)).astype(np.int32)
+        # unsharded reference
+        ref_eng = LLMEngine(m, max_batch=2, max_len=32, page_size=8)
+        r0 = ref_eng.add_request(prompt, max_new_tokens=5)
+        ref_eng.run_until_done()
+        # sharded engine: same tokens through a pp=2,mp=2 placement
+        eng = LLMEngine(m, mesh=mesh, max_batch=2, max_len=32, page_size=8)
+        r1 = eng.add_request(prompt, max_new_tokens=5)
+        eng.run_until_done()
+        assert eng.result(r1) == ref_eng.result(r0)
