@@ -305,11 +305,17 @@ class LlamaForCausalLM(Layer):
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  top_p=1.0, top_k=0, temperature=1.0, eos_token_id=None,
-                 use_cache=True, seed=None):
+                 use_cache=True, seed=None, tokens_per_dispatch=None):
         """Autoregressive decoding with a per-layer KV cache (reference:
         PaddleNLP generation + phi top_p_sampling_kernel.h for the sampler).
         Greedy when do_sample=False; nucleus/top-k sampling otherwise.
-        Returns [B, prompt + new] token ids."""
+        Returns [B, prompt + new] token ids.
+
+        tokens_per_dispatch: decode steps compiled into ONE program per
+        host dispatch (default 1 — async dispatch already pipelines the
+        per-token calls; raise it only when per-call latency, not
+        throughput, dominates). eos checking needs each token on host, so
+        it forces 1."""
         from .. import ops
         from ..autograd import no_grad
         from ..jit import to_static
@@ -321,6 +327,13 @@ class LlamaForCausalLM(Layer):
             cur = input_ids
             cached_step, caches = None, None
             gen_entry = None
+            # measured on the tunneled v5e: decode dispatches already
+            # pipeline (K=4 gave +2%, K=8 regressed), so default stays 1;
+            # the knob remains for latency-bound deployments
+            K = 1 if tokens_per_dispatch is None else tokens_per_dispatch
+            K = max(1, min(int(K), max_new_tokens))
+            if eos_token_id is not None:
+                K = 1                      # host must see every token
             if use_cache:
                 # cache length buckets to a power of two (floor 128) so
                 # repeated generate() calls of similar lengths share ONE
@@ -328,10 +341,13 @@ class LlamaForCausalLM(Layer):
                 # without paying full-context attention for short outputs;
                 # entries persist on the model and reset by rewinding the
                 # offset — stale tail entries are causally masked, never read
-                need = prompt + max_new_tokens
+                # K>1 overshoots up to K-1 tokens past max_new before the
+                # trim; the bucket must cover them or the final dispatch
+                # indexes the RoPE table / cache past max_len
+                need = prompt + -(-max_new_tokens // K) * K
                 max_len = 1 << max(7, (need - 1).bit_length())
                 gen_key = (b, max_len, do_sample, top_p, top_k, temperature,
-                           seed)
+                           seed, K)
                 states = getattr(self, "_gen_states", None)
                 if states is None:
                     states = self._gen_states = {}
@@ -346,7 +362,7 @@ class LlamaForCausalLM(Layer):
 
                     out_dtype = str(input_ids.dtype).split(".")[-1]
 
-                    def _model_step(cur_tok):
+                    def _one_tok(cur_tok):
                         hidden = self.llama(cur_tok, kv_caches=caches)
                         if self.lm_head is not None:
                             logits = self.lm_head(hidden[:, -1])
@@ -360,6 +376,15 @@ class LlamaForCausalLM(Layer):
                         # cast in-graph: keeps the decode loop free of
                         # per-step eager ops (each is a device round trip)
                         return nxt.astype(out_dtype)
+
+                    def _model_step(cur_tok):
+                        # K tokens per compiled program: the kv caches are
+                        # mutable captured state, so the K sequential cache
+                        # updates land in ONE dispatch
+                        outs = [_one_tok(cur_tok)]
+                        for _ in range(K - 1):
+                            outs.append(_one_tok(outs[-1]))
+                        return ops.concat(outs, axis=1) if K > 1 else outs[0]
 
                     # one compiled program per shape signature: a prefill
                     # trace ([B, prompt]) and a decode trace ([B, 1]); every
@@ -385,10 +410,13 @@ class LlamaForCausalLM(Layer):
             # would compile a fresh kernel every token (measured 15ms/token
             # vs 0.4ms for the whole compiled decode step)
             toks = [ids]
+            n_dispatch = -(-max_new_tokens // K) if use_cache else \
+                max_new_tokens
             try:
-                for step in range(max_new_tokens):
+                for step in range(n_dispatch):
                     if use_cache:
-                        nxt = cached_step(cur)
+                        blk = cached_step(cur)       # [B, K] token block
+                        nxt = blk if K == 1 else blk[:, -1:]
                     else:
                         ids = ops.concat(toks, axis=1) if len(toks) > 1 \
                             else ids
@@ -415,7 +443,10 @@ class LlamaForCausalLM(Layer):
                             done_now = Tensor(finished._data | done_now._data)
                         finished = done_now
                     nxt = nxt.astype(toks[0].dtype)
-                    toks.append(nxt)
+                    if use_cache and K > 1:
+                        toks.append(blk.astype(toks[0].dtype))
+                    else:
+                        toks.append(nxt)
                     cur = nxt
                     if finished is not None and \
                             bool(np.asarray(finished._data).all()):
@@ -423,7 +454,10 @@ class LlamaForCausalLM(Layer):
             finally:
                 if gen_entry is not None:
                     gen_entry["busy"] = False
-            return ops.concat(toks, axis=1) if len(toks) > 1 else toks[0]
+            out = ops.concat(toks, axis=1) if len(toks) > 1 else toks[0]
+            if use_cache and K > 1:
+                out = out[:, :prompt + max_new_tokens]  # trim K overshoot
+            return out
 
     def _sample(self, logits, do_sample, top_p, top_k, temperature, seed):
         from .. import ops

@@ -262,3 +262,24 @@ class TestLLMEngine:
         r1 = eng.add_request(prompt, max_new_tokens=5)
         eng.run_until_done()
         assert eng.result(r1) == ref_eng.result(r0)
+
+
+def test_generate_tokens_per_dispatch_parity():
+    """K decode steps per dispatched program must produce identical tokens
+    to per-token dispatch (cache state threads through the K-step capture)."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    pt.seed(0)
+    m = LlamaForCausalLM(LlamaConfig.tiny())
+    m.eval()
+    rng = np.random.RandomState(0)
+    x = pt.to_tensor(rng.randint(1, 256, (2, 7)).astype(np.int32))
+    m._gen_states = {}
+    a = np.asarray(m.generate(x, max_new_tokens=10,
+                              tokens_per_dispatch=1).numpy())
+    m._gen_states = {}
+    b = np.asarray(m.generate(x, max_new_tokens=10,
+                              tokens_per_dispatch=4).numpy())
+    np.testing.assert_array_equal(a, b)
+    assert b.shape == (2, 17)
