@@ -214,6 +214,64 @@ def _weight_only_bench(jax, on_tpu):
         return None
 
 
+def _vision_bench(paddle, nn, on_tpu):
+    """ResNet-50 training throughput (BASELINE conv-heavy config family).
+    Best-effort extra: returns images/s or None."""
+    if not on_tpu:
+        return None
+    try:
+        from paddle_tpu.vision.models import resnet50
+        paddle.seed(0)
+        model = resnet50()
+        model.to(dtype="bfloat16")
+        opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                        parameters=model.parameters())
+        B, MULTI = 64, 2
+        rng = np.random.RandomState(0)
+
+        def train_multi(xs, ys):
+            for i in range(MULTI):
+                logits = model(xs[i])
+                loss = nn.functional.cross_entropy(logits, ys[i])
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            return loss
+
+        step = paddle.jit.to_static(train_multi)
+
+        def batch():
+            x = rng.rand(MULTI, B, 3, 224, 224).astype(np.float32)
+            y = rng.randint(0, 1000, (MULTI, B)).astype(np.int64)
+            return (paddle.to_tensor(x).astype("bfloat16"),
+                    paddle.to_tensor(y))
+
+        for _ in range(3):
+            loss = step(*batch())
+        float(np.asarray(loss._data, np.float32))
+        data = [batch() for _ in range(6)]
+
+        def timed(k):
+            t0 = time.perf_counter()
+            for i in range(k):
+                loss = step(*data[i])
+            float(np.asarray(loss._data, np.float32))
+            return time.perf_counter() - t0
+
+        best = None
+        for _ in range(2):
+            t1, t6 = timed(1), timed(6)
+            if t6 > t1:
+                d = (t6 - t1) / 5 / MULTI
+                best = d if best is None else min(best, d)
+        if not best:
+            return None
+        return round(B / best, 1)
+    except Exception as e:  # noqa: BLE001 — extras must not kill the bench
+        print(f"vision bench failed: {type(e).__name__}: {e}", file=sys.stderr)
+        return None
+
+
 def _decode_bench(paddle, on_tpu):
     """KV-cache decode throughput on a small Llama (serving-path extra).
     Best-effort: returns tokens/s or None."""
@@ -343,6 +401,7 @@ def main():
 
     decode_tps = _decode_bench(paddle, on_tpu)
     wo_bench = _weight_only_bench(jax, on_tpu)
+    vision_ips = _vision_bench(paddle, nn, on_tpu)
 
     print(json.dumps({
         "metric": "gpt2_124m_pretrain_tokens_per_sec_per_chip",
@@ -359,6 +418,7 @@ def main():
                       round(achieved / meas_peak, 4) if meas_peak else None,
                   "decode_tokens_per_sec": decode_tps,
                   "weight_only_int8": wo_bench,
+                  "resnet50_images_per_sec": vision_ips,
                   "final_loss": final_loss},
     }))
 

@@ -112,25 +112,97 @@ worker_index = lambda: get_rank()  # noqa: E731
 worker_num = lambda: get_world_size()  # noqa: E731
 
 
-class UserDefinedRoleMaker:
-    def __init__(self, *a, **k):
-        pass
+class Role:
+    """reference fleet/base/role_maker.py Role enum."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
 
 
 class PaddleCloudRoleMaker:
-    """reference: fleet/base/role_maker.py:548 — env-derived roles."""
+    """reference: fleet/base/role_maker.py:548 — roles derived from the
+    PaddleCloud env contract: TRAINING_ROLE (TRAINER|PSERVER),
+    PADDLE_PSERVERS_IP_PORT_LIST, PADDLE_TRAINER_ENDPOINTS, POD_IP +
+    PADDLE_PORT. In PS mode the server endpoints feed
+    distributed.ps_sparse servers; collective mode falls back to the
+    launch env (rank/world)."""
 
     def __init__(self, is_collective=True, **kwargs):
+        import os
         self._is_collective = is_collective
+        self._role = Role.WORKER
+        self._servers = [e for e in os.environ.get(
+            "PADDLE_PSERVERS_IP_PORT_LIST", "").split(",") if e]
+        self._workers = [e for e in os.environ.get(
+            "PADDLE_TRAINER_ENDPOINTS", "").split(",") if e]
+        if not is_collective and os.environ.get(
+                "TRAINING_ROLE", "TRAINER").upper() == "PSERVER":
+            self._role = Role.SERVER
+            me = (os.environ.get("POD_IP", "127.0.0.1") + ":"
+                  + os.environ.get("PADDLE_PORT", "0"))
+            if me not in self._servers:
+                raise ValueError(
+                    f"TRAINING_ROLE=PSERVER but {me!r} is not in "
+                    f"PADDLE_PSERVERS_IP_PORT_LIST={self._servers}; check "
+                    "POD_IP/PADDLE_PORT (two servers claiming the same "
+                    "shard would silently corrupt training)")
+            self._server_index = self._servers.index(me)
+        else:
+            self._server_index = -1
 
+    # -- worker plane ---------------------------------------------------------
     def worker_index(self):
         return get_rank()
 
     def worker_num(self):
-        return get_world_size()
+        if self._is_collective:
+            return get_world_size()      # launch env is authoritative
+        return len(self._workers) or get_world_size()
 
     def is_worker(self):
-        return True
+        return self._role == Role.WORKER
 
+    def is_first_worker(self):
+        return self.is_worker() and self.worker_index() == 0
+
+    def get_trainer_endpoints(self):
+        return list(self._workers)
+
+    # -- server plane ---------------------------------------------------------
     def is_server(self):
-        return False
+        return self._role == Role.SERVER
+
+    def server_num(self):
+        return len(self._servers)
+
+    def server_index(self):
+        return self._server_index
+
+    def get_pserver_endpoints(self):
+        return list(self._servers)
+
+    def role_id(self):
+        return self.server_index() if self.is_server() else             self.worker_index()
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """reference: fleet/base/role_maker.py:1213 — explicit roles instead of
+    env derivation."""
+
+    def __init__(self, is_collective=False, current_id=0, role=None,
+                 worker_num=0, server_endpoints=None, **kwargs):
+        self._is_collective = is_collective
+        self._role = role if role is not None else Role.WORKER
+        self._servers = list(server_endpoints or [])
+        self._workers = []
+        self._current_id = int(current_id)
+        self._worker_num = int(worker_num)
+        self._server_index = self._current_id             if self._role == Role.SERVER else -1
+
+    def worker_index(self):
+        return self._current_id if self._role == Role.WORKER else -1
+
+    def worker_num(self):
+        return self._worker_num

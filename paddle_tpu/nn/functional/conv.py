@@ -56,12 +56,15 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format, na
         # paddle weight is OI<spatial>; convert to rhs_spec
         if channel_last:
             w = jnp.moveaxis(w, (0, 1), (-1, -2))  # OIHW -> HWIO
+        # no preferred_element_type: jax's conv transpose rule rejects the
+        # bf16-operand/f32-cotangent mix it creates, breaking backward. The
+        # MXU accumulates in f32 internally either way — only the output
+        # rounding differs, matching standard bf16 conv semantics.
         out = jax.lax.conv_general_dilated(
             a, w, window_strides=st, padding=pad,
             lhs_dilation=None, rhs_dilation=dl,
             dimension_numbers=(lhs_spec, rhs_spec, out_spec),
-            feature_group_count=groups,
-            preferred_element_type=jnp.float32 if a.dtype == jnp.bfloat16 else None)
+            feature_group_count=groups)
         out = out.astype(a.dtype)
         if b:
             shape = [1] * out.ndim

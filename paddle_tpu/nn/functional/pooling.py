@@ -37,9 +37,15 @@ def _pool(x, kernel, stride, padding, n, data_format, reducer, init, name,
                 if rem:
                     pads[ax] = (pads[ax][0], pads[ax][1] + st[i] - rem)
         if reducer == "max":
-            return jax.lax.reduce_window(a, -jnp.inf if np.dtype(a.dtype).kind == "f" else
-                                         jnp.iinfo(a.dtype).min,
-                                         jax.lax.max, window, strides, pads)
+            from ...core.dispatch import _FLOAT_KINDS
+            if np.dtype(a.dtype).kind in _FLOAT_KINDS:
+                # fp8 has no inf: -inf would cast to NaN and poison the max
+                init = float(jnp.finfo(a.dtype).min) \
+                    if jnp.finfo(a.dtype).maxexp < 128 else -jnp.inf
+            else:
+                init = jnp.iinfo(a.dtype).min
+            return jax.lax.reduce_window(a, init, jax.lax.max, window,
+                                         strides, pads)
         s = jax.lax.reduce_window(a.astype(jnp.float32), 0.0, jax.lax.add, window, strides, pads)
         if exclusive:
             ones = jnp.ones(a.shape, jnp.float32)

@@ -806,3 +806,37 @@ def test_gradient_merge_strategy_wired():
     for pm, pr in zip(lin_m.parameters(), lin_r.parameters()):
         np.testing.assert_allclose(np.asarray(pm._data),
                                    np.asarray(pr._data), rtol=1e-6)
+
+
+def test_role_makers():
+    """Cluster role plumbing (VERDICT §2.4 #69): env-derived PaddleCloud
+    roles + explicit UserDefined roles."""
+    import os
+    from paddle_tpu.distributed.fleet import (PaddleCloudRoleMaker,
+                                              UserDefinedRoleMaker, Role)
+    env = {"TRAINING_ROLE": "PSERVER",
+           "PADDLE_PSERVERS_IP_PORT_LIST": "10.0.0.1:6000,10.0.0.2:6000",
+           "PADDLE_TRAINER_ENDPOINTS": "10.0.0.3:0,10.0.0.4:0",
+           "POD_IP": "10.0.0.2", "PADDLE_PORT": "6000"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        rm = PaddleCloudRoleMaker(is_collective=False)
+        assert rm.is_server() and not rm.is_worker()
+        assert rm.server_index() == 1 and rm.server_num() == 2
+        assert rm.get_pserver_endpoints() == ["10.0.0.1:6000",
+                                              "10.0.0.2:6000"]
+        assert rm.role_id() == 1
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else \
+                os.environ.__setitem__(k, v)
+    rm = PaddleCloudRoleMaker(is_collective=True)
+    assert rm.is_worker()
+    assert rm.is_first_worker() == (rm.worker_index() == 0)
+    assert rm.worker_num() >= 1
+    u = UserDefinedRoleMaker(current_id=1, role=Role.SERVER, worker_num=2,
+                             server_endpoints=["a:1", "b:2"])
+    assert u.is_server() and u.server_index() == 1 and u.server_num() == 2
+    u2 = UserDefinedRoleMaker(current_id=0, role=Role.WORKER, worker_num=2)
+    assert u2.is_worker() and u2.worker_num() == 2
