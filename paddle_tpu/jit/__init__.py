@@ -14,7 +14,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .to_static import to_static, not_to_static, StaticFunction  # noqa: F401
+from .to_static import (to_static, not_to_static, StaticFunction,  # noqa: F401
+                        scan_steps, ScanStaticFunction)
 from ..core.tensor import Tensor
 from ..core.dispatch import unwrap
 
