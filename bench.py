@@ -31,6 +31,20 @@ _SPEC_PEAK_TFLOPS = [
     ("v2", 45.0),
 ]
 
+# HBM bandwidth GB/s per chip by device kind substring (public spec sheets) —
+# the physical floor for any weight-streaming microbench result.
+_SPEC_HBM_GBPS = [
+    ("v5 lite", 819.0),
+    ("v5e", 819.0),
+    ("v5p", 2765.0),
+    ("v5", 2765.0),
+    ("v6 lite", 1640.0),
+    ("v6e", 1640.0),
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+]
+
 
 def _spec_peak(device_kind: str, on_tpu: bool) -> float:
     kind = device_kind.lower()
@@ -39,6 +53,14 @@ def _spec_peak(device_kind: str, on_tpu: bool) -> float:
             if key in kind:
                 return tf * 1e12
     return 1e12  # nominal CPU number so the ratio is defined
+
+
+def _spec_hbm_bw(device_kind: str) -> float:
+    kind = device_kind.lower()
+    for key, gb in _SPEC_HBM_GBPS:
+        if key in kind:
+            return gb * 1e9
+    return 100e9  # conservative CPU-ish default
 
 
 def _sync(x):
@@ -86,16 +108,49 @@ def _measure_peak(jax):
         return None
 
 
-def _train(paddle, nn, cfg, batch, seqlen, steps, multi=4):
-    """Build the model + run the timed loop. Returns (tokens/s, step_dt, loss, n_params).
+def _measure_rtt(jax):
+    """Per-dispatch round-trip latency of THIS session's dispatch path: a
+    trivial jitted scalar op timed end-to-end (dispatch + scalar sync).
+    Reported so a slow run explains itself — through the axon tunnel this has
+    measured anywhere from ~5 to ~150 ms and it is NOT part of device step
+    time when steps are scanned."""
+    import jax.numpy as jnp
 
-    `multi` train steps run per dispatched call (one compiled program looping
-    the step): the axon tunnel costs ~5ms per dispatch even when pipelined,
-    which a per-step dispatch pays in full — amortizing it across 4 steps
-    recovers ~4% at GPT-2 b16 step times."""
+    try:
+        f = jax.jit(lambda x: x + 1.0)
+        x = jnp.zeros(())
+        float(np.asarray(f(x)))
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            float(np.asarray(f(x)))
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        return ts[len(ts) // 2]
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _train(paddle, nn, cfg, batch, seqlen, trials, k_lo=2, k_hi=8):
+    """Build the model + run the timed loop.
+
+    Returns (tokens/s, step_dt, loss, n_params, detail dict).
+
+    Dispatch amortization: the train step is compiled as ONE lax.scan over K
+    steps (paddle.jit.scan_steps), so a dispatch costs one tunnel round trip
+    for K real optimizer updates and the HLO size is independent of K.
+
+    Timing: differential between a k_hi-step dispatch and a k_lo-step
+    dispatch, ONE dispatch each — the per-dispatch constant (tunnel RTT +
+    scalar-sync cost, 5-150 ms/call depending on session) cancels exactly,
+    same method as the peak-matmul probe. Median over `trials` trials; the
+    full-dispatch average (latency-inflated) is kept as an upper-bound
+    cross-check and the fallback if the differential misbehaves."""
     paddle.seed(0)
     from paddle_tpu.models.gpt2 import GPT2ForCausalLM
 
+    phases = {}
+    t_phase = time.perf_counter()
     model = GPT2ForCausalLM(cfg)
     model.to(dtype="bfloat16")
     opt = paddle.optimizer.AdamW(learning_rate=3e-4, weight_decay=0.01,
@@ -103,60 +158,84 @@ def _train(paddle, nn, cfg, batch, seqlen, steps, multi=4):
                                  grad_clip=nn.ClipGradByGlobalNorm(1.0))
     n_params = sum(p.size for p in model.parameters())
 
-    def train_multi(xs, ys):
-        for i in range(multi):
-            _, loss = model(xs[i], labels=ys[i])
-            loss.backward()
-            opt.step()
-            opt.clear_grad()
+    def train_step(x, y):
+        _, loss = model(x, labels=y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
         return loss
 
-    static_step = paddle.jit.to_static(train_multi)
+    scan_step = paddle.jit.scan_steps(train_step)
     rng = np.random.RandomState(0)
 
-    def batch_data():
+    def batch_data(k):
         ids = rng.randint(0, cfg.vocab_size,
-                          (multi, batch, seqlen + 1)).astype(np.int32)
+                          (k, batch, seqlen + 1)).astype(np.int32)
         return (paddle.to_tensor(ids[:, :, :-1]),
                 paddle.to_tensor(ids[:, :, 1:]))
 
-    # warmup: spy (lazy state creation) + re-spy/trace + first compiled run
-    for _ in range(3):
-        loss = static_step(*batch_data())
-    final0 = float(np.asarray(loss._data, np.float32))  # sync before timing
+    def sync_loss(out):
+        return float(np.asarray(out._data[-1], np.float32))
 
-    # pre-generate batches so host-side RNG isn't in the timed region;
-    # single sync at the end via materialization (block_until_ready does not
-    # actually block through the tunnel), differential to cancel latency
-    data = [batch_data() for _ in range(steps)]
+    phases["build_s"] = round(time.perf_counter() - t_phase, 2)
 
-    def timed(k):
+    # capture: k_lo first (the lazy-state re-spy burns its MissedCapture on
+    # the cheap signature), then k_hi compiles first try
+    t_phase = time.perf_counter()
+    sync_loss(scan_step(*batch_data(k_lo)))   # spy attempt 1 (lazy state)
+    sync_loss(scan_step(*batch_data(k_lo)))   # spy attempt 2 -> traced
+    sync_loss(scan_step(*batch_data(k_hi)))   # k_hi spy -> traced
+    phases["capture_s"] = round(time.perf_counter() - t_phase, 2)
+
+    # pre-stage data on device, then warm both compiled programs (first call
+    # of each pays XLA compile)
+    lo_data, hi_data = batch_data(k_lo), batch_data(k_hi)
+    t_phase = time.perf_counter()
+    sync_loss(scan_step(*lo_data))
+    sync_loss(scan_step(*hi_data))
+    phases["compile_warm_s"] = round(time.perf_counter() - t_phase, 2)
+
+    t_phase = time.perf_counter()
+    diffs, uppers = [], []
+    loss = None
+    for _ in range(max(2, trials)):
         t0 = time.perf_counter()
-        for i in range(k):
-            loss = static_step(*data[i])
-        float(np.asarray(loss._data, np.float32))
-        return time.perf_counter() - t0
+        sync_loss(scan_step(*lo_data))
+        t_lo = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        loss = sync_loss(scan_step(*hi_data))
+        t_hi = time.perf_counter() - t0
+        uppers.append(t_hi / k_hi)
+        diffs.append((t_hi - t_lo) / (k_hi - k_lo))
+    phases["trials_s"] = round(time.perf_counter() - t_phase, 2)
+    diffs.sort()
+    dt = diffs[len(diffs) // 2]               # median differential
+    upper = min(uppers)
+    method = "scan_differential"
+    if dt <= 0 or dt > upper * 1.5:
+        # tunnel jitter defeated the differential; the full-dispatch average
+        # still bounds per-step time from above (includes RTT/k_hi)
+        dt, method = upper, "scan_upper_bound"
+    detail = {"dispatch": "lax.scan over steps",
+              "k_lo": k_lo, "k_hi": k_hi,
+              "dt_ms_samples": [round(d * 1e3, 2) for d in diffs],
+              "dt_ms_upper_bound": round(upper * 1e3, 2),
+              "timing_method": method,
+              "phases": phases}
+    return batch * seqlen / dt, dt, loss, n_params, detail
 
-    best = None
-    for _ in range(2):       # best-of-2: tunnel throughput varies run-to-run
-        t_small = timed(max(1, steps // 5))
-        t_full = timed(steps)
-        d = (t_full - t_small) / (steps - max(1, steps // 5)) / multi
-        if d <= 0:  # latency-dominated; fall back to the full-loop average
-            d = t_full / (steps * multi)
-        best = d if best is None else min(best, d)
-    dt = best
-    loss = static_step(*data[0])
-    final_loss = float(np.asarray(loss._data, np.float32))
-    return batch * seqlen / dt, dt, final_loss, n_params
 
-
-def _weight_only_bench(jax, on_tpu):
+def _weight_only_bench(jax, on_tpu, hbm_bw):
     """Pallas int8 weight-only matmul vs the XLA dequant path at a
     Llama-shaped decode GEMM (M=8, 4096x4096). Each chain iteration streams
     a DISTINCT weight copy — with one shared weight XLA hoists the dequant
-    out of the loop and the comparison measures nothing. Returns the
-    per-call times + speedup, or None."""
+    out of the loop and the comparison measures nothing.
+
+    Estimator (r3 lesson — min-of-differences once published a physically
+    impossible 3.4us): MEDIAN of differences over 10 trials, with a physical
+    floor — each call must stream >=16MB of int8 weight, so any estimate
+    below bytes/HBM_bandwidth is tagged "implausible" and excluded from the
+    speedup. Per-trial spread (IQR) is reported alongside."""
     if not on_tpu:
         return None
     try:
@@ -170,6 +249,7 @@ def _weight_only_bench(jax, on_tpu):
         q1 = np.clip(np.round(w / s), -127, 127).astype(np.int8)
         qws = jnp.asarray(np.stack([q1] * COPIES))       # [C, K, N] int8
         sc = jnp.asarray(s.astype(np.float32))
+        floor_s = (K * N) / hbm_bw   # one int8 weight stream at spec HBM BW
 
         def chain(x, qws, fn, n):
             for i in range(n):
@@ -189,32 +269,39 @@ def _weight_only_bench(jax, on_tpu):
             lo = jax.jit(lambda x, q: chain(x, q, fn, n_lo))
             hi = jax.jit(lambda x, q: chain(x, q, fn, n_hi))
             float(np.asarray(lo(x, qws))), float(np.asarray(hi(x, qws)))
-            best, full = None, None
-            for _ in range(6):
+            diffs, fulls = [], []
+            for _ in range(10):
                 t0 = time.perf_counter()
                 float(np.asarray(lo(x, qws)))
                 a = time.perf_counter() - t0
                 t0 = time.perf_counter()
                 float(np.asarray(hi(x, qws)))
                 b = time.perf_counter() - t0
-                full = min(full or 9e9, b / n_hi)
-                if b > a:
-                    best = min(best or 9e9, (b - a) / (n_hi - n_lo))
-            # throttled/noisy sessions can defeat the differential; the
-            # full-loop average still bounds the per-call time from above
-            if best is not None:
-                return best, "differential"
-            return full, "upper_bound"
+                fulls.append(b / n_hi)
+                diffs.append((b - a) / (n_hi - n_lo))
+            diffs.sort()
+            q1_, med, q3_ = (diffs[len(diffs) // 4],
+                             diffs[len(diffs) // 2],
+                             diffs[(3 * len(diffs)) // 4])
+            if med < floor_s:
+                # below the weight-stream bandwidth floor: the differential
+                # was defeated by session noise — report the (latency-
+                # inflated) full-loop average as an upper bound instead
+                return min(fulls), "implausible_floor", (q1_, q3_)
+            return med, "differential", (q1_, q3_)
 
-        t_deq, m_deq = timed(dequant)
-        t_kern, m_kern = timed(kern)
+        t_deq, m_deq, iqr_deq = timed(dequant)
+        t_kern, m_kern, iqr_kern = timed(kern)
         if not t_deq or not t_kern:
             return None
         both_diff = m_deq == m_kern == "differential"
         return {"dequant_us": round(t_deq * 1e6, 1),
                 "kernel_us": round(t_kern * 1e6, 1),
-                # upper-bound times are latency-inflated and not comparable:
-                # a ratio of them would look plausible but be biased
+                "dequant_iqr_us": [round(v * 1e6, 1) for v in iqr_deq],
+                "kernel_iqr_us": [round(v * 1e6, 1) for v in iqr_kern],
+                "floor_us": round(floor_s * 1e6, 1),
+                # non-differential times are latency-inflated / noise-floored
+                # and not comparable: a ratio would look plausible but lie
                 "speedup": round(t_deq / t_kern, 2) if both_diff else None,
                 "method": m_deq if m_deq == m_kern else
                 f"mixed({m_deq}/{m_kern})"}
@@ -333,7 +420,7 @@ def main():
 
     dev = jax.devices()[0]
     on_tpu = dev.platform in ("tpu", "axon")
-    steps = 10 if on_tpu else 3
+    steps = 5 if on_tpu else 2   # timing trials (each = one lo + one hi dispatch)
 
     meas_peak = _measure_peak(jax)
     spec_peak = _spec_peak(dev.device_kind, on_tpu)
@@ -350,6 +437,21 @@ def main():
                                        attention_dropout_prob=0.0,
                                        max_position_embeddings=256)
 
+    def _tune_loss_cfg(cfg, batch, seqlen, on_tpu):
+        if not on_tpu:
+            return
+        if batch * seqlen <= 16 * 1024:
+            # HBM fits the un-recomputed loss chunks: skip one [chunk,V]
+            # matmul per chunk in backward (~9% of step FLOPs)
+            cfg.loss_chunk_size = batch * seqlen
+            cfg.loss_recompute = False
+        else:
+            # large geometry: smaller recomputed chunks keep the eager
+            # capture pass's transient [chunk,V] f32 logits under control
+            # (r3's b=32 OOM died in the eager chunked_lm_loss dispatch)
+            cfg.loss_chunk_size = 2048
+            cfg.loss_recompute = True
+
     # OOM-resilient: back off batch geometry instead of dying without a number.
     # Each attempt runs in a FRESH subprocess — a failed large-batch attempt
     # leaves compiled programs/optimizer state behind that would poison the
@@ -360,22 +462,28 @@ def main():
     geom = os.environ.get("BENCH_GEOMETRY")
     if geom:                                  # child: run one geometry
         batch, seqlen = (int(v) for v in geom.split("x"))
-        if on_tpu and batch * seqlen <= 16 * 1024:
-            cfg.loss_chunk_size = batch * seqlen
-            cfg.loss_recompute = False
+        _tune_loss_cfg(cfg, batch, seqlen, on_tpu)
+        # per-child probe: the chip's rate is a property of THIS session, and
+        # the child is a fresh process/session — the parent's probe does not
+        # certify it (the r3 claim-vs-driver gap hid here)
+        child_peak = _measure_peak(jax)
+        rtt = _measure_rtt(jax)
         result = _train(paddle, nn, cfg, batch, seqlen, steps)
+        result[4]["child_peak_tflops"] = \
+            round(child_peak / 1e12, 2) if child_peak else None
+        result[4]["rtt_ms"] = round(rtt * 1e3, 1) if rtt else None
         print("BENCH_CHILD " + json.dumps(list(result)), file=sys.stderr)
-        tokens_per_sec, dt, final_loss, n_params = result
         sys.exit(0)
 
     result, err = None, None
     for batch, seqlen in shapes:
         if (batch, seqlen) == shapes[-1]:
             try:      # last resort runs in-process (works even if fork fails)
-                if on_tpu and batch * seqlen <= 16 * 1024:
-                    cfg.loss_chunk_size = batch * seqlen
-                    cfg.loss_recompute = False
+                _tune_loss_cfg(cfg, batch, seqlen, on_tpu)
+                rtt = _measure_rtt(jax)
                 result = _train(paddle, nn, cfg, batch, seqlen, steps)
+                result[4]["child_peak_tflops"] = None
+                result[4]["rtt_ms"] = round(rtt * 1e3, 1) if rtt else None
                 break
             except Exception as e:  # noqa: BLE001
                 err = e
@@ -403,15 +511,21 @@ def main():
     if result is None:
         raise err if err is not None else RuntimeError("all geometries failed")
 
-    tokens_per_sec, dt, final_loss, n_params = result
+    tokens_per_sec, dt, final_loss, n_params, detail = result
     # PaLM-appendix model flops per token: 6N + 12·L·h·s
     flops_per_token = 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * seqlen
     achieved = tokens_per_sec * flops_per_token
     mfu = achieved / spec_peak
 
     decode_tps = _decode_bench(paddle, on_tpu)
-    wo_bench = _weight_only_bench(jax, on_tpu)
+    wo_bench = _weight_only_bench(jax, on_tpu, _spec_hbm_bw(dev.device_kind))
     vision_ips = _vision_bench(paddle, nn, on_tpu)
+
+    # normalize against the peak measured in the SAME process/session as the
+    # timed train (the tunneled chip's rate is bimodal across sessions; the
+    # parent's probe does not certify the child's session)
+    child_peak = detail.get("child_peak_tflops")
+    sess_peak = child_peak * 1e12 if child_peak else meas_peak
 
     print(json.dumps({
         "metric": "gpt2_124m_pretrain_tokens_per_sec_per_chip",
@@ -424,8 +538,10 @@ def main():
                   "spec_peak_tflops": round(spec_peak / 1e12, 1),
                   "measured_chip_peak_tflops":
                       round(meas_peak / 1e12, 2) if meas_peak else None,
+                  "train_session_peak_tflops": child_peak,
                   "mfu_vs_measured_peak":
-                      round(achieved / meas_peak, 4) if meas_peak else None,
+                      round(achieved / sess_peak, 4) if sess_peak else None,
+                  "timing": detail,
                   "decode_tokens_per_sec": decode_tps,
                   "weight_only_int8": wo_bench,
                   "resnet50_images_per_sec": vision_ips,

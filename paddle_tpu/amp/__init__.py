@@ -157,11 +157,15 @@ class GradScaler:
         self._unscaled = False
 
     def scale(self, var):
+        global _active_scaler
+        _active_scaler = self if self._enable else None
         if not self._enable or self._scale == 1.0:
             return var
         return var * self._scale
 
     def unscale_(self, optimizer):
+        global _active_scaler
+        _active_scaler = None   # grads are unscaled from here on
         if not self._enable:
             return
         inv = 1.0 / self._scale
@@ -202,6 +206,8 @@ class GradScaler:
         self.update()
 
     def update(self):
+        global _active_scaler
+        _active_scaler = None   # the scaled-backward window is over
         if not (self._enable and self._dynamic):
             self._unscaled = False
             return
@@ -254,3 +260,17 @@ def is_bfloat16_supported(device=None):
 
 def is_float16_supported(device=None):
     return True
+
+
+# last enabled scaler that scaled a loss this process; lets out-of-band grad
+# consumers (e.g. distributed.ps_sparse.PsEmbedding's backward-hook push)
+# unscale gradients they receive mid-backward, before unscale_() has run
+_active_scaler = None
+
+
+def active_loss_scale() -> float:
+    """Loss-scale factor currently applied to gradients flowing in backward
+    (1.0 when no enabled GradScaler has scaled a loss)."""
+    if _active_scaler is not None and _active_scaler._enable:
+        return float(_active_scaler._scale)
+    return 1.0

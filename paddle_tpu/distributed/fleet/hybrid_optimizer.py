@@ -37,9 +37,16 @@ class HybridParallelOptimizer:
                 return                  # merge window open: accumulate only
             self._gm_counter = 0
             if self._gm_avg:
+                from ...core.selected_rows import SelectedRows
                 k = float(self._gm_steps)
                 for p in getattr(self._inner_opt, "_parameter_list", []):
-                    if p.grad is not None:
+                    if isinstance(p.grad, SelectedRows):
+                        # row-sparse grad (Embedding(sparse=True)): scale the
+                        # values in place, keeping the rows/height structure
+                        sr = p.grad
+                        p.grad = SelectedRows(sr.rows, sr.values / k,
+                                              sr.height)
+                    elif p.grad is not None:
                         p.grad.set_value(p.grad / k)
         self._inner_opt.step()
 

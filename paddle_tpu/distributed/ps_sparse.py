@@ -76,8 +76,17 @@ class SparseShard:
                 "INSERT OR REPLACE INTO rows VALUES (?, ?, ?)",
                 (rid, self.pool[slot].tobytes(), float(self.accum[slot])))
             del self.slot_of[rid]
+            self._evicted_uncommitted = True
         self.id_of[slot] = -1
         return slot
+
+    def _commit_evictions(self):
+        # evicted rows are gone from the pool, so an uncommitted spill INSERT
+        # is the only copy — commit at batch boundaries or a crash between
+        # checkpoints silently re-initializes them (ADVICE r3)
+        if getattr(self, "_evicted_uncommitted", False):
+            self._db.commit()
+            self._evicted_uncommitted = False
 
     def _resident(self, rid):
         """Slot of row `rid`, faulting it in (spill or fresh init)."""
@@ -106,6 +115,7 @@ class SparseShard:
         with self.lock:
             for i, rid in enumerate(ids):
                 out[i] = self.pool[self._resident(int(rid))]
+            self._commit_evictions()
         return out
 
     def push(self, ids, grads):
@@ -125,6 +135,7 @@ class SparseShard:
                     self.pool[slot] -= scale * gr
                 else:
                     self.pool[slot] -= self.lr * gr
+            self._commit_evictions()
 
     # -- persistence ----------------------------------------------------------
     def save(self, path):
@@ -187,10 +198,13 @@ def _send_msg(sock, obj):
     sock.sendall(struct.pack("!Q", len(payload)) + payload)
 
 
-def serve(port, data_dir, host="127.0.0.1", ready_file=None):
-    """Run a PS server (blocking): one process = one shard of every table."""
+def serve(port, data_dir, host="127.0.0.1", ready_file=None, load_dir=None):
+    """Run a PS server (blocking): one process = one shard of every table.
+    With `load_dir`, a table whose shard checkpoint exists there warm-starts
+    from it on create (fleet.init_server(dirname) analog)."""
     os.makedirs(data_dir, exist_ok=True)
     shards: dict[str, SparseShard] = {}
+    create_lock = threading.Lock()  # create is idempotent under concurrency
     srv = socket.socket()
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     srv.bind((host, port))
@@ -207,13 +221,26 @@ def serve(port, data_dir, host="127.0.0.1", ready_file=None):
                 try:
                     if op == "create":
                         name = msg["name"]
-                        if name not in shards:
-                            shards[name] = SparseShard(
-                                name, msg["dim"], msg["capacity"], data_dir,
-                                lr=msg.get("lr", 0.1),
-                                optimizer=msg.get("optimizer", "sgd"),
-                                initializer=msg.get("initializer", "uniform"),
-                                seed=msg.get("seed", 0))
+                        # check-then-insert must be atomic: concurrent trainer
+                        # connects run in separate handler threads, and a
+                        # double-create would silently discard pushes applied
+                        # to the replaced shard (ADVICE r3)
+                        with create_lock:
+                            if name not in shards:
+                                sh = SparseShard(
+                                    name, msg["dim"], msg["capacity"],
+                                    data_dir,
+                                    lr=msg.get("lr", 0.1),
+                                    optimizer=msg.get("optimizer", "sgd"),
+                                    initializer=msg.get("initializer",
+                                                        "uniform"),
+                                    seed=msg.get("seed", 0))
+                                if load_dir:
+                                    ck = os.path.join(
+                                        load_dir, f"{name}.shard.sqlite")
+                                    if os.path.exists(ck):
+                                        sh.load(ck)
+                                shards[name] = sh
                         _send_msg(conn, {"ok": True})
                     elif op == "pull":
                         _send_msg(conn, {"ok": True, "rows":
@@ -382,13 +409,24 @@ class SparsePsClient:
         return [self._call(si, {"op": "stats"})["stats"]
                 for si in range(len(self.endpoints))]
 
+    def close(self, si=None):
+        """Drop client connections (servers keep running)."""
+        for i in ([si] if si is not None else range(len(self.endpoints))):
+            s = self._socks[i]
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._socks[i] = None
+
     def shutdown(self, si=None):
         for i in ([si] if si is not None else range(len(self.endpoints))):
             try:
                 self._call(i, {"op": "shutdown"})
             except Exception:
                 pass
-            self._socks[i] = None
+        self.close(si)
 
 
 # ============================ device integration ============================
@@ -400,7 +438,12 @@ class PsEmbedding:
     forward: unique ids in the batch -> pull rows (host) -> device gather.
     backward: a hook on the pulled-rows leaf tensor pushes per-row grads
     back to the servers (server-side optimizer applies them), so the
-    embedding "trains" without the table ever living on device."""
+    embedding "trains" without the table ever living on device.
+
+    Caveats (by design, matching the reference's PS semantics): the push
+    happens DURING backward, so PS rows bypass trainer-side gradient
+    clipping; under AMP the hook divides by the active GradScaler's current
+    loss scale (amp.active_loss_scale) since unscale_() has not run yet."""
 
     def __init__(self, client, table, dim, lr=0.1, optimizer="sgd",
                  capacity_rows_per_server=2 ** 20):
@@ -424,8 +467,17 @@ class PsEmbedding:
         client, table = self.client, self.table
 
         def _push(grad):
+            from ..amp import active_loss_scale
             g = np.asarray(grad._data if hasattr(grad, "_data") else grad,
                            np.float32)
+            scale = active_loss_scale()
+            if scale != 1.0:   # AMP: grads are still loss-scale-multiplied
+                g = g / scale
+            if not np.isfinite(g).all():
+                # fp16 overflow step: GradScaler will skip the dense update;
+                # skipping the push keeps PS rows equally protected (a pushed
+                # inf would poison the table permanently)
+                return grad
             client.push(table, uniq, g)
             return grad
 

@@ -52,7 +52,13 @@ _BREAKS = (jax.errors.TracerBoolConversionError,
 
 
 class MissedCapture(Exception):
-    pass
+    """Replay/compile saw state the spy pass didn't record. ``permanent=True``
+    marks deterministic rejections (e.g. scan_steps restrictions) that re-spying
+    can never fix — the signature goes eager-only immediately."""
+
+    def __init__(self, msg, permanent=False):
+        super().__init__(msg)
+        self.permanent = permanent
 
 
 def _is_tensor(x):
@@ -194,7 +200,7 @@ class _CacheEntry:
     __slots__ = ("compiled", "mut_list", "ro_list", "write_list", "grad_list",
                  "grad_in_list", "out_treedef", "out_mask",
                  "treedef", "guard_kinds", "guard_ints",
-                 "scan_k", "scan_grad_slots")
+                 "scan_grad_slots", "scan_static")
 
     def __init__(self):
         self.compiled = None
@@ -349,7 +355,10 @@ class StaticFunction:
             self._spy_attempts[key] = attempts
             group.variants.remove(entry)
             group.last = None
-            if attempts < self.MAX_SPY_ATTEMPTS:
+            if getattr(e, "permanent", False):
+                logger.info("to_static: %s; signature stays eager", e)
+                group.eager_only = True
+            elif attempts < self.MAX_SPY_ATTEMPTS:
                 # state created during this spy (lazy-init accumulators) is
                 # external state next call — drop the entry so the next call
                 # re-spies with that state pre-existing and fully captured
@@ -546,16 +555,22 @@ class ScanStaticFunction(StaticFunction):
               if isinstance(l, Tensor) and getattr(l._buf, "ndim", 0) > 0}
         scalars = [l for l in leaves
                    if isinstance(l, Tensor) and getattr(l._buf, "ndim", 0) == 0]
-        if scalars or len(ks) != 1:
+        if scalars or len(ks) != 1 or 0 in ks:
             raise ValueError(
                 "scan_steps: every tensor argument must be stacked on one "
-                f"shared leading (step) dim; got leading dims {sorted(ks)}"
+                f"shared non-empty leading (step) dim; got leading dims "
+                f"{sorted(ks)}"
                 + (" plus scalar tensor args" if scalars else ""))
         return ks.pop()
 
     @staticmethod
     def _slice(leaves, i):
-        return [Tensor(l._buf[i], stop_gradient=l.stop_gradient, name=l.name)
+        # read through the dispatch unwrap so a nested capture (outer spy or
+        # replay) records/lifts the argument read instead of baking in the
+        # concrete capture-time buffer
+        from ..core.dispatch import unwrap
+        return [Tensor(unwrap(l)[i], stop_gradient=l.stop_gradient,
+                       name=l.name)
                 if isinstance(l, Tensor) else l for l in leaves]
 
     def _eager_scan(self, leaves, treedef, k):
@@ -578,6 +593,10 @@ class ScanStaticFunction(StaticFunction):
             if isinstance(leaf, Tensor):
                 stacked.append(
                     Tensor(jnp.stack([c[j]._buf for c in cols])))
+            elif hasattr(leaf, "dtype") and hasattr(leaf, "shape"):
+                # raw array leaf: stack to match the compiled path, which
+                # rides it through the scan ys as [K, ...]
+                stacked.append(jnp.stack([c[j] for c in cols]))
             else:
                 stacked.append(cols[-1][j])
         return jax.tree_util.tree_unflatten(rtree, stacked)
@@ -599,13 +618,13 @@ class ScanStaticFunction(StaticFunction):
         if guards:
             raise MissedCapture(
                 "scan_steps does not support value-guarded (bool()/int()) "
-                "data-dependent branches")
+                "data-dependent branches", permanent=True)
         if entry.grad_in_list:
             raise MissedCapture(
                 "scan_steps requires a self-contained step (no pre-existing "
-                "grads read; clear grads inside the step or use to_static)")
+                "grads read; clear grads inside the step or use to_static)",
+                permanent=True)
         k = self._pending_k
-        entry.scan_k = k
         pure_fn = self._build_pure_fn(entry, leaves, [])
         tensor_pos = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
 
@@ -619,8 +638,16 @@ class ScanStaticFunction(StaticFunction):
         # one abstract pass over the single step: surfaces graph breaks,
         # fills out_treedef/out_mask, and yields the grad-write structure so
         # non-None grads can ride the scan carry
-        shapes = jax.eval_shape(pure_fn, slice_shapes, mut_shapes,
-                                ro_shapes, [])
+        try:
+            shapes = jax.eval_shape(pure_fn, slice_shapes, mut_shapes,
+                                    ro_shapes, [])
+        except _BREAKS:
+            raise
+        except MissedCapture:
+            raise
+        except Exception as e:
+            raise MissedCapture(
+                f"step trace failed ({type(e).__name__}: {e})") from e
         _, write_shapes, grad_shapes, _ = shapes
         entry.scan_grad_slots = tuple(
             i for i, g in enumerate(grad_shapes) if g is not None)
@@ -634,6 +661,10 @@ class ScanStaticFunction(StaticFunction):
                 raise MissedCapture(
                     f"state tensor {t.name or id(t)!r} changes shape/dtype "
                     "across steps; scan_steps needs a shape-stable carry")
+        # non-Tensor output leaves: trace-time constants (python scalars)
+        # return as-is on every path; tracer-valued non-Tensor leaves (raw
+        # arrays) ride the scan ys. scan_static[j] holds the constants.
+        scan_static: dict[int, object] = {}
 
         def scan_fn(stacked_args, state_arrays, ro_arrays):
             def body(carry, xs):
@@ -641,8 +672,14 @@ class ScanStaticFunction(StaticFunction):
                 mut = [state[i] for i in mut_idx]
                 out_vals, write_out, grad_out, _ = pure_fn(
                     list(xs), mut, list(ro_arrays), [])
+                ys = []
+                for j, (v, m) in enumerate(zip(out_vals, entry.out_mask)):
+                    if m or isinstance(v, jax.core.Tracer):
+                        ys.append(v)
+                    else:
+                        scan_static[j] = v
                 new_grads = [grad_out[i] for i in grad_slots]
-                return (list(write_out), new_grads), list(out_vals)
+                return (list(write_out), new_grads), ys
 
             init_grads = [jnp.zeros(grad_shapes[i].shape,
                                     grad_shapes[i].dtype)
@@ -656,7 +693,13 @@ class ScanStaticFunction(StaticFunction):
             np.dtype(leaves[i]._buf.dtype)) for i in tensor_pos]
         state_shapes = [_sds(t._buf) for t in entry.write_list]
         try:
-            jax.eval_shape(scan_fn, stacked_shapes, state_shapes, ro_shapes)
+            from . import _code_level_value
+            if _code_level_value() > 0:
+                print(jax.make_jaxpr(scan_fn)(stacked_shapes, state_shapes,
+                                              ro_shapes))
+            else:
+                jax.eval_shape(scan_fn, stacked_shapes, state_shapes,
+                               ro_shapes)
         except _BREAKS:
             raise
         except MissedCapture:
@@ -664,6 +707,7 @@ class ScanStaticFunction(StaticFunction):
         except Exception as e:  # carry-structure mismatches etc.
             raise MissedCapture(
                 f"scan trace failed ({type(e).__name__}: {e})") from e
+        entry.scan_static = dict(scan_static)
         donate = (1,) if self._donate and entry.write_list else ()
         entry.compiled = jax.jit(scan_fn, donate_argnums=donate)
 
@@ -679,8 +723,13 @@ class ScanStaticFunction(StaticFunction):
         for i, t in enumerate(entry.grad_list):
             g = gmap.get(i)
             t._grad_buf = Tensor(g) if g is not None else None
-        out_leaves = [Tensor(v) if m else v
-                      for v, m in zip(ys, entry.out_mask)]
+        out_leaves, ys_it = [], iter(ys)
+        for j, m in enumerate(entry.out_mask):
+            if j in entry.scan_static:
+                out_leaves.append(entry.scan_static[j])
+            else:
+                v = next(ys_it)
+                out_leaves.append(Tensor(v) if m else v)
         return jax.tree_util.tree_unflatten(entry.out_treedef, out_leaves), None
 
 
