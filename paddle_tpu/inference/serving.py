@@ -1,25 +1,37 @@
 """Serving runtime for (sharded) LLMs — the role the reference fills with
 the FleetExecutor actor/interceptor pipeline for multi-stage inference
 (paddle/fluid/distributed/fleet_executor/carrier.cc) plus the paged
-KV-cache fused ops (phi/kernels/fusion block_multi_head_attention).
+KV-cache fused ops (phi/kernels/fusion block_multi_head_attention; the
+encoder/decoder split there is seq_lens_encoder vs seq_lens_decoder,
+python/paddle/incubate/nn/functional/block_multihead_attention.py:33, and
+sampling is in-op via phi top_p_sampling).
 
 TPU-native design:
-- ONE jitted token step serves the whole engine. Requests are admitted into
-  fixed slots; a slot still consuming its prompt feeds prompt tokens, a slot
-  past its prompt feeds its last generated token — token-level continuous
-  batching (Orca-style) with no separate prefill program or shape buckets.
+- TWO jitted programs serve the whole engine:
+  * a PREFILL step consuming a CHUNK of prompt tokens for one slot per
+    dispatch (chunk rows ride the paged-attention kernel's batch dim with
+    per-row context lengths, so causal masking falls out of ctx=pos+1), and
+  * a DECODE step feeding every in-flight slot its last token — token-level
+    continuous batching (Orca-style).
+  A P-token prompt costs ceil(P/chunk) dispatches before its first token,
+  not P (the r3 engine fed one prompt token per dispatch).
+- Sampling happens IN-GRAPH with per-slot parameters (greedy / temperature /
+  top-k / top-p / seed), replicating models.llama._sample token-for-token so
+  an engine decode with the same seed matches model.generate.
 - KV lives in PAGES [L, n_pages, page, KVH, D] with host-managed per-slot
-  page tables; decode attention runs against the paged cache
-  (ops/pallas/paged_attention kernel on a single TPU chip; the partitionable
-  jnp formulation under GSPMD meshes, where XLA shards the gathers).
+  page tables. Pages are allocated ON DEMAND: admit reserves only the
+  prompt's pages and decode grows by one page at boundary crossings, so a
+  `page_pool` SMALLER than the worst case (the HBM budget knob)
+  oversubscribes safely — when the pool runs dry the youngest slot is
+  preempted back to the waiting queue (vLLM-style recompute).
 - Weights are extracted from the model once, stacked [L, ...] and placed
-  with NamedShardings: layers sharded over the pp axis (stage-partitioned
-  memory), head/ffn dims over the mp axis. The step function is pure jax
-  over those arrays; GSPMD inserts the collectives.
+  with NamedShardings: layers sharded over the pp axis, head/ffn dims over
+  the mp axis. GSPMD inserts the collectives.
 """
 from __future__ import annotations
 
 import math
+import time
 from collections import deque
 
 import numpy as np
@@ -29,17 +41,29 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 __all__ = ["LLMEngine", "Request"]
 
+_MAXK = 64        # static cap for per-slot dynamic top-k filtering
+
 
 class Request:
-    def __init__(self, rid, prompt_ids, max_new_tokens, eos_token_id=None):
+    def __init__(self, rid, prompt_ids, max_new_tokens, eos_token_id=None,
+                 do_sample=False, temperature=1.0, top_p=1.0, top_k=0,
+                 seed=None):
         self.rid = rid
         self.prompt = list(int(t) for t in np.asarray(prompt_ids).reshape(-1))
         self.max_new = int(max_new_tokens)
         self.eos = eos_token_id
+        self.do_sample = bool(do_sample)
+        self.temperature = float(temperature)
+        self.top_p = float(top_p)
+        self.top_k = int(top_k)
+        self.seed = seed
         self.out: list[int] = []
-        self.pos = 0                 # tokens already fed to the engine
+        self.pos = 0                 # prompt tokens already prefilled
         self.slot = None
         self.done = False
+        self.admit_seq = -1          # preemption picks the youngest
+        self.t_submit = time.perf_counter()
+        self.ttft = None             # seconds to first generated token
 
 
 def _rope(x, pos, theta):
@@ -62,19 +86,67 @@ def _rms(x, w, eps):
         x.dtype)
 
 
+def _sample_row(logits, greedy, temp, topp, topk, seed):
+    """One row of in-graph sampling, replicating models.llama._sample +
+    ops.top_p_sampling (same filter order, same sort, same categorical
+    key/shape) so a SEEDED top_p<1 engine decode == model.generate.
+    (At top_p>=1.0, generate falls through to ops.multinomial on the global
+    RNG stream, which ignores the seed — no parity is possible there by
+    construction.) logits [V] f32; scalars traced."""
+    maxk = min(_MAXK, logits.shape[-1])
+    amax = jnp.argmax(logits)
+    l = logits / jnp.where(temp > 0, temp, 1.0)
+    probs = jax.nn.softmax(l)
+    # top-k (0 = off): zero everything below the k-th largest prob
+    kvals, _ = jax.lax.top_k(probs, maxk)
+    thresh = kvals[jnp.clip(topk - 1, 0, maxk - 1)]
+    probs = jnp.where((topk > 0) & (probs < thresh), 0.0, probs)
+    probs = probs / jnp.sum(probs)
+    # top-p over the full sorted vocab (ops.top_p_sampling's formulation)
+    sort_idx = jnp.argsort(-probs)
+    sorted_p = probs[sort_idx]
+    cum = jnp.cumsum(sorted_p)
+    keep = jnp.where(topp < 1.0, (cum - sorted_p) < topp, sorted_p >= 0)
+    filtered = jnp.where(keep, sorted_p, 0.0)
+    filtered = filtered / jnp.sum(filtered)
+    key = jax.random.PRNGKey(seed)
+    # [1, V] shape matches the b=1 categorical in ops.top_p_sampling, so the
+    # gumbel draw is bit-identical at equal keys
+    choice = jax.random.categorical(
+        key, jnp.log(jnp.maximum(filtered, 1e-30))[None, :], axis=-1)[0]
+    tok = sort_idx[choice]
+    return jnp.where(greedy > 0, amax, tok).astype(jnp.int32)
+
+
 class LLMEngine:
     """Continuous-batching paged-KV engine over a LlamaForCausalLM."""
 
     def __init__(self, model, mesh=None, mp_axis="mp", pp_axis="pp",
-                 max_batch=4, max_len=256, page_size=16, use_kernel=None):
+                 max_batch=4, max_len=256, page_size=16, prefill_chunk=32,
+                 page_pool=None, decode_block=1, use_kernel=None, seed=0):
+        """page_pool: usable KV pages (the HBM budget). Defaults to the
+        worst case (max_batch * ceil(max_len/page)); set it SMALLER to
+        oversubscribe — on-demand growth means slots only claim what they
+        use, and a dry pool preempts the youngest slot (recompute).
+
+        decode_block: max decode steps fused into one dispatch (power-of-two
+        blocks are chosen per step, shrinking near max_new; eos-bearing
+        requests force 1). Raise it when dispatch latency, not throughput,
+        dominates (e.g. a remote/tunneled runtime)."""
         cfg = model.config
         self.cfg = cfg
         self.max_batch = max_batch
         self.max_len = max_len
         self.page = page_size
+        self.chunk = int(prefill_chunk)
         self.pages_per_slot = math.ceil(max_len / page_size)
+        if page_pool is None:
+            page_pool = max_batch * self.pages_per_slot
+        if page_pool < self.pages_per_slot:
+            raise ValueError("page_pool must cover at least one max_len "
+                             f"request ({self.pages_per_slot} pages)")
         # +1: a trash page absorbing the (masked-out) writes of inactive slots
-        self.n_pages = max_batch * self.pages_per_slot + 1
+        self.n_pages = int(page_pool) + 1
         self.trash_page = self.n_pages - 1
         self.mesh = mesh
         L = cfg.num_hidden_layers
@@ -142,79 +214,165 @@ class LLMEngine:
         self._slot_tables = np.zeros((max_batch, self.pages_per_slot),
                                      np.int32)
         self._lens = np.zeros((max_batch,), np.int32)
+        self._n_alloc = np.zeros((max_batch,), np.int32)
         self._waiting: deque = deque()
         self._finished: dict = {}
         self._next_rid = 0
-        self._step = self._build_step()
+        self._admit_seq = 0
+        self._seed_counter = np.int64(seed) * 1_000_003
+        self.preemptions = 0
+        self.decode_block = max(1, int(decode_block))
+        self._decode_programs: dict = {}
+        self._prefill = self._build_prefill()
+
+    # ---------------------------------------------------------------- layers
+    def _layer_fn(self, page_idx, within, tables, ctx, pos):
+        """Shared per-layer body for decode and prefill (they differ only in
+        how many rows ride the batch dim and where those rows' pages are)."""
+        nh, kvh, D = self.nh, self.kvh, self.D
+        eps = self.cfg.rms_norm_eps
+        theta = self.cfg.rope_theta
+        use_kernel = self.use_kernel
+
+        def layer(carry, wl):
+            x, = carry
+            h = _rms(x, wl["ln1"], eps)
+            q = (h @ wl["wq"]).reshape(-1, nh, D)
+            k = (h @ wl["wk"]).reshape(-1, kvh, D)
+            v = (h @ wl["wv"]).reshape(-1, kvh, D)
+            q = _rope(q, pos, theta)
+            k = _rope(k, pos, theta)
+            kpl = wl["kp"].at[page_idx, within].set(k)
+            vpl = wl["vp"].at[page_idx, within].set(v)
+            if use_kernel:
+                from ..ops.pallas.paged_attention import paged_attention
+                att = paged_attention(q, kpl, vpl, tables, ctx)
+            else:
+                from ..ops.pallas.paged_attention import paged_attention_ref
+                att = paged_attention_ref(q, kpl, vpl, tables, ctx)
+            x = x + att.reshape(-1, nh * D) @ wl["wo"]
+            h = _rms(x, wl["ln2"], eps)
+            gate = h @ wl["wg"]
+            up = h @ wl["wu"]
+            x = x + (jax.nn.silu(gate.astype(jnp.float32)).astype(
+                up.dtype) * up) @ wl["wd"]
+            return (x,), (kpl, vpl)
+
+        return layer
+
+    def _scan_layers(self, W, kp, vp, x, layer):
+        per_layer = {k: W[k] for k in
+                     ("wq", "wk", "wv", "wo", "ln1", "ln2",
+                      "wg", "wu", "wd")}
+        per_layer["kp"] = kp
+        per_layer["vp"] = vp
+        (x,), (kp2, vp2) = jax.lax.scan(layer, (x,), per_layer)
+        return x, kp2, vp2
 
     # ------------------------------------------------------------------ step
-    def _build_step(self):
+    def _build_decode(self, K):
+        """K decode steps fused into ONE dispatch (token feedback stays
+        in-graph via lax.scan) — through a remote dispatch path each host
+        round trip costs RTT, which a per-token loop pays in full; a K-block
+        pays RTT/K. The host sees the K sampled tokens afterwards, so eos
+        requests cap K at 1 (every token must be inspected). Mirrors
+        generate()'s tokens_per_dispatch."""
         cfg = self.cfg
-        nh, kvh, D = self.nh, self.kvh, self.D
         page = self.page
         eps = cfg.rms_norm_eps
-        theta = cfg.rope_theta
-        use_kernel = self.use_kernel
         trash = self.trash_page
 
-        def step(W, kp, vp, tokens, lens, tables, active):
+        def block(W, kp, vp, tokens, lens, tables, active,
+                  greedy, temp, topp, topk, seeds, fold):
             # tokens [B] int32; lens [B] tokens already cached; tables
-            # [B, S] page ids; active [B] 0/1
-            x = W["embed"][tokens]                       # [B, H]
-            pos = lens.astype(jnp.int32)
-            page_idx = jnp.take_along_axis(
-                tables, (pos // page)[:, None], axis=1)[:, 0]
-            # inactive slots write into the trash page, never a live one
-            page_idx = jnp.where(active > 0, page_idx, trash)
+            # [B, S] page ids; active [B] 0/1; sampling params [B].
+            # fold [B]: 1 -> vary the sampling key per block step (seedless
+            # requests); 0 -> reuse it (fixed-seed generate parity).
+            def one(carry, i):
+                tokens, lens, kp, vp = carry
+                x = W["embed"][tokens]                   # [B, H]
+                pos = lens.astype(jnp.int32)
+                page_idx = jnp.take_along_axis(
+                    tables, (pos // page)[:, None], axis=1)[:, 0]
+                # inactive slots write into the trash page, never a live one
+                page_idx = jnp.where(active > 0, page_idx, trash)
+                within = pos % page
+                ctx = jnp.where(active > 0, pos + 1, 1).astype(jnp.int32)
+                layer = self._layer_fn(page_idx, within, tables, ctx, pos)
+                x, kp, vp = self._scan_layers(W, kp, vp, x, layer)
+                h = _rms(x, W["norm"], eps)
+                logits = h.astype(jnp.float32) @ W["head"].astype(
+                    jnp.float32)
+                # one vmapped sampler, not B inlined sort/cumsum subgraphs
+                nxt = jax.vmap(_sample_row)(logits, greedy, temp, topp,
+                                            topk, seeds + i * fold)
+                tokens = jnp.where(active > 0, nxt, tokens)
+                lens = lens + (active > 0).astype(lens.dtype)
+                return (tokens, lens, kp, vp), nxt
+
+            (_, _, kp2, vp2), toks = jax.lax.scan(
+                one, (tokens, lens, kp, vp),
+                jnp.arange(K, dtype=jnp.int32))
+            return toks, kp2, vp2                        # toks [K, B]
+
+        return jax.jit(block, donate_argnums=(1, 2))
+
+    def _build_prefill(self):
+        cfg = self.cfg
+        page = self.page
+        eps = cfg.rms_norm_eps
+        trash = self.trash_page
+        C = self.chunk
+
+        def prefill(W, kp, vp, tokens, start, table, n_valid,
+                    greedy, temp, topp, topk, seed):
+            # tokens [C] int32 (one slot's prompt chunk, zero-padded);
+            # start scalar; table [S]; n_valid scalar <= C. Chunk rows ride
+            # the paged-attention BATCH dim: row i gets ctx = start+i+1, so
+            # in-chunk causality and attention to the already-cached prefix
+            # both fall out of the per-row context length.
+            x = W["embed"][tokens]                       # [C, H]
+            offs = jnp.arange(C, dtype=jnp.int32)
+            pos = start.astype(jnp.int32) + offs
+            valid = offs < n_valid
+            page_idx = table[pos // page]
+            page_idx = jnp.where(valid, page_idx, trash)
             within = pos % page
-            ctx = jnp.where(active > 0, pos + 1, 1).astype(jnp.int32)
-
-            def layer(carry, wl):
-                x, = carry
-                h = _rms(x, wl["ln1"], eps)
-                q = (h @ wl["wq"]).reshape(-1, nh, D)
-                k = (h @ wl["wk"]).reshape(-1, kvh, D)
-                v = (h @ wl["wv"]).reshape(-1, kvh, D)
-                q = _rope(q, pos, theta)
-                k = _rope(k, pos, theta)
-                kpl = wl["kp"].at[page_idx, within].set(k)
-                vpl = wl["vp"].at[page_idx, within].set(v)
-                if use_kernel:
-                    from ..ops.pallas.paged_attention import paged_attention
-                    att = paged_attention(q, kpl, vpl, tables, ctx)
-                else:
-                    from ..ops.pallas.paged_attention import \
-                        paged_attention_ref
-                    att = paged_attention_ref(q, kpl, vpl, tables, ctx)
-                x = x + att.reshape(-1, nh * D) @ wl["wo"]
-                h = _rms(x, wl["ln2"], eps)
-                gate = h @ wl["wg"]
-                up = h @ wl["wu"]
-                x = x + (jax.nn.silu(gate.astype(jnp.float32)).astype(
-                    up.dtype) * up) @ wl["wd"]
-                return (x,), (kpl, vpl)
-
-            per_layer = {k: W[k] for k in
-                         ("wq", "wk", "wv", "wo", "ln1", "ln2",
-                          "wg", "wu", "wd")}
-            per_layer["kp"] = kp
-            per_layer["vp"] = vp
-            (x,), (kp2, vp2) = jax.lax.scan(layer, (x,), per_layer)
+            ctx = jnp.where(valid, pos + 1, 1).astype(jnp.int32)
+            tables = jnp.broadcast_to(table[None, :], (C, table.shape[0]))
+            layer = self._layer_fn(page_idx, within, tables, ctx, pos)
+            x, kp2, vp2 = self._scan_layers(W, kp, vp, x, layer)
             h = _rms(x, W["norm"], eps)
-            logits = h.astype(jnp.float32) @ W["head"].astype(jnp.float32)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            last = h[jnp.maximum(n_valid - 1, 0)]
+            logits = last.astype(jnp.float32) @ W["head"].astype(jnp.float32)
+            nxt = _sample_row(logits, greedy, temp, topp, topk, seed)
             return nxt, kp2, vp2
 
-        return jax.jit(step, donate_argnums=(1, 2))
+        return jax.jit(prefill, donate_argnums=(1, 2))
 
     # ------------------------------------------------------------- scheduling
-    def add_request(self, prompt_ids, max_new_tokens=16, eos_token_id=None):
+    def add_request(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
+                    do_sample=False, temperature=1.0, top_p=1.0, top_k=0,
+                    seed=None):
         n_prompt = int(np.asarray(prompt_ids).reshape(-1).shape[0])
-        if n_prompt >= self.max_len:
+        if n_prompt == 0:
+            raise ValueError("empty prompt")
+        if int(max_new_tokens) < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if n_prompt + int(max_new_tokens) > self.max_len:
+            # admitting would silently truncate at max_len (ADVICE r3): the
+            # caller must choose — raise max_len or shrink the request
             raise ValueError(
-                f"prompt length {n_prompt} >= engine max_len {self.max_len}; "
-                "raise max_len or truncate the prompt")
-        r = Request(self._next_rid, prompt_ids, max_new_tokens, eos_token_id)
+                f"prompt ({n_prompt}) + max_new_tokens ({max_new_tokens}) "
+                f"> engine max_len ({self.max_len})")
+        vocab = self.cfg.vocab_size
+        if int(top_k) > min(_MAXK, vocab):
+            raise ValueError(
+                f"top_k={top_k} exceeds the engine's in-graph cap "
+                f"{min(_MAXK, vocab)} (static top-k window)")
+        r = Request(self._next_rid, prompt_ids, max_new_tokens, eos_token_id,
+                    do_sample=do_sample, temperature=temperature,
+                    top_p=top_p, top_k=top_k, seed=seed)
         self._next_rid += 1
         self._waiting.append(r)
         return r.rid
@@ -224,61 +382,172 @@ class LLMEngine:
             if self._slots[slot] is not None or not self._waiting:
                 continue
             r = self._waiting[0]
-            need = math.ceil(min(len(r.prompt) + r.max_new,
-                                 self.max_len) / self.page)
+            # on-demand paging: reserve only the PROMPT's pages; decode
+            # grows page-by-page (cf. the r3 engine's worst-case
+            # prompt+max_new reservation, which gave paging no benefit)
+            need = math.ceil(len(r.prompt) / self.page)
             if len(self._free_pages) < need:
                 break
             self._waiting.popleft()
             pages = [self._free_pages.popleft() for _ in range(need)]
             self._slot_tables[slot, :need] = pages
-            self._slot_tables[slot, need:] = pages[-1] if pages else 0
+            self._slot_tables[slot, need:] = pages[-1]
+            self._n_alloc[slot] = need
             self._lens[slot] = 0
+            r.pos = 0
             r.slot = slot
+            r.admit_seq = self._admit_seq
+            self._admit_seq += 1
             self._slots[slot] = r
 
-    def _release(self, slot):
+    def _release(self, slot, finished=True):
         r = self._slots[slot]
-        need = math.ceil(min(len(r.prompt) + r.max_new,
-                             self.max_len) / self.page)
-        for p in self._slot_tables[slot, :need]:
+        for p in self._slot_tables[slot, :int(self._n_alloc[slot])]:
             self._free_pages.append(int(p))
         self._slots[slot] = None
         self._lens[slot] = 0
-        r.done = True
-        self._finished[r.rid] = r
+        self._n_alloc[slot] = 0
+        if finished:
+            r.done = True
+            self._finished[r.rid] = r
+
+    def _preempt_youngest(self, excluding):
+        """Free the youngest slot's pages, requeueing it for recompute
+        (prompt := prompt + generated so far). Returns True if one was
+        preempted."""
+        victims = [(r.admit_seq, s) for s, r in enumerate(self._slots)
+                   if r is not None and s != excluding]
+        if not victims:
+            return False
+        _, slot = max(victims)
+        r = self._slots[slot]
+        r.prompt = r.prompt + r.out
+        self._release(slot, finished=False)
+        r.slot = None
+        self._waiting.appendleft(r)
+        self.preemptions += 1
+        return True
+
+    def _ensure_page(self, slot, ahead=1):
+        """Grow slot's page table to cover `ahead` more tokens; preempt the
+        youngest other slot if the pool is dry."""
+        needed = (int(self._lens[slot]) + ahead + self.page - 1) // self.page
+        while int(self._n_alloc[slot]) < needed:
+            if not self._free_pages:
+                if not self._preempt_youngest(excluding=slot):
+                    raise RuntimeError(
+                        "page pool exhausted with a single slot — engine "
+                        "misconfigured (max_len vs page pool)")
+                continue
+            p = self._free_pages.popleft()
+            na = int(self._n_alloc[slot])
+            self._slot_tables[slot, na] = p
+            self._slot_tables[slot, na + 1:] = p
+            self._n_alloc[slot] = na + 1
+
+    def _next_seed(self, r):
+        if r.seed is not None:
+            return int(r.seed)       # fixed seed: matches model.generate
+        self._seed_counter += 1
+        return int(self._seed_counter % (2 ** 31 - 1))
+
+    def _emit(self, slot, token):
+        """Record one generated token; release the slot when finished."""
+        r = self._slots[slot]
+        r.out.append(int(token))
+        if r.ttft is None:
+            r.ttft = time.perf_counter() - r.t_submit
+        hit_eos = (r.eos is not None and r.out[-1] == r.eos)
+        if (len(r.out) >= r.max_new or hit_eos
+                or int(self._lens[slot]) >= self.max_len):
+            self._release(slot)
+
+    def _prefill_chunk(self, slot):
+        r = self._slots[slot]
+        start = r.pos
+        n = min(self.chunk, len(r.prompt) - start)
+        toks = np.zeros((self.chunk,), np.int32)
+        toks[:n] = r.prompt[start:start + n]
+        finishes = (start + n) == len(r.prompt)
+        nxt, self.kp, self.vp = self._prefill(
+            self.W, self.kp, self.vp, jnp.asarray(toks),
+            jnp.asarray(np.int32(start)),
+            jnp.asarray(self._slot_tables[slot]),
+            jnp.asarray(np.int32(n)),
+            jnp.asarray(np.int32(0 if r.do_sample else 1)),
+            jnp.asarray(np.float32(r.temperature)),
+            jnp.asarray(np.float32(r.top_p)),
+            jnp.asarray(np.int32(r.top_k)),
+            jnp.asarray(np.int32(self._next_seed(r))))
+        r.pos += n
+        self._lens[slot] = start + n
+        if finishes:
+            self._emit(slot, int(np.asarray(nxt)))
 
     def step(self):
-        """One engine token-step. Returns #active slots served."""
+        """One engine dispatch: a prefill chunk if any slot is mid-prompt,
+        else one decode token for every active slot. Returns #slots
+        served."""
         self._admit()
+        for slot, r in enumerate(self._slots):
+            if r is not None and r.pos < len(r.prompt):
+                self._prefill_chunk(slot)
+                return 1
+        live = [(s, r) for s, r in enumerate(self._slots) if r is not None]
+        if not live:
+            return 0
+        # block size: largest power of two <= every slot's remaining budget,
+        # capped by decode_block; any eos request needs per-token host
+        # inspection -> 1
+        k = min(self.decode_block,
+                min(r.max_new - len(r.out) for _, r in live))
+        if any(r.eos is not None for _, r in live):
+            k = 1
+        k = 1 << max(0, k.bit_length() - 1)              # floor to pow2
         active = np.zeros((self.max_batch,), np.int32)
         tokens = np.zeros((self.max_batch,), np.int32)
-        for slot, r in enumerate(self._slots):
-            if r is None:
-                continue
-            active[slot] = 1
-            if r.pos < len(r.prompt):
-                tokens[slot] = r.prompt[r.pos]
-            else:
-                tokens[slot] = r.out[-1]
-        if not active.any():
+        greedy = np.ones((self.max_batch,), np.int32)
+        temp = np.ones((self.max_batch,), np.float32)
+        topp = np.ones((self.max_batch,), np.float32)
+        topk = np.zeros((self.max_batch,), np.int32)
+        seeds = np.zeros((self.max_batch,), np.int32)
+        fold = np.zeros((self.max_batch,), np.int32)
+        for slot, r in live:
+            if self._slots[slot] is not r:
+                continue        # preempted by an earlier slot's growth
+            self._ensure_page(slot, ahead=k)
+        # growth may have preempted members of `live` — drop them before
+        # building the batch (a stale entry would re-allocate pages to an
+        # empty slot and decode a request that is back in the queue)
+        live = [(s, r) for s, r in live if self._slots[s] is r]
+        if not live:
             return 0
-        nxt, self.kp, self.vp = self._step(
+        for slot, r in live:
+            active[slot] = 1
+            tokens[slot] = r.out[-1]
+            greedy[slot] = 0 if r.do_sample else 1
+            temp[slot] = r.temperature
+            topp[slot] = r.top_p
+            topk[slot] = r.top_k
+            seeds[slot] = self._next_seed(r)
+            fold[slot] = 1 if r.seed is None else 0
+        prog = self._decode_programs.get(k)
+        if prog is None:
+            prog = self._decode_programs[k] = self._build_decode(k)
+        toks, self.kp, self.vp = prog(
             self.W, self.kp, self.vp, jnp.asarray(tokens),
             jnp.asarray(self._lens), jnp.asarray(self._slot_tables),
-            jnp.asarray(active))
-        nxt = np.asarray(nxt)
-        for slot, r in enumerate(self._slots):
-            if r is None:
-                continue
-            self._lens[slot] += 1
-            r.pos += 1
-            if r.pos >= len(r.prompt):          # past prefill: token emitted
-                r.out.append(int(nxt[slot]))
-                hit_eos = (r.eos is not None and r.out[-1] == r.eos)
-                if (len(r.out) >= r.max_new or hit_eos or
-                        self._lens[slot] >= self.max_len):
-                    self._release(slot)
-        return int(active.sum())
+            jnp.asarray(active), jnp.asarray(greedy), jnp.asarray(temp),
+            jnp.asarray(topp), jnp.asarray(topk), jnp.asarray(seeds),
+            jnp.asarray(fold))
+        toks = np.asarray(toks)                          # [k, B]
+        for j in range(k):
+            for slot, r in live:
+                if self._slots[slot] is not r:           # released mid-block
+                    continue
+                self._lens[slot] += 1
+                self._emit(slot, int(toks[j, slot]))
+        return len(live)
 
     def run_until_done(self, max_steps=10000):
         steps = 0
@@ -290,3 +559,7 @@ class LLMEngine:
 
     def result(self, rid):
         return self._finished[rid].out
+
+    def ttft(self, rid):
+        """Seconds from add_request to the first generated token."""
+        return self._finished[rid].ttft

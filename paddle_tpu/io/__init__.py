@@ -255,6 +255,68 @@ class DistributedBatchSampler(BatchSampler):
 
 
 # ---- collate -----------------------------------------------------------------
+class BucketCollate:
+    """Pad variable-length token sequences to power-of-two length BUCKETS so a
+    compiled train step (jit.to_static / jit.scan_steps) traces once per
+    bucket instead of once per distinct length — the training-side analog of
+    generate()'s decode-length buckets (models/llama.py). XLA programs have
+    static shapes; without bucketing, mixed-length pretraining data retraces
+    per exact length (reference runs dynamic shapes natively in dygraph:
+    python/paddle/jit/sot — SURVEY §7 hard part #5).
+
+    Right-padding is loss-exact for causal LMs: padded positions sit after
+    every valid token, so causal attention never lets a valid position see
+    them, and labels at pads are `label_pad` (cross_entropy ignore_index).
+
+    collate(batch_of_1d_sequences) -> (ids [B, S_bucket], labels [B, S_bucket])
+    with labels = next-token targets when make_labels=True, else ids only.
+    """
+
+    def __init__(self, pad_value=0, label_pad=-100, floor=32, max_len=None,
+                 make_labels=True):
+        self.pad_value = int(pad_value)
+        self.label_pad = int(label_pad)
+        self.floor = int(floor)
+        self.max_len = max_len
+        self.make_labels = make_labels
+
+    def bucket_length(self, n):
+        b = max(self.floor, 1 << max(0, (int(n) - 1).bit_length()))
+        return min(b, self.max_len) if self.max_len else b
+
+    def __call__(self, batch):
+        seqs = [np.asarray(s._data if isinstance(s, Tensor) else s).reshape(-1)
+                for s in batch]
+        if self.max_len:
+            seqs = [s[:self.max_len] for s in seqs]
+        need = 2 if self.make_labels else 1
+        short = [i for i, s in enumerate(seqs) if len(s) < need]
+        if short:
+            raise ValueError(
+                f"BucketCollate: samples {short} are shorter than {need} "
+                "tokens" + (" (make_labels needs an input AND a target; a "
+                            "1-token sample would contribute only ignored "
+                            "labels and an all-short batch would NaN the "
+                            "loss)" if self.make_labels else ""))
+        longest = max(len(s) for s in seqs)
+        S = self.bucket_length(longest if not self.make_labels
+                               else longest - 1)
+        if self.make_labels:
+            # sample [n] -> inputs [:-1], next-token labels [1:]; pads get
+            # label_pad so the loss ignores them
+            ids = np.full((len(seqs), S), self.pad_value, np.int32)
+            labels = np.full((len(seqs), S), self.label_pad, np.int32)
+            for i, s in enumerate(seqs):
+                n = len(s) - 1
+                ids[i, :n] = s[:-1]
+                labels[i, :n] = s[1:]
+            return Tensor(ids), Tensor(labels)
+        ids = np.full((len(seqs), S), self.pad_value, np.int32)
+        for i, s in enumerate(seqs):
+            ids[i, :len(s)] = s
+        return Tensor(ids)
+
+
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, Tensor):

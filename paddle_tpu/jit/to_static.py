@@ -602,15 +602,25 @@ class ScanStaticFunction(StaticFunction):
         return jax.tree_util.tree_unflatten(rtree, stacked)
 
     def _spy_scan(self, key, leaves, treedef, k):
+        from ..core import flags
         self._pending_k = k
         # slice 0 runs under the spy (records reads/writes, compiles the
-        # scan); the remaining slices run plain-eager so the capturing call
-        # still performs all K steps with exact per-slice semantics
+        # scan); the remaining slices run eagerly so the capturing call
+        # still performs all K steps with exact per-slice semantics.
+        # FLAGS_eager_recompute_grad keeps those warmup slices on the
+        # deferred-vjp memory profile (the spy's own mode) — plain eager
+        # holds per-op jax.vjp residuals and OOMs at capture on geometries
+        # the compiled scan itself fits comfortably
         results = [self._spy(key, self._slice(leaves, 0), treedef)]
-        for i in range(1, k):
-            args, kwargs = jax.tree_util.tree_unflatten(
-                treedef, self._slice(leaves, i))
-            results.append(self._fn(*args, **kwargs))
+        prev = flags.flag("eager_recompute_grad")
+        flags.set_flags({"FLAGS_eager_recompute_grad": True})
+        try:
+            for i in range(1, k):
+                args, kwargs = jax.tree_util.tree_unflatten(
+                    treedef, self._slice(leaves, i))
+                results.append(self._fn(*args, **kwargs))
+        finally:
+            flags.set_flags({"FLAGS_eager_recompute_grad": prev})
         return self._stack_results(results)
 
     def _compile(self, entry, leaves, guards=()):
@@ -674,8 +684,11 @@ class ScanStaticFunction(StaticFunction):
                     list(xs), mut, list(ro_arrays), [])
                 ys = []
                 for j, (v, m) in enumerate(zip(out_vals, entry.out_mask)):
-                    if m or isinstance(v, jax.core.Tracer):
-                        ys.append(v)
+                    # array-valued leaves (traced OR constant) ride the scan
+                    # ys as [K, ...] — matching _stack_results on the eager
+                    # capture call; only python scalars stay static
+                    if m or (hasattr(v, "dtype") and hasattr(v, "shape")):
+                        ys.append(jnp.asarray(v))
                     else:
                         scan_static[j] = v
                 new_grads = [grad_out[i] for i in grad_slots]
