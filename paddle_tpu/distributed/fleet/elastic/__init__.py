@@ -200,11 +200,19 @@ class PreemptionCheckpointer:
             np.savez(f, **arrays)
         os.replace(tmp, os.path.join(d, f"rank_{self.rank}.npz"))
         with open(os.path.join(d, f"rank_{self.rank}.done"), "w") as f:
-            json.dump({"rank": self.rank, "step": step}, f)
+            # world in the marker: a restart at a different scale must judge
+            # completeness against the world that WROTE the step, not its own
+            json.dump({"rank": self.rank, "step": step,
+                       "world": self.world}, f)
 
     # -- restart plane --------------------------------------------------------
-    def latest_complete_step(self):
+    def _scan(self):
+        """Newest step whose WRITER world is fully done -> (step, world).
+        The writer world comes from the done markers themselves (markers
+        written before r4 carry no world field and are judged against the
+        current world)."""
         import glob
+        import json
         import os
         best = None
         for d in glob.glob(os.path.join(self.root, "step_*")):
@@ -212,26 +220,55 @@ class PreemptionCheckpointer:
                 k = int(os.path.basename(d).split("_")[1])
             except ValueError:
                 continue
+            markers = glob.glob(os.path.join(d, "rank_*.done"))
+            if not markers:
+                continue
+            try:
+                with open(sorted(markers)[0]) as f:
+                    writer_world = int(json.load(f).get("world", self.world))
+            except (OSError, ValueError):
+                writer_world = self.world
             done = [os.path.exists(os.path.join(d, f"rank_{r}.done"))
-                    for r in range(self.world)]
-            if all(done) and (best is None or k > best):
-                best = k
+                    for r in range(writer_world)]
+            if all(done) and (best is None or k > best[0]):
+                best = (k, writer_world)
         return best
+
+    def latest_complete_step(self):
+        found = self._scan()
+        return None if found is None else found[0]
 
     def resume(self):
         """Load the newest complete checkpoint into the live state (in place
         on the get_state() tensors, then set_state for anything else).
         Returns the step to continue FROM, or None when no complete
-        checkpoint exists (fresh start)."""
+        checkpoint exists (fresh start).
+
+        World-size changes (reference elastic scale-in/out,
+        fleet/elastic/manager.py:125,177): when the checkpoint was written by
+        a DIFFERENT world, rank r restores rank r % writer_world's shard.
+        For the state this checkpointer holds — data-parallel-replicated
+        params/optimizer moments and host counters — every writer shard
+        agrees, so the mapping IS the reshard. Genuinely sharded device
+        state (ZeRO/mp) belongs in paddle_tpu.distributed.checkpoint (orbax),
+        which reshards on load by sharding spec."""
         import os
+        import logging
         import numpy as np
         import jax.numpy as jnp
-        k = self.latest_complete_step()
-        if k is None:
+        found = self._scan()
+        if found is None:
             return None
+        k, writer_world = found
+        src_rank = self.rank % writer_world
+        if writer_world != self.world:
+            logging.getLogger("paddle_tpu.elastic").warning(
+                "resuming step %d written by world=%d at world=%d: rank %d "
+                "restores shard %d (replicated-state reshard)",
+                k, writer_world, self.world, self.rank, src_rank)
         state = self.get_state()
         with np.load(os.path.join(self.root, f"step_{k}",
-                                  f"rank_{self.rank}.npz")) as z:
+                                  f"rank_{src_rank}.npz")) as z:
             for key, dst in state.items():
                 if key not in z:
                     raise KeyError(f"checkpoint missing key {key}")

@@ -50,6 +50,7 @@ class Request:
                  seed=None):
         self.rid = rid
         self.prompt = list(int(t) for t in np.asarray(prompt_ids).reshape(-1))
+        self.prompt0 = list(self.prompt)   # original; preemption re-folds
         self.max_new = int(max_new_tokens)
         self.eos = eos_token_id
         self.do_sample = bool(do_sample)
@@ -421,7 +422,10 @@ class LLMEngine:
             return False
         _, slot = max(victims)
         r = self._slots[slot]
-        r.prompt = r.prompt + r.out
+        # recompute prompt = ORIGINAL prompt + everything generated so far —
+        # folding the current (possibly already-folded) prompt would
+        # duplicate earlier output on a second preemption
+        r.prompt = r.prompt0 + r.out
         self._release(slot, finished=False)
         r.slot = None
         self._waiting.appendleft(r)

@@ -433,3 +433,23 @@ class TestEngineRound4:
             outs[blk] = [eng.result(r) for r in rids]
         assert outs[1] == outs[4], (outs[1], outs[4])
         assert steps[4] < steps[1]
+
+    def test_repeated_preemption_no_prompt_double_fold(self):
+        """A request preempted TWICE must re-fold original_prompt + out, not
+        compound the earlier fold (which duplicated context and overflowed
+        the page table)."""
+        import numpy as np
+        from paddle_tpu.inference.serving import LLMEngine
+        m = self._model()
+        rng = np.random.RandomState(8)
+        eng = LLMEngine(m, max_batch=2, max_len=24, page_size=4,
+                        prefill_chunk=8, page_pool=7, decode_block=4)
+        rids = [eng.add_request(rng.randint(1, 128, (8,)).astype(np.int32),
+                                max_new_tokens=16) for _ in range(3)]
+        eng.run_until_done()
+        assert eng.preemptions >= 2
+        for rid in rids:
+            r = eng._finished[rid]
+            assert len(r.out) == 16
+            assert r.prompt == r.prompt0 + r.out[:len(r.prompt) - 8] \
+                or len(r.prompt) == 8      # never double-folded
