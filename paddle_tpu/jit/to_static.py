@@ -371,6 +371,19 @@ class StaticFunction:
                                "signature stays eager", e, attempts)
                 group.eager_only = True
         else:
+            if entry.guard_kinds and not getattr(self, "_guard_warned", False):
+                # the guard check is a device->host sync per call: through a
+                # remote dispatch path that is a full round trip (measured
+                # 5-150 ms/call on the tunneled v5e — see BASELINE.md), and
+                # a diverged step discards a fully executed compiled program
+                self._guard_warned = True
+                logger.warning(
+                    "to_static: signature compiled with %d value guard(s) "
+                    "(bool()/int() on tensors): every call pays a "
+                    "device->host guard sync, which through a remote "
+                    "dispatch path costs a full round trip. Hoist the "
+                    "branch out of the step (or precompute it) for the "
+                    "guard-free fast path.", len(entry.guard_kinds))
             if ctx.grad_writes:
                 # train-step pattern (fn ran backward internally): replay-path
                 # outputs are detached, so detach the spy outputs too — this

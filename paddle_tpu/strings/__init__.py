@@ -1,0 +1,126 @@
+"""String tensors (reference: paddle/phi/core/string_tensor.h + the
+phi/kernels/strings/ kernel family — strings_empty_kernel.h,
+strings_copy_kernel.h, strings_lower_upper_kernel.h, case_utils.h/unicode.h).
+
+TPU-native framing: a TPU has no string compute unit — the reference's GPU
+string kernels exist to co-locate tokenization-adjacent preprocessing with
+the CUDA pipeline. Here strings are HOST-resident (numpy object arrays) by
+design; anything that needs device compute happens after numericalization.
+The kernel surface matches the reference: empty/empty_like, copy, and
+case conversion with the same ascii-vs-utf8 switch
+(strings_lower_upper_kernel.h's bool use_utf8_encoding: the ascii path
+touches only [A-Za-z]; the utf8 path applies full Unicode case mapping).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["StringTensor", "empty", "empty_like", "copy", "lower", "upper",
+           "to_string_tensor"]
+
+
+class StringTensor:
+    """Dense tensor of variable-length UTF-8 strings (reference
+    phi::StringTensor over pstring).
+
+    Host-resident; `data` is an ndarray of python str with arbitrary shape.
+    """
+
+    def __init__(self, data, name=None):
+        arr = np.asarray(data, dtype=object)
+        # normalize every element to str (bytes decode as UTF-8, matching
+        # the reference's pstring semantics)
+        flat = arr.reshape(-1)
+        for i, v in enumerate(flat):
+            if isinstance(v, bytes):
+                flat[i] = v.decode("utf-8")
+            elif not isinstance(v, str):
+                flat[i] = str(v)
+        self._data = flat.reshape(arr.shape)
+        self.name = name
+
+    # -- metadata (reference string_tensor.h: dims/numel/valid) --------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return "pstring"
+
+    def numel(self):
+        return int(self._data.size)
+
+    def reshape(self, shape):
+        return StringTensor(self._data.reshape(shape), name=self.name)
+
+    def numpy(self):
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        if isinstance(out, str):
+            return out
+        return StringTensor(out)
+
+    def __len__(self):
+        return len(self._data)
+
+    def __eq__(self, other):
+        other_arr = other._data if isinstance(other, StringTensor) else \
+            np.asarray(other, dtype=object)
+        return self._data == other_arr
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, data={self._data!r})"
+
+
+def to_string_tensor(data, name=None) -> StringTensor:
+    return StringTensor(data, name=name)
+
+
+def empty(shape) -> StringTensor:
+    """reference strings_empty_kernel.h EmptyKernel: uninitialized -> ""."""
+    arr = np.empty(tuple(shape), dtype=object)
+    arr.reshape(-1)[:] = ""
+    return StringTensor(arr)
+
+
+def empty_like(x: StringTensor) -> StringTensor:
+    return empty(x.shape)
+
+
+def copy(x: StringTensor) -> StringTensor:
+    """reference strings_copy_kernel.h Copy (str values are immutable, so an
+    element-wise array copy is a deep copy)."""
+    return StringTensor(x._data.copy())
+
+
+def _case_convert(x, fn_ascii, fn_unicode, use_utf8_encoding):
+    flat = x._data.reshape(-1)
+    out = np.empty_like(flat)
+    for i, s in enumerate(flat):
+        out[i] = fn_unicode(s) if use_utf8_encoding else fn_ascii(s)
+    return StringTensor(out.reshape(x._data.shape))
+
+
+def _ascii_lower(s: str) -> str:
+    return "".join(chr(ord(c) + 32) if "A" <= c <= "Z" else c for c in s)
+
+
+def _ascii_upper(s: str) -> str:
+    return "".join(chr(ord(c) - 32) if "a" <= c <= "z" else c for c in s)
+
+
+def lower(x: StringTensor, use_utf8_encoding: bool = False) -> StringTensor:
+    """reference strings_lower_upper_kernel.h StringLowerKernel: ascii mode
+    maps [A-Z] only; utf8 mode applies Unicode case mapping."""
+    return _case_convert(x, _ascii_lower, str.lower, use_utf8_encoding)
+
+
+def upper(x: StringTensor, use_utf8_encoding: bool = False) -> StringTensor:
+    """reference strings_lower_upper_kernel.h StringUpperKernel."""
+    return _case_convert(x, _ascii_upper, str.upper, use_utf8_encoding)

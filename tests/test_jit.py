@@ -399,3 +399,25 @@ def test_scan_steps_rejects_ragged_leading_dim():
     with pytest.raises(ValueError):
         scan(pt.to_tensor(np.zeros((3, 2), np.float32)),
              pt.to_tensor(np.zeros((4, 2), np.float32)))
+
+
+def test_guarded_signature_warns_once(caplog):
+    """VERDICT r3 #7: a value-guarded signature must loudly disclose its
+    per-call device->host sync cost — once, not per call."""
+    import logging
+    pt.seed(0)
+    lin = nn.Linear(4, 2)
+
+    def step(x, y):
+        loss = ((lin(x) - y) ** 2).mean()
+        if int(loss * 0) == 0:        # value guard (int conversion)
+            loss = loss * 1.0
+        return loss
+
+    static = pt.jit.to_static(step)
+    x, y = _linear_problem()
+    with caplog.at_level(logging.WARNING, logger="paddle_tpu.jit"):
+        for _ in range(3):
+            static(x, y)
+    warns = [r for r in caplog.records if "value guard" in r.message]
+    assert len(warns) == 1, [r.message for r in caplog.records]

@@ -287,3 +287,34 @@ class TestFunctional:
         assert u.shape == [1, 8, 9]
         back = F.fold(u, [6, 6], 2, strides=2)
         np.testing.assert_allclose(back.numpy(), x, rtol=1e-5)
+
+
+def test_chunked_lm_loss_bf16_logits_close_to_f32():
+    """loss_logits_dtype='bfloat16' (bench fast path) must match the f32
+    chunked loss within bf16 tolerance, forward and backward."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt2 import GPT2Config, GPT2ForCausalLM
+
+    losses, grads = {}, {}
+    for dt in ("float32", "bfloat16"):
+        pt.seed(0)
+        cfg = GPT2Config.tiny(hidden_dropout_prob=0.0,
+                              attention_dropout_prob=0.0,
+                              loss_chunk_size=64, loss_logits_dtype=dt)
+        m = GPT2ForCausalLM(cfg)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, (2, 33)).astype(np.int32)
+        x, y = pt.to_tensor(ids[:, :-1]), pt.to_tensor(ids[:, 1:])
+        _, loss = m(x, labels=y)
+        loss.backward()
+        losses[dt] = float(np.asarray(loss._data, np.float32))
+        grads[dt] = np.asarray(m.gpt2.wte.weight.grad._data
+                               if not hasattr(m.gpt2.wte.weight.grad, "values")
+                               else m.gpt2.wte.weight.grad.values,
+                               np.float32)
+    assert abs(losses["bfloat16"] - losses["float32"]) \
+        / max(abs(losses["float32"]), 1e-6) < 2e-2, losses
+    num = np.abs(grads["bfloat16"] - grads["float32"]).max()
+    den = np.abs(grads["float32"]).max() + 1e-6
+    assert num / den < 5e-2, (num, den)

@@ -1,0 +1,42 @@
+"""String tensor + kernels (reference: phi/core/string_tensor.h,
+phi/kernels/strings/{empty,copy,lower_upper}_kernel.h)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import strings
+
+
+def test_construct_shape_reshape_index():
+    st = strings.to_string_tensor([["Hello", "WORLD"], ["Ä", "ß"]])
+    assert st.shape == [2, 2] and st.numel() == 4 and st.dtype == "pstring"
+    assert st[0, 1] == "WORLD"
+    r = st.reshape([4])
+    assert r.tolist() == ["Hello", "WORLD", "Ä", "ß"]
+    b = strings.StringTensor([b"caf\xc3\xa9"])     # bytes decode as UTF-8
+    assert b[0] == "café"
+
+
+def test_empty_and_copy():
+    e = strings.empty([2, 3])
+    assert e.shape == [2, 3] and all(v == "" for v in e.reshape([6]).tolist())
+    src = strings.to_string_tensor(["a", "b"])
+    cp = strings.copy(src)
+    assert cp.tolist() == ["a", "b"]
+    cp._data[0] = "changed"
+    assert src[0] == "a"                            # deep copy
+
+
+def test_lower_upper_ascii_vs_utf8():
+    st = strings.to_string_tensor(["HeLLo", "Ärger", "straße", "ÇA"])
+    lo_ascii = strings.lower(st)                    # ascii: [A-Z] only
+    assert lo_ascii.tolist() == ["hello", "Ärger", "straße", "Ça"]
+    lo_utf8 = strings.lower(st, use_utf8_encoding=True)
+    assert lo_utf8.tolist() == ["hello", "ärger", "straße", "ça"]
+    up_ascii = strings.upper(st)
+    assert up_ascii.tolist() == ["HELLO", "ÄRGER", "STRAßE", "ÇA"]
+    up_utf8 = strings.upper(st, use_utf8_encoding=True)
+    assert up_utf8.tolist() == ["HELLO", "ÄRGER", "STRASSE", "ÇA"]
+
+
+def test_lazy_namespace():
+    assert pt.strings.StringTensor is strings.StringTensor
