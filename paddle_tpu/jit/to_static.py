@@ -212,7 +212,7 @@ class _SigGroup:
     """All compiled variants for one argument signature. Multiple variants
     exist only when the fn has value guards (data-dependent branches): one
     per branch-combination actually taken."""
-    __slots__ = ("variants", "eager_only", "last")
+    __slots__ = ("variants", "eager_only", "last", "guard_warned")
 
     MAX_VARIANTS = 8
 
@@ -220,6 +220,7 @@ class _SigGroup:
         self.variants: list[_CacheEntry] = []
         self.eager_only = False
         self.last: _CacheEntry | None = None
+        self.guard_warned = False
 
 
 def _guard_ints(guards):
@@ -371,12 +372,14 @@ class StaticFunction:
                                "signature stays eager", e, attempts)
                 group.eager_only = True
         else:
-            if entry.guard_kinds and not getattr(self, "_guard_warned", False):
+            if entry.guard_kinds and not group.guard_warned:
                 # the guard check is a device->host sync per call: through a
                 # remote dispatch path that is a full round trip (measured
                 # 5-150 ms/call on the tunneled v5e — see BASELINE.md), and
-                # a diverged step discards a fully executed compiled program
-                self._guard_warned = True
+                # a diverged step discards a fully executed compiled program.
+                # Once per SIGNATURE: a later signature with its own guards
+                # discloses its own cost
+                group.guard_warned = True
                 logger.warning(
                     "to_static: signature compiled with %d value guard(s) "
                     "(bool()/int() on tensors): every call pays a "

@@ -178,7 +178,10 @@ def _chunked_lm_loss(hidden, wte, labels, chunk, ignore_index=-100,
             valid = yc != ignore_index
             safe_y = jnp.where(valid, yc, 0).astype(jnp.int32)
             if bf16_logits:
-                logits = hc @ w.T                       # bf16 [c, V]
+                logits = (hc @ w.T).astype(jnp.bfloat16)  # [c, V] in bf16
+                # (explicit cast: on a bf16 model it's a no-op XLA elides;
+                # on an f32 model it's what makes the flag actually halve
+                # the streamed/kept bytes)
                 m = jnp.max(logits, axis=-1, keepdims=True)
                 z = (logits - m).astype(jnp.float32)    # f32 from here on
                 lse = m[:, 0].astype(jnp.float32) + jnp.log(

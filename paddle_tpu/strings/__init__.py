@@ -27,7 +27,9 @@ class StringTensor:
     """
 
     def __init__(self, data, name=None):
-        arr = np.asarray(data, dtype=object)
+        # forced copy: np.asarray would alias a caller's object ndarray and
+        # the normalization below would mutate it in place
+        arr = np.array(data, dtype=object)
         # normalize every element to str (bytes decode as UTF-8, matching
         # the reference's pstring semantics)
         flat = arr.reshape(-1)

@@ -303,6 +303,7 @@ def test_chunked_lm_loss_bf16_logits_close_to_f32():
                               attention_dropout_prob=0.0,
                               loss_chunk_size=64, loss_logits_dtype=dt)
         m = GPT2ForCausalLM(cfg)
+        m.to(dtype="bfloat16")   # the bench path; makes the bf16 branch real
         rng = np.random.RandomState(0)
         ids = rng.randint(0, cfg.vocab_size, (2, 33)).astype(np.int32)
         x, y = pt.to_tensor(ids[:, :-1]), pt.to_tensor(ids[:, 1:])
