@@ -1,0 +1,93 @@
+"""ERNIE encoder family (BASELINE ERNIE-style config; PaddleNLP ErnieModel
+parity surface): embeddings incl. token/task types, post-LN encoder, pooler,
+MLM + classification heads, mask semantics, to_static capture."""
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.models.ernie import (ErnieConfig, ErnieModel,
+                                     ErnieForMaskedLM,
+                                     ErnieForSequenceClassification)
+
+
+def _ids(b=2, s=12, vocab=256, seed=0):
+    rng = np.random.RandomState(seed)
+    return pt.to_tensor(rng.randint(1, vocab, (b, s)).astype(np.int64))
+
+
+def test_forward_shapes_and_pooler():
+    pt.seed(0)
+    cfg = ErnieConfig.tiny(task_type_vocab_size=3)
+    m = ErnieModel(cfg)
+    m.eval()
+    seq, pooled = m(_ids())
+    assert seq.shape == [2, 12, 64] and pooled.shape == [2, 64]
+    assert np.isfinite(seq.numpy()).all()
+    # tanh pooler is bounded
+    assert (np.abs(pooled.numpy()) <= 1.0 + 1e-6).all()
+
+
+def test_padding_mask_blocks_pad_influence():
+    """Changing PAD-position token ids must not change unpadded outputs."""
+    pt.seed(0)
+    cfg = ErnieConfig.tiny()
+    m = ErnieModel(cfg)
+    m.eval()
+    rng = np.random.RandomState(1)
+    ids = rng.randint(1, 256, (1, 10)).astype(np.int64)
+    mask = np.ones((1, 10), np.float32)
+    mask[0, 7:] = 0.0
+    a = m(pt.to_tensor(ids), attention_mask=pt.to_tensor(mask))[0].numpy()
+    ids2 = ids.copy()
+    ids2[0, 7:] = rng.randint(1, 256, (3,))
+    b = m(pt.to_tensor(ids2), attention_mask=pt.to_tensor(mask))[0].numpy()
+    np.testing.assert_allclose(a[0, :7], b[0, :7], atol=1e-5)
+
+
+def test_mlm_head_tied_and_trains():
+    pt.seed(0)
+    cfg = ErnieConfig.tiny()
+    m = ErnieForMaskedLM(cfg)
+    opt = pt.optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    rng = np.random.RandomState(2)
+    ids = rng.randint(1, 256, (2, 16)).astype(np.int64)
+    labels = np.full((2, 16), -100, np.int64)
+    labels[:, 3:8] = rng.randint(1, 256, (2, 5))
+    x, y = pt.to_tensor(ids), pt.to_tensor(labels)
+    losses = []
+    for _ in range(8):
+        _, loss = m(x, labels=y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.asarray(loss._data, np.float32)))
+    assert losses[-1] < losses[0]
+    # decoder is tied to the word embeddings (no separate [V,H] matrix)
+    n_vh = sum(1 for _, p in m.named_parameters()
+               if list(p.shape) == [cfg.vocab_size, cfg.hidden_size])
+    assert n_vh == 1
+
+
+def test_classifier_trains_under_to_static():
+    pt.seed(0)
+    cfg = ErnieConfig.tiny(hidden_dropout_prob=0.0,
+                           attention_probs_dropout_prob=0.0)
+    m = ErnieForSequenceClassification(cfg, num_classes=3)
+    opt = pt.optimizer.AdamW(learning_rate=2e-3, parameters=m.parameters())
+    rng = np.random.RandomState(3)
+    x = pt.to_tensor(rng.randint(1, 256, (8, 10)).astype(np.int64))
+    y = pt.to_tensor(rng.randint(0, 3, (8,)).astype(np.int64))
+
+    def step(x, y):
+        _, loss = m(x, labels=y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    static = pt.jit.to_static(step)
+    losses = [float(np.asarray(static(x, y)._data, np.float32))
+              for _ in range(10)]
+    assert losses[-1] < losses[0] * 0.9, (losses[0], losses[-1])
+    assert all(v.compiled is not None and not g.eager_only
+               for g in static._cache.values() for v in g.variants)
