@@ -69,13 +69,16 @@ def _sync(x):
     return float(np.asarray(x[(0,) * getattr(x, "ndim", 0)]))
 
 
-def _measure_peak(jax):
+def _measure_peak(jax, spec=None):
     """Achievable matmul ceiling on THIS chip (tunneled chips can be slices).
 
     Runs before any model state exists so the 4096^2 operands are the only HBM
-    users. Differential timing (48-chain minus 8-chain) cancels the ~80ms
-    tunnel round-trip latency that otherwise dominates. Returns flops/s or
-    None on failure.
+    users. Differential timing (48-chain minus 8-chain) cancels the tunnel
+    round-trip latency that otherwise dominates; MEDIAN of 3 trials with a
+    1.05x-spec sanity cap, because single differentials through this tunnel
+    have produced physically impossible readings in both directions (244 TF
+    on a 197 TF part; 60 TF while the train step ran at ~135 ms). Returns
+    flops/s or None on failure.
     """
     import jax.numpy as jnp
 
@@ -91,17 +94,24 @@ def _measure_peak(jax):
         g48 = jax.jit(lambda x: chain(x, 48))
         _sync(g8(a))
         _sync(g48(a))
-        t0 = time.perf_counter()
-        _sync(g8(a))
-        t8 = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        _sync(g48(a))
-        t48 = time.perf_counter() - t0
+        vals = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            _sync(g8(a))
+            t8 = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            _sync(g48(a))
+            t48 = time.perf_counter() - t0
+            if t48 > t8:
+                v = 40 * 2 * 4096 ** 3 / (t48 - t8)
+                if spec is None or v <= 1.05 * spec:
+                    vals.append(v)
         del a, g8, g48
         gc.collect()
-        if t48 <= t8:
+        if not vals:
             return None
-        return 40 * 2 * 4096 ** 3 / (t48 - t8)
+        vals.sort()
+        return vals[len(vals) // 2]
     except Exception as e:  # noqa: BLE001 — probe is best-effort
         print(f"peak probe failed ({type(e).__name__}): {e}", file=sys.stderr)
         gc.collect()
@@ -463,8 +473,8 @@ def main():
     on_tpu = dev.platform in ("tpu", "axon")
     steps = 5 if on_tpu else 2   # timing trials (each = one lo + one hi dispatch)
 
-    meas_peak = _measure_peak(jax)
     spec_peak = _spec_peak(dev.device_kind, on_tpu)
+    meas_peak = _measure_peak(jax, spec_peak if on_tpu else None)
 
     # loss_chunk_size streams the tied-head CE in [chunk, V] tiles instead of
     # materializing [B*S, V] logits — the loss path was the OOM wall that
@@ -516,10 +526,10 @@ def main():
         # this session AND drifts over minutes (r4 observed ~80/130/190 TF
         # windows within one process) — a probe minutes before the trials
         # does not certify them (the r3 claim-vs-driver gap hid here)
-        child_peak = _measure_peak(jax)
+        child_peak = _measure_peak(jax, _spec_peak(dev.device_kind, on_tpu))
         rtt = _measure_rtt(jax)
         result = _train(paddle, nn, cfg, batch, seqlen, steps)
-        peak_after = _measure_peak(jax)
+        peak_after = _measure_peak(jax, _spec_peak(dev.device_kind, on_tpu))
         peaks = [p for p in (child_peak, peak_after) if p]
         result[4]["child_peak_tflops"] = \
             round(min(peaks) / 1e12, 2) if peaks else None

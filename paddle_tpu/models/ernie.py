@@ -11,8 +11,6 @@ nn.MultiHeadAttention (flash path on TPU), masks are additive [B,1,1,S].
 """
 from __future__ import annotations
 
-import numpy as np
-
 from .. import ops
 from ..nn.layer.layers import Layer
 from ..nn.layer.common import Linear, Embedding, Dropout
@@ -48,6 +46,8 @@ class ErnieConfig:
 
     @classmethod
     def base(cls, **kw):       # ernie-3.0-base-zh geometry
+        kw.setdefault("vocab_size", 40000)
+        kw.setdefault("max_position_embeddings", 2048)
         kw.setdefault("task_type_vocab_size", 3)
         return cls(**kw)
 
@@ -125,12 +125,17 @@ class ErnieModel(Layer):
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
                 attention_mask=None, task_type_ids=None):
         """attention_mask: [B, S] with 1 = attend, 0 = pad (HF/PaddleNLP
-        convention) — converted to an additive [B, 1, 1, S] bias."""
-        if attention_mask is None:
-            pad = self.config.pad_token_id
-            attention_mask = (input_ids != pad).astype("float32")
-        bias = ((1.0 - attention_mask.astype("float32")) * -1e4)
-        bias = bias.unsqueeze(1).unsqueeze(1)            # [B,1,1,S]
+        convention) — converted to an additive [B, 1, 1, S] bias.
+
+        With attention_mask=None the encoder attends everywhere and runs
+        mask-free (the TPU flash-attention path). This matches HF BERT
+        semantics and DIVERGES from PaddleNLP, which synthesizes a pad mask
+        from pad_token_id — a data-dependent host check that would break
+        program capture here. Padded batches must pass attention_mask."""
+        bias = None
+        if attention_mask is not None:
+            bias = ((1.0 - attention_mask.astype("float32")) * -1e4)
+            bias = bias.unsqueeze(1).unsqueeze(1)        # [B,1,1,S]
         x = self.embeddings(input_ids, token_type_ids, position_ids,
                             task_type_ids)
         seq = self.encoder(x, src_mask=bias)
@@ -156,10 +161,13 @@ class ErnieForMaskedLM(Layer):
                                                 jnp.float32))
 
     def forward(self, input_ids, token_type_ids=None, labels=None,
-                attention_mask=None, ignore_index=-100):
-        seq, _ = self.ernie(input_ids, token_type_ids,
-                            attention_mask=attention_mask)
-        h = self.transform_ln(F.gelu(self.transform(seq)))
+                attention_mask=None, position_ids=None, task_type_ids=None,
+                ignore_index=-100):
+        seq, _ = self.ernie(input_ids, token_type_ids, position_ids,
+                            attention_mask=attention_mask,
+                            task_type_ids=task_type_ids)
+        act = getattr(F, self.config.hidden_act)
+        h = self.transform_ln(act(self.transform(seq)))
         logits = ops.matmul(h, self.ernie.embeddings.word_embeddings.weight,
                             transpose_y=True) + self.decoder_bias
         if labels is None:
@@ -181,9 +189,10 @@ class ErnieForSequenceClassification(Layer):
                                  weight_attr=Normal(std=cfg.initializer_range))
 
     def forward(self, input_ids, token_type_ids=None, labels=None,
-                attention_mask=None):
-        _, pooled = self.ernie(input_ids, token_type_ids,
-                               attention_mask=attention_mask)
+                attention_mask=None, position_ids=None, task_type_ids=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, position_ids,
+                               attention_mask=attention_mask,
+                               task_type_ids=task_type_ids)
         logits = self.classifier(self.dropout(pooled))
         if labels is None:
             return logits
