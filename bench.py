@@ -398,7 +398,7 @@ def _serving_bench(paddle, on_tpu):
         P, NEW, CHUNK = (512, 32, 128) if on_tpu else (24, 4, 8)
         prompt = rng.randint(1, cfg.vocab_size, (P,)).astype(np.int32)
         eng = LLMEngine(m, max_batch=2, max_len=P + NEW + 8, page_size=16,
-                        prefill_chunk=CHUNK, decode_block=8)
+                        prefill_chunk=CHUNK, decode_block=16)
         rid = eng.add_request(prompt, max_new_tokens=NEW)   # warm compile
         eng.run_until_done()
         t_w = eng.ttft(rid)
