@@ -84,6 +84,12 @@ define_flag("flash_layout_direct", False,
             "flash attention reads [B,S,H,D] operands directly (no relayout "
             "copies) via in-kernel per-head lane slicing; measured slower on "
             "v5e at GPT-2 shapes, may win at other geometries")
+define_flag("weight_only_use_kernel", True,
+            "route weight_only_linear through the Pallas in-kernel-dequant "
+            "matmul on TPU no-grad calls; False uses the XLA dequant "
+            "formulation (r4 microbenches through the tunnel measured the "
+            "two within noise of each other at the M=8 decode GEMM — "
+            "benchmark on your own deployment)")
 define_flag("eager_recompute_grad", False,
             "eager autograd stores op inputs only and recomputes each vjp at "
             "backward time (2x forward FLOPs, far lower peak memory); the "

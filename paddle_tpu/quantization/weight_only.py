@@ -63,7 +63,9 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
     N = unwrap(weight).shape[1]
     xa = unwrap(x)
     M = int(np.prod(xa.shape[:-1])) if xa.ndim > 1 else 1
-    use_kernel = (jax.default_backend() in ("tpu", "axon")
+    from ..core import flags as _flags
+    use_kernel = (_flags.flag("weight_only_use_kernel")
+                  and jax.default_backend() in ("tpu", "axon")
                   and not _requires_grad((x, weight, weight_scale))
                   and xa.shape[-1] == K_in
                   and qmm.supported(M, K_in, N, int4=is4))

@@ -1,6 +1,6 @@
-__version__ = "0.3.0"
+__version__ = "0.4.0"
 full_version = __version__
-major, minor, patch = 0, 3, 0
+major, minor, patch = 0, 4, 0
 
 
 def show():
