@@ -539,7 +539,16 @@ class ScanStaticFunction(StaticFunction):
     produced and consumed/cleared within one call). Grads left set at step
     end hold the LAST slice's values, matching a per-slice eager loop only
     when each step overwrites rather than accumulates across steps.
+
+    ``unroll``: lax.scan unroll factor (HLO grows proportionally; can
+    recover cross-step fusion / shave while-loop overhead).
     """
+
+    def __init__(self, function, input_spec=None, build_strategy=None,
+                 backend=None, full_graph=False, donate_state=True, unroll=1):
+        super().__init__(function, input_spec, build_strategy, backend,
+                         full_graph, donate_state)
+        self._unroll = max(1, int(unroll))
 
     def __call__(self, *args, **kwargs):
         leaves, treedef = jax.tree_util.tree_flatten((args, kwargs),
@@ -714,7 +723,8 @@ class ScanStaticFunction(StaticFunction):
                                     grad_shapes[i].dtype)
                           for i in grad_slots]
             (fin_state, fin_grads), ys = jax.lax.scan(
-                body, (list(state_arrays), init_grads), tuple(stacked_args))
+                body, (list(state_arrays), init_grads), tuple(stacked_args),
+                unroll=self._unroll)
             return ys, fin_state, fin_grads
 
         stacked_shapes = [jax.ShapeDtypeStruct(
@@ -762,7 +772,7 @@ class ScanStaticFunction(StaticFunction):
         return jax.tree_util.tree_unflatten(entry.out_treedef, out_leaves), None
 
 
-def scan_steps(function=None, donate_state=True):
+def scan_steps(function=None, donate_state=True, unroll=1):
     """Compile ``function`` to run K steps per dispatched call via one fused
     ``lax.scan`` — call the result with every tensor argument stacked on a
     leading [K, ...] axis; outputs come back stacked the same way and K
@@ -775,7 +785,8 @@ def scan_steps(function=None, donate_state=True):
             return f
         if isinstance(f, StaticFunction):
             f = f.function
-        return ScanStaticFunction(f, donate_state=donate_state)
+        return ScanStaticFunction(f, donate_state=donate_state,
+                                  unroll=unroll)
     if function is not None:
         return wrap(function)
     return wrap
