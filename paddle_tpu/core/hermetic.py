@@ -1,0 +1,53 @@
+"""Hermetic child-process environments (reference pattern: the fake-device /
+CPU simulation contract in test/legacy_test/test_dist_base.py:957 — a CPU-bound
+child must not attach the parent's accelerator runtime).
+
+On this platform the TPU is reached through a PJRT plugin that a
+``sitecustomize`` hook registers in EVERY python interpreter whose environment
+carries the plugin's discovery variables — and the plugin ignores
+``JAX_PLATFORMS=cpu``.  Any CPU-bound helper process (PS shard servers,
+``launch --backend cpu`` workers, test subprocesses) that inherits those
+variables will try to dial the accelerator tunnel at import time and, when the
+tunnel is down, hang until a timeout.  A framework must produce its green
+suite and its numbers even when the chip environment misbehaves, so every
+CPU-bound spawn path routes through :func:`cpu_child_env`.
+"""
+import os
+
+# Discovery/config variables of out-of-process accelerator plugins.  Removing
+# the discovery var (`*_POOL_IPS`) is what prevents the sitecustomize hook from
+# registering the plugin; the rest are its knobs, cleared for good measure.
+ACCEL_PLUGIN_VARS = (
+    "PALLAS_AXON_POOL_IPS",
+    "PALLAS_AXON_REMOTE_COMPILE",
+    "PALLAS_AXON_TPU_GEN",
+    "AXON_POOL_SVC_OVERRIDE",
+    "AXON_LOOPBACK_RELAY",
+    "TPU_WORKER_HOSTNAMES",
+)
+
+
+def cpu_child_env(base=None, **extra):
+    """Environment mapping for a child process that must run on XLA:CPU.
+
+    Starts from ``base`` (default: ``os.environ``), strips accelerator-plugin
+    discovery variables, forces ``JAX_PLATFORMS=cpu``, then applies ``extra``.
+    """
+    env = dict(os.environ if base is None else base)
+    for var in ACCEL_PLUGIN_VARS:
+        env.pop(var, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra)
+    return env
+
+
+def scrub_plugin_vars():
+    """Strip accelerator-plugin variables from THIS process's environment so
+    every descendant (however spawned) inherits a clean one.  Used by the test
+    harness; returns the removed items for callers that want to restore them.
+    """
+    removed = {}
+    for var in ACCEL_PLUGIN_VARS:
+        if var in os.environ:
+            removed[var] = os.environ.pop(var)
+    return removed

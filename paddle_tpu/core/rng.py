@@ -16,28 +16,49 @@ from . import dispatch
 
 
 class Generator:
+    """Key creation is LAZY: ``PRNGKey`` is a device op, and building it in
+    ``__init__`` would initialize the jax backend at ``import paddle_tpu``
+    time — every CLI (launcher, bench supervisor) would then dial the
+    accelerator tunnel before parsing its arguments."""
+
     def __init__(self, seed: int = 0):
-        self._state = Tensor(jax.random.PRNGKey(seed), persistable=True)
-        self._state.name = "global_rng_state"
+        self._state = None
         self._seed = seed
 
+    def _ensure_state(self):
+        if self._state is None:
+            self._state = Tensor(jax.random.PRNGKey(self._seed),
+                                 persistable=True)
+            self._state.name = "global_rng_state"
+        return self._state
+
     def manual_seed(self, seed: int):
-        self._state._data = jax.random.PRNGKey(seed)
         self._seed = seed
+        if self._state is not None:
+            # in-place so captured programs that lifted the state Tensor as a
+            # program input keep seeing this generator's stream
+            self._state._data = jax.random.PRNGKey(seed)
         return self
 
     def get_state(self) -> Tensor:
-        return self._state
+        return self._ensure_state()
 
     def set_state(self, state: Tensor):
-        self._state._data = state._data if isinstance(state, Tensor) else jnp.asarray(state)
+        data = state._data if isinstance(state, Tensor) else jnp.asarray(state)
+        if self._state is None:
+            # build the Tensor straight from the incoming state — going via
+            # _ensure_state would run a throwaway PRNGKey device op
+            self._state = Tensor(data, persistable=True)
+            self._state.name = "global_rng_state"
+        else:
+            self._state._data = data
 
     def initial_seed(self) -> int:
         return self._seed
 
     def next_key(self):
         """Split the state key; returns a fresh subkey (array)."""
-        key = dispatch.unwrap(self._state)
+        key = dispatch.unwrap(self._ensure_state())
         new_state, sub = jax.random.split(key)
         self._state._data = new_state
         return sub

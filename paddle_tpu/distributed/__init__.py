@@ -44,16 +44,29 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
     ctx = mp.get_context("spawn")
+    # Children of a CPU-bound parent must stay CPU-bound.  The accelerator
+    # plugin registers from sitecustomize at interpreter STARTUP — before any
+    # code we pass to the child runs — so the discovery vars must be scrubbed
+    # from the parent's environ while the children launch (spawn-context
+    # children snapshot os.environ at start()).
+    import os
+    from paddle_tpu.core.hermetic import scrub_plugin_vars
+    cpu_parent = os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
+    removed = scrub_plugin_vars() if cpu_parent else {}
     procs = []
-    for rank in range(nprocs):
-        env = {"PADDLE_TRAINER_ID": str(rank),
-               "PADDLE_TRAINERS_NUM": str(nprocs),
-               "PADDLE_LOCAL_RANK": str(rank),
-               "PADDLE_MASTER": f"127.0.0.1:{port}",
-               "MASTER_ADDR": "127.0.0.1", "MASTER_PORT": str(port)}
-        p = ctx.Process(target=_spawn_entry, args=(func, args, env), daemon=daemon)
-        p.start()
-        procs.append(p)
+    try:
+        for rank in range(nprocs):
+            env = {"PADDLE_TRAINER_ID": str(rank),
+                   "PADDLE_TRAINERS_NUM": str(nprocs),
+                   "PADDLE_LOCAL_RANK": str(rank),
+                   "PADDLE_MASTER": f"127.0.0.1:{port}",
+                   "MASTER_ADDR": "127.0.0.1", "MASTER_PORT": str(port)}
+            p = ctx.Process(target=_spawn_entry, args=(func, args, env),
+                            daemon=daemon)
+            p.start()
+            procs.append(p)
+    finally:
+        os.environ.update(removed)
     if join:
         for p in procs:
             p.join()

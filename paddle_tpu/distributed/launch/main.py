@@ -43,7 +43,13 @@ def _worker_env(args, local_rank):
     world = args.nnodes * args.nproc_per_node
     rank = args.node_rank * args.nproc_per_node + local_rank
     host, port = (args.master.split(":") + ["8476"])[:2]
-    env = dict(os.environ)
+    if args.backend == "cpu":
+        # CPU-bound workers must not attach the parent's accelerator plugin
+        # (it ignores JAX_PLATFORMS and would dial the tunnel at import).
+        from paddle_tpu.core.hermetic import cpu_child_env
+        env = cpu_child_env()
+    else:
+        env = dict(os.environ)
     env.update({
         "PADDLE_TRAINER_ID": str(rank),
         "PADDLE_TRAINERS_NUM": str(world),

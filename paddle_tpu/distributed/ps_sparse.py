@@ -29,6 +29,8 @@ import time
 
 import numpy as np
 
+from paddle_tpu.core.hermetic import cpu_child_env as _hermetic_env
+
 __all__ = ["SparseShard", "serve", "start_server_process", "SparsePsClient",
            "PsEmbedding"]
 
@@ -300,7 +302,7 @@ def start_server_process(port, data_dir, ready_timeout=30.0):
          "serve(%d, %r, ready_file=%r)" % (
              os.path.dirname(os.path.dirname(os.path.dirname(
                  os.path.abspath(__file__)))), port, data_dir, ready)],
-        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        env=_hermetic_env())
     deadline = time.time() + ready_timeout
     while time.time() < deadline:
         if os.path.exists(ready):

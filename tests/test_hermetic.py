@@ -1,0 +1,93 @@
+"""Hermeticity against a dead/unreachable accelerator tunnel (VERDICT r4 #2).
+
+The platform plugin registers from sitecustomize in every interpreter whose
+env carries its discovery vars, ignores ``JAX_PLATFORMS=cpu``, and hangs on a
+dead tunnel.  Every CPU-bound spawn path must therefore ship children a
+scrubbed environment (reference pattern: the CPU-simulation contract of
+test/legacy_test/test_dist_base.py:957).
+"""
+import os
+import subprocess
+import sys
+
+from paddle_tpu.core.hermetic import (ACCEL_PLUGIN_VARS, cpu_child_env,
+                                      scrub_plugin_vars)
+
+UNREACHABLE = "10.255.255.1"   # RFC-1918, nothing listens; a dial would hang
+
+
+class TestCpuChildEnv:
+    def test_strips_plugin_vars_and_forces_cpu(self):
+        base = {var: "x" for var in ACCEL_PLUGIN_VARS}
+        base.update({"PATH": "/bin", "JAX_PLATFORMS": "axon"})
+        env = cpu_child_env(base)
+        for var in ACCEL_PLUGIN_VARS:
+            assert var not in env
+        assert env["JAX_PLATFORMS"] == "cpu"
+        assert env["PATH"] == "/bin"
+
+    def test_extra_overrides_win(self):
+        env = cpu_child_env({}, PADDLE_TRAINER_ID="3")
+        assert env["PADDLE_TRAINER_ID"] == "3"
+
+    def test_scrub_returns_removed_for_restore(self):
+        os.environ["PALLAS_AXON_POOL_IPS"] = UNREACHABLE
+        try:
+            removed = scrub_plugin_vars()
+            assert removed["PALLAS_AXON_POOL_IPS"] == UNREACHABLE
+            assert "PALLAS_AXON_POOL_IPS" not in os.environ
+        finally:
+            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+
+class TestSpawnPathsAreHermetic:
+    def test_launch_worker_env_cpu_backend(self):
+        from paddle_tpu.distributed.launch.main import _parse, _worker_env
+        os.environ["PALLAS_AXON_POOL_IPS"] = UNREACHABLE
+        try:
+            args = _parse(["--nproc_per_node=2", "--backend=cpu", "x.py"])
+            env = _worker_env(args, 0)
+            assert "PALLAS_AXON_POOL_IPS" not in env
+            assert env["JAX_PLATFORMS"] == "cpu"
+            # non-cpu backends keep the parent env untouched
+            args = _parse(["--nproc_per_node=2", "x.py"])
+            env = _worker_env(args, 0)
+            assert env["PALLAS_AXON_POOL_IPS"] == UNREACHABLE
+        finally:
+            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+    def test_child_with_dead_tunnel_env_runs_cpu(self):
+        """End-to-end: parent env points the plugin at an unreachable address;
+        a child launched through cpu_child_env must come up on CPU fast
+        instead of hanging on the tunnel."""
+        base = {**os.environ, "PALLAS_AXON_POOL_IPS": UNREACHABLE,
+                "JAX_PLATFORMS": "axon"}
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print('BACKEND', jax.default_backend())"],
+            env=cpu_child_env(base), capture_output=True, text=True,
+            timeout=120)
+        assert "BACKEND cpu" in r.stdout, r.stderr[-2000:]
+
+    def test_ps_server_child_is_hermetic(self, tmp_path):
+        """start_server_process ships a scrubbed env even when the parent's
+        environ points at a dead tunnel."""
+        import socket
+        import numpy as np
+        from paddle_tpu.distributed.ps_sparse import (start_server_process,
+                                                      SparsePsClient)
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        os.environ["PALLAS_AXON_POOL_IPS"] = UNREACHABLE
+        try:
+            p = start_server_process(port, str(tmp_path), ready_timeout=60)
+            client = SparsePsClient([f"127.0.0.1:{port}"])
+            client.create_table("t", dim=4, capacity_rows_per_server=8,
+                                lr=1.0, initializer="zeros")
+            out = client.pull("t", np.array([1, 2]))
+            assert out.shape == (2, 4)
+            client.shutdown()
+            p.wait(timeout=10)
+        finally:
+            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
