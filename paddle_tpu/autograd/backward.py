@@ -79,6 +79,10 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
     _only (internal, paddle.grad only_inputs=True): restrict .grad writes to
     this id-set so a grad() call never pollutes other leaves' .grad.
 
+    Under a break-stitched echo pass (jit/to_static.py) backward is a no-op:
+    the compiled program already produced every grad; the echo's placeholder
+    tensors carry no tape.
+
     defer_param_ids (internal, zero-bubble pipeline): id-set of leaf
     parameters whose weight-grad computation is DEFERRED — the sweep
     propagates activation cotangents now (the "B" pass) and returns a list of
@@ -91,6 +95,10 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
     linearizations land in one XLA module and the duplicated forward
     subexpressions are CSE'd (reference analog: pipeline_zero_bubble.py splits
     matmul_grad into dX-now / dW-later at the op level)."""
+    from ..core.dispatch import _state
+    tc = _state.trace_ctx
+    if tc is not None and getattr(tc, "mode", None) == "echo":
+        return [] if defer_param_ids is not None else None
     if create_graph and defer_param_ids:
         raise ValueError("defer_param_ids cannot be combined with create_graph")
     if grad_tensors is None:

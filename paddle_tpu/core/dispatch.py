@@ -92,6 +92,11 @@ def apply_op(name: str, fn: Callable, *inputs, out_treedef_hint=None):
 
 
 def _apply_op_inner(name, fn, *inputs):
+    tc = _state.trace_ctx
+    if tc is not None and tc.mode == "echo":
+        # break-stitched replay (jit/to_static.py): the compiled program
+        # already ran; hand back shape-only placeholders with zero compute
+        return tc.on_op_echo(name, inputs)
     arrays = tuple(unwrap(a) for a in inputs)
     if _state.amp_state is not None:
         from ..amp import maybe_cast_inputs
@@ -139,6 +144,7 @@ def _apply_op_inner(name, fn, *inputs):
             # the replay entry (non-float outputs have no _grad_node link)
             for i, t in enumerate(wrapped):
                 t._replay_node = (node, i)
+        _notify_op(name, single, wrapped)
         return wrapped[0] if single else tuple(wrapped)
     else:
         try:
@@ -151,7 +157,18 @@ def _apply_op_inner(name, fn, *inputs):
                    for o in ((outs,) if single else outs)]
         if _state.static_record:
             _attach_replay(name, fn, inputs, arrays, wrapped)
+        _notify_op(name, single, wrapped)
         return wrapped[0] if single else tuple(wrapped)
+
+
+def _notify_op(name, single, wrapped):
+    """Op-tape hook: the jit replay trace records each dispatch so the echo
+    pass of a break-stitched signature can validate + placeholder it."""
+    tc = _state.trace_ctx
+    if tc is not None:
+        hook = getattr(tc, "on_op", None)
+        if hook is not None:
+            hook(name, single, wrapped)
 
 
 def _op_error_note(name, arrays):
@@ -206,6 +223,7 @@ def _run_checked(name, fn, arrays, needs_grad, inputs):
         wrapped.append(t)
     if node is not None:
         node.set_outputs(wrapped)
+    _notify_op(name, single, wrapped)
     return wrapped[0] if single else tuple(wrapped)
 
 

@@ -140,6 +140,14 @@ class Tensor:
 
     # ---- host interop --------------------------------------------------------
     def numpy(self) -> np.ndarray:
+        """Host read. Under program capture this is a stitched BREAK event
+        (jit/to_static.py): the compiled program emits the traced value as an
+        extra output, and the per-call echo pass hands the caller the true
+        array — the signature stays compiled."""
+        from .dispatch import _state
+        tc = _state.trace_ctx
+        if tc is not None and hasattr(tc, "on_materialize"):
+            return tc.on_materialize(self)
         if _is_tracer(self._buf):
             raise RuntimeError(
                 "Tensor.numpy() is not available while capturing a static program "
@@ -175,16 +183,22 @@ class Tensor:
         return self._convert_scalar("int", lambda a: int(a))
 
     def __float__(self) -> float:
-        # float guards would re-specialize on every distinct value; keep this
-        # a graph break (raises Tracer*Error under capture)
-        return float(self._data)
+        # a float guard would re-specialize on every distinct value, so under
+        # capture this is a stitched BREAK (traced value rides out as a
+        # program output; the echo pass returns the true per-call float)
+        return self._convert_scalar("float", lambda a: float(a))
 
     def __index__(self) -> int:
         return self._convert_scalar("int", lambda a: int(a))
 
     def __format__(self, spec):
-        if self.ndim == 0 and not _is_tracer(self._buf):
-            return format(self.item(), spec)
+        if self.ndim == 0:
+            from .dispatch import _state
+            tc = _state.trace_ctx
+            if tc is not None and hasattr(tc, "on_materialize"):
+                return format(np.asarray(tc.on_materialize(self)).item(), spec)
+            if not _is_tracer(self._buf):
+                return format(self.item(), spec)
         return str(self)
 
     # ---- autograd surface ----------------------------------------------------

@@ -1,0 +1,249 @@
+"""SOT-style stitched graph breaks (VERDICT r4 missing #1): float()/.numpy()
+inside a captured step must NOT de-compile the signature — the step stays one
+fused program, and the python around the break observes true per-call values
+via the echo pass (reference analog: sot/translate.py:31 subgraph stitching).
+"""
+import logging
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _model_and_opt(seed=0, lr=0.05):
+    paddle.seed(seed)
+    m = nn.Linear(8, 4)
+    opt = paddle.optimizer.AdamW(learning_rate=lr, parameters=m.parameters())
+    return m, opt
+
+
+def _data(seed=0, n=6):
+    rng = np.random.RandomState(seed)
+    return [(paddle.to_tensor(rng.rand(16, 8).astype(np.float32)),
+             paddle.to_tensor(rng.rand(16, 4).astype(np.float32)))
+            for _ in range(n)]
+
+
+class TestFloatBreakStitching:
+    def test_float_loss_metric_hook_stays_compiled(self):
+        """The exact idiom from the VERDICT: float(loss) in a metric callback.
+        Losses must match eager, the metric list must hold TRUE per-call
+        values in steady state, and the compiled program must run every call.
+        (Capture passes — spy/trace — re-run the python with capture-time
+        values, like any trace-based capture; steady state is one echo per
+        call with the true value.)"""
+        metrics = []
+        m, opt = _model_and_opt()
+
+        def train_step(x, y):
+            loss = ((m(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            metrics.append(float(loss))      # the break
+            return loss
+
+        step = paddle.jit.to_static(train_step)
+        data = _data()
+        losses = [float(np.asarray(step(x, y)._data)) for x, y in data]
+
+        # eager twin for parity
+        m2, opt2 = _model_and_opt()
+        ref = []
+        for x, y in data:
+            loss = ((m2(x) - y) ** 2).mean()
+            loss.backward()
+            opt2.step()
+            opt2.clear_grad()
+            ref.append(float(loss))
+        np.testing.assert_allclose(losses, ref, rtol=2e-5, atol=2e-5)
+        # steady state (after the capture calls): the metric hook observed
+        # the true value of every call, not the spy-time constant
+        np.testing.assert_allclose(metrics[-4:], ref[-4:],
+                                   rtol=2e-5, atol=2e-5)
+        assert len(set(np.round(metrics, 6))) > 1   # values actually change
+
+        group = next(iter(step._cache.values()))
+        assert not group.eager_only
+        entry = group.variants[0]
+        assert entry.compiled is not None
+        assert entry.break_kinds == ("float",)
+        assert len(entry.op_tape) > 0
+        # steady-state: exactly one append per call
+        n = len(metrics)
+        step(*data[0])
+        assert len(metrics) == n + 1
+
+    def test_compiled_program_runs_every_call(self):
+        m, opt = _model_and_opt()
+        seen = []
+
+        def train_step(x, y):
+            loss = ((m(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            seen.append(float(loss))
+            return loss
+
+        step = paddle.jit.to_static(train_step)
+        data = _data()
+        step(*data[0])                       # spy
+        step(*data[1])                       # first compiled call
+        group = next(iter(step._cache.values()))
+        entry = group.variants[0]
+        calls = []
+        orig = entry.compiled
+        entry.compiled = lambda *a: (calls.append(1), orig(*a))[1]
+        step(*data[2])
+        step(*data[3])
+        assert len(calls) == 2               # compile-count hook: both calls
+        assert not group.eager_only          # ...ran the compiled program
+
+    def test_numpy_break(self):
+        m, opt = _model_and_opt()
+        grabbed = []
+
+        def train_step(x, y):
+            pred = m(x)
+            loss = ((pred - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            grabbed.append(pred.numpy().copy())   # full-array break
+            return loss
+
+        step = paddle.jit.to_static(train_step)
+        data = _data()
+        for x, y in data[:2]:                 # capture warmup
+            step(x, y)
+        grabbed.clear()
+        for x, y in data[2:5]:                # steady state
+            step(x, y)
+        group = next(iter(step._cache.values()))
+        assert not group.eager_only
+        assert group.variants[0].break_kinds == ("numpy",)
+        assert len(grabbed) == 3 and grabbed[0].shape == (16, 4)
+        # weights move every step, so consecutive grabbed preds must differ
+        assert not np.allclose(grabbed[1], grabbed[2])
+
+    def test_fstring_logging_break(self):
+        m, opt = _model_and_opt()
+        lines = []
+
+        def train_step(x, y):
+            loss = ((m(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            lines.append(f"loss={loss:.6f}")     # __format__ break
+            return loss
+
+        step = paddle.jit.to_static(train_step)
+        data = _data()
+        step(*data[0])                        # capture warmup
+        step(*data[1])
+        lines.clear()
+        losses = [float(np.asarray(step(x, y)._data)) for x, y in data[2:5]]
+        group = next(iter(step._cache.values()))
+        assert not group.eager_only
+        assert lines == [f"loss={v:.6f}" for v in np.float32(losses)]
+
+    def test_break_plus_guard_coexist(self):
+        m, opt = _model_and_opt()
+        metrics = []
+
+        def train_step(x, y, flag):
+            loss = ((m(x) - y) ** 2).mean()
+            if bool(flag):                        # int/bool value guard
+                loss = loss * 2.0
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            metrics.append(float(loss))          # stitched break
+            return loss
+
+        step = paddle.jit.to_static(train_step)
+        data = _data()
+        t = paddle.to_tensor(np.array(1, np.int32))
+        f = paddle.to_tensor(np.array(0, np.int32))
+        step(data[0][0], data[0][1], t)          # capture warmup
+        step(data[1][0], data[1][1], t)
+        metrics.clear()
+        l2 = float(np.asarray(step(data[2][0], data[2][1], t)._data))
+        assert metrics[-1] == pytest.approx(l2, rel=1e-6)
+        l3 = float(np.asarray(step(data[3][0], data[3][1], f)._data))  # guard
+        group = next(iter(step._cache.values()))
+        assert not group.eager_only
+        assert len(group.variants) == 2          # one per guard branch
+        assert metrics[-1] == pytest.approx(l3, rel=1e-6)
+
+    def test_op_divergence_on_break_value_falls_back_loudly(self, caplog):
+        """Tensor ops conditioned on a float() break value cannot be stitched:
+        the echo pass detects the tape divergence BEFORE committing state,
+        the call runs eagerly (correct numbers), and the signature pins
+        eager-only with a warning — never silently wrong."""
+        m, opt = _model_and_opt()
+
+        losses = []
+
+        def train_step(x, y, thresh):
+            loss = ((m(x) - y) ** 2).mean()
+            if float(loss) > thresh:             # break value drives op flow
+                loss = loss * 2.0                # extra op on one path only
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+            return loss
+
+        step = paddle.jit.to_static(train_step)
+        data = _data()
+        # ...train until the loss crosses the threshold: the echo pass must
+        # catch the branch flip and fall back
+        with caplog.at_level(logging.WARNING, logger="paddle_tpu.jit"):
+            vals = []
+            for i in range(30):
+                x, y = data[i % len(data)]
+                vals.append(float(np.asarray(step(x, y, 0.5)._data)))
+        group = next(iter(step._cache.values()))
+        assert group.eager_only          # pinned, not silently wrong
+        assert any("eager" in r.message for r in caplog.records)
+        # eager twin parity across the WHOLE trajectory (incl. the fallback
+        # call): state was never corrupted by a half-committed step
+        m2, opt2 = _model_and_opt()
+        ref = []
+        for i in range(30):
+            x, y = data[i % len(data)]
+            loss = ((m2(x) - y) ** 2).mean()
+            if float(loss) > 0.5:
+                loss = loss * 2.0
+            loss.backward()
+            opt2.step()
+            opt2.clear_grad()
+            ref.append(float(loss))
+        np.testing.assert_allclose(vals, ref, rtol=2e-4, atol=2e-5)
+
+    def test_scan_steps_rejects_breaks_eagerly(self):
+        m, opt = _model_and_opt()
+        metrics = []
+
+        def train_step(x, y):
+            loss = ((m(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            metrics.append(float(loss))
+            return loss
+
+        step = paddle.jit.scan_steps(train_step)
+        rng = np.random.RandomState(0)
+        xs = paddle.to_tensor(rng.rand(3, 16, 8).astype(np.float32))
+        ys = paddle.to_tensor(rng.rand(3, 16, 4).astype(np.float32))
+        out = step(xs, ys)                      # falls back to eager loop
+        out2 = step(xs, ys)
+        group = next(iter(step._cache.values()))
+        assert group.eager_only                  # documented restriction
+        assert len(metrics) == 6                 # but all steps really ran
