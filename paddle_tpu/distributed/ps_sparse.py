@@ -38,30 +38,53 @@ __all__ = ["SparseShard", "serve", "start_server_process", "SparsePsClient",
 # =============================== server side ================================
 
 class SparseShard:
-    """One server's shard of one table: bounded LRU pool + sqlite spill."""
+    """One server's shard of one table: bounded LRU pool + sqlite spill,
+    gated by a CtrAccessor-style feature policy (reference:
+    paddle/fluid/distributed/ps/table/ctr_accessor.h:30 — show-threshold
+    admission, show-score time decay, threshold-based shrink):
+
+      * admission — with ``admit_threshold`` > 0 a feature id gets a trained
+        row only after its cumulative push count reaches the threshold;
+        earlier pushes only bump a bounded candidate counter (their grads are
+        dropped, as the reference drops updates to uncreated embedx), and
+        pulls of unadmitted ids return the initializer row without creating
+        state.  A skewed stream of one-shot features therefore cannot fill
+        the table.
+      * score + decay — every push adds to the row's show-score;
+        ``shrink(decay_rate, delete_threshold)`` multiplies all scores
+        (resident, spilled, candidates) by the decay and deletes rows whose
+        score fell below the threshold (the reference's Table::Shrink).
+    """
 
     def __init__(self, name, dim, capacity_rows, data_dir, lr=0.1,
-                 optimizer="sgd", initializer="uniform", seed=0):
+                 optimizer="sgd", initializer="uniform", seed=0,
+                 admit_threshold=0):
         self.name = name
         self.dim = int(dim)
         self.capacity = int(capacity_rows)
         self.lr = float(lr)
         self.optimizer = optimizer
         self.initializer = initializer
+        self.admit_threshold = int(admit_threshold)
         self._rng = np.random.RandomState(seed)
         os.makedirs(data_dir, exist_ok=True)
         self._db_path = os.path.join(data_dir, f"{name}.spill.sqlite")
         self._db = sqlite3.connect(self._db_path, check_same_thread=False)
         self._db.execute("CREATE TABLE IF NOT EXISTS rows ("
-                         "id INTEGER PRIMARY KEY, row BLOB, accum REAL)")
+                         "id INTEGER PRIMARY KEY, row BLOB, accum REAL, "
+                         "score REAL DEFAULT 0)")
         # resident pool: id -> pool slot; LRU tick per slot
         self.pool = np.zeros((self.capacity, self.dim), np.float32)
         self.accum = np.zeros((self.capacity,), np.float32)   # adagrad state
+        self.score = np.zeros((self.capacity,), np.float32)   # show-score
         self.slot_of: dict[int, int] = {}
         self.id_of = np.full((self.capacity,), -1, np.int64)
         self.tick_of = np.zeros((self.capacity,), np.int64)
         self._free = list(range(self.capacity - 1, -1, -1))
         self._tick = 0
+        # pre-admission candidates: id -> cumulative push count (bounded)
+        self._candidates: dict[int, float] = {}
+        self._cand_budget = max(8 * self.capacity, 1024)
         self.lock = threading.Lock()
 
     # -- row lifecycle --------------------------------------------------------
@@ -75,8 +98,9 @@ class SparseShard:
         rid = int(self.id_of[slot])
         if rid >= 0:
             self._db.execute(
-                "INSERT OR REPLACE INTO rows VALUES (?, ?, ?)",
-                (rid, self.pool[slot].tobytes(), float(self.accum[slot])))
+                "INSERT OR REPLACE INTO rows VALUES (?, ?, ?, ?)",
+                (rid, self.pool[slot].tobytes(), float(self.accum[slot]),
+                 float(self.score[slot])))
             del self.slot_of[rid]
             self._evicted_uncommitted = True
         self.id_of[slot] = -1
@@ -90,20 +114,27 @@ class SparseShard:
             self._db.commit()
             self._evicted_uncommitted = False
 
-    def _resident(self, rid):
-        """Slot of row `rid`, faulting it in (spill or fresh init)."""
+    def _resident(self, rid, create=True):
+        """Slot of row `rid`, faulting it in (spill or fresh init).
+        ``create=False`` (pull of an unadmitted id) returns None instead of
+        creating state for an id that exists nowhere."""
         slot = self.slot_of.get(rid)
         if slot is None:
-            slot = self._free.pop() if self._free else self._evict_one()
             cur = self._db.execute(
-                "SELECT row, accum FROM rows WHERE id=?", (rid,)).fetchone()
+                "SELECT row, accum, score FROM rows WHERE id=?",
+                (rid,)).fetchone()
+            if cur is None and not create:
+                return None
+            slot = self._free.pop() if self._free else self._evict_one()
             if cur is not None:
                 self.pool[slot] = np.frombuffer(cur[0], np.float32)
                 self.accum[slot] = cur[1]
+                self.score[slot] = cur[2]
                 self._db.execute("DELETE FROM rows WHERE id=?", (rid,))
             else:
                 self.pool[slot] = self._init_row()
                 self.accum[slot] = 0.0
+                self.score[slot] = 0.0
             self.slot_of[rid] = slot
             self.id_of[slot] = rid
         self._tick += 1
@@ -115,22 +146,54 @@ class SparseShard:
         ids = np.asarray(ids, np.int64)
         out = np.empty((len(ids), self.dim), np.float32)
         with self.lock:
+            # with admission gating, a pull must not create state: unadmitted
+            # ids get the initializer row (reference: missing feature pulls
+            # default values; embedx exists only past the show threshold)
+            create = self.admit_threshold <= 0
             for i, rid in enumerate(ids):
-                out[i] = self.pool[self._resident(int(rid))]
+                slot = self._resident(int(rid), create=create)
+                out[i] = self.pool[slot] if slot is not None \
+                    else self._init_row()
             self._commit_evictions()
         return out
 
+    def _admit(self, rid, count):
+        """Candidate bookkeeping; True once `rid` may own a trained row."""
+        if self.admit_threshold <= 0:
+            return True
+        if self.slot_of.get(rid) is not None or self._db.execute(
+                "SELECT 1 FROM rows WHERE id=?", (rid,)).fetchone():
+            return True          # already created
+        total = self._candidates.get(rid, 0.0) + count
+        if total >= self.admit_threshold:
+            self._candidates.pop(rid, None)
+            return True
+        self._candidates[rid] = total
+        if len(self._candidates) > self._cand_budget:
+            # bounded candidate set: drop the colder half (one-shot features)
+            keep = sorted(self._candidates.items(),
+                          key=lambda kv: kv[1],
+                          reverse=True)[:self._cand_budget // 2]
+            self._candidates = dict(keep)
+        return False
+
     def push(self, ids, grads):
-        """Sparse server-side update; duplicate ids accumulate."""
+        """Sparse server-side update; duplicate ids accumulate. Updates to
+        unadmitted features are dropped (candidate counter bumped instead)."""
         ids = np.asarray(ids, np.int64)
         g = np.asarray(grads, np.float32)
         with self.lock:
             agg: dict[int, np.ndarray] = {}
+            cnt: dict[int, int] = {}
             for i, rid in enumerate(ids):
                 rid = int(rid)
                 agg[rid] = agg.get(rid, 0) + g[i]
+                cnt[rid] = cnt.get(rid, 0) + 1
             for rid, gr in agg.items():
+                if not self._admit(rid, cnt[rid]):
+                    continue
                 slot = self._resident(rid)
+                self.score[slot] += cnt[rid]
                 if self.optimizer == "adagrad":
                     self.accum[slot] += float((gr * gr).mean())
                     scale = self.lr / (np.sqrt(self.accum[slot]) + 1e-8)
@@ -138,6 +201,33 @@ class SparseShard:
                 else:
                     self.pool[slot] -= self.lr * gr
             self._commit_evictions()
+
+    def shrink(self, decay_rate=0.98, delete_threshold=None):
+        """Decay every show-score by `decay_rate`; with `delete_threshold`,
+        drop rows (resident + spilled) and candidates whose score fell below
+        it.  Returns the number of rows deleted (Table::Shrink analog)."""
+        deleted = 0
+        with self.lock:
+            self.score[list(self.slot_of.values())] *= decay_rate
+            self._db.execute("UPDATE rows SET score = score * ?",
+                             (decay_rate,))
+            self._candidates = {k: v * decay_rate
+                                for k, v in self._candidates.items()
+                                if v * decay_rate >= 0.5}
+            if delete_threshold is not None:
+                for rid in list(self.slot_of):
+                    slot = self.slot_of[rid]
+                    if self.score[slot] < delete_threshold:
+                        del self.slot_of[rid]
+                        self.id_of[slot] = -1
+                        self.tick_of[slot] = 0
+                        self._free.append(slot)
+                        deleted += 1
+                cur = self._db.execute(
+                    "DELETE FROM rows WHERE score < ?", (delete_threshold,))
+                deleted += cur.rowcount
+            self._db.commit()
+        return deleted
 
     # -- persistence ----------------------------------------------------------
     def save(self, path):
@@ -147,8 +237,9 @@ class SparseShard:
             for rid in list(self.slot_of):
                 slot = self.slot_of[rid]
                 self._db.execute(
-                    "INSERT OR REPLACE INTO rows VALUES (?, ?, ?)",
-                    (rid, self.pool[slot].tobytes(), float(self.accum[slot])))
+                    "INSERT OR REPLACE INTO rows VALUES (?, ?, ?, ?)",
+                    (rid, self.pool[slot].tobytes(), float(self.accum[slot]),
+                     float(self.score[slot])))
             self._db.commit()
             tmp = path + ".tmp"
             dst = sqlite3.connect(tmp)
@@ -175,7 +266,22 @@ class SparseShard:
         with self.lock:
             spilled = self._db.execute("SELECT COUNT(*) FROM rows").fetchone()[0]
             return {"resident": len(self.slot_of), "spilled": int(spilled),
-                    "capacity": self.capacity, "dim": self.dim}
+                    "capacity": self.capacity, "dim": self.dim,
+                    "candidates": len(self._candidates),
+                    "admit_threshold": self.admit_threshold}
+
+
+def _auth_key():
+    """Shared wire key (PADDLE_PS_AUTH_KEY). The protocol is pickle, so an
+    unauthenticated frame is arbitrary code execution for anyone who can
+    reach the port — with a key set, every frame carries an HMAC-SHA256 that
+    is verified BEFORE unpickling, and unauthenticated peers are dropped."""
+    k = os.environ.get("PADDLE_PS_AUTH_KEY", "")
+    return k.encode() if k else None
+
+
+class _AuthError(Exception):
+    pass
 
 
 def _recv_msg(sock):
@@ -192,11 +298,25 @@ def _recv_msg(sock):
         if not chunk:
             return None
         buf += chunk
-    return pickle.loads(bytes(buf))
+    body = bytes(buf)
+    key = _auth_key()
+    if key is not None:
+        import hashlib
+        import hmac as _hmac
+        if len(body) < 32 or not _hmac.compare_digest(
+                body[:32], _hmac.new(key, body[32:], hashlib.sha256).digest()):
+            raise _AuthError("PS frame failed HMAC verification")
+        body = body[32:]
+    return pickle.loads(body)
 
 
 def _send_msg(sock, obj):
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    key = _auth_key()
+    if key is not None:
+        import hashlib
+        import hmac as _hmac
+        payload = _hmac.new(key, payload, hashlib.sha256).digest() + payload
     sock.sendall(struct.pack("!Q", len(payload)) + payload)
 
 
@@ -216,7 +336,15 @@ def serve(port, data_dir, host="127.0.0.1", ready_file=None, load_dir=None):
     def handle(conn):
         try:
             while not stop.is_set():
-                msg = _recv_msg(conn)
+                try:
+                    msg = _recv_msg(conn)
+                except _AuthError:
+                    # unauthenticated/forged frame: drop the peer without
+                    # replying (and without ever having unpickled its bytes)
+                    import sys
+                    print("ps_sparse: rejected unauthenticated frame",
+                          file=sys.stderr)
+                    return
                 if msg is None:
                     return
                 op = msg["op"]
@@ -236,7 +364,9 @@ def serve(port, data_dir, host="127.0.0.1", ready_file=None, load_dir=None):
                                     optimizer=msg.get("optimizer", "sgd"),
                                     initializer=msg.get("initializer",
                                                         "uniform"),
-                                    seed=msg.get("seed", 0))
+                                    seed=msg.get("seed", 0),
+                                    admit_threshold=msg.get(
+                                        "admit_threshold", 0))
                                 if load_dir:
                                     ck = os.path.join(
                                         load_dir, f"{name}.shard.sqlite")
@@ -260,6 +390,14 @@ def serve(port, data_dir, host="127.0.0.1", ready_file=None, load_dir=None):
                         shards[name].load(os.path.join(
                             msg["path"], f"{name}.shard.sqlite"))
                         _send_msg(conn, {"ok": True})
+                    elif op == "shrink":
+                        names = ([msg["name"]] if msg.get("name")
+                                 else list(shards))
+                        _send_msg(conn, {"ok": True, "deleted": {
+                            n: shards[n].shrink(
+                                decay_rate=msg.get("decay_rate", 0.98),
+                                delete_threshold=msg.get("delete_threshold"))
+                            for n in names}})
                     elif op == "stats":
                         _send_msg(conn, {"ok": True, "stats": {
                             n: s.stats() for n, s in shards.items()}})
@@ -362,12 +500,25 @@ class SparsePsClient:
 
     # -- table API ------------------------------------------------------------
     def create_table(self, name, dim, capacity_rows_per_server, lr=0.1,
-                     optimizer="sgd", initializer="uniform"):
+                     optimizer="sgd", initializer="uniform",
+                     admit_threshold=0):
         for si in range(len(self.endpoints)):
             self._call(si, {"op": "create", "name": name, "dim": dim,
                             "capacity": capacity_rows_per_server, "lr": lr,
                             "optimizer": optimizer,
-                            "initializer": initializer, "seed": si})
+                            "initializer": initializer, "seed": si,
+                            "admit_threshold": admit_threshold})
+
+    def shrink(self, name=None, decay_rate=0.98, delete_threshold=None):
+        """Decay feature scores on every server (CtrAccessor show-decay) and
+        delete rows below `delete_threshold`. Returns total rows deleted."""
+        total = 0
+        for si in range(len(self.endpoints)):
+            rep = self._call(si, {"op": "shrink", "name": name,
+                                  "decay_rate": decay_rate,
+                                  "delete_threshold": delete_threshold})
+            total += sum(rep["deleted"].values())
+        return total
 
     def _split(self, ids):
         ids = np.asarray(ids, np.int64)

