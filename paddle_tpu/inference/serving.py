@@ -124,7 +124,8 @@ class LLMEngine:
 
     def __init__(self, model, mesh=None, mp_axis="mp", pp_axis="pp",
                  max_batch=4, max_len=256, page_size=16, prefill_chunk=32,
-                 page_pool=None, decode_block=1, use_kernel=None, seed=0):
+                 page_pool=None, decode_block=1, use_kernel=None, seed=0,
+                 kv_cache_dtype="auto"):
         """page_pool: usable KV pages (the HBM budget). Defaults to the
         worst case (max_batch * ceil(max_len/page)); set it SMALLER to
         oversubscribe — on-demand growth means slots only claim what they
@@ -133,7 +134,13 @@ class LLMEngine:
         decode_block: max decode steps fused into one dispatch (power-of-two
         blocks are chosen per step, shrinking near max_new; eos-bearing
         requests force 1). Raise it when dispatch latency, not throughput,
-        dominates (e.g. a remote/tunneled runtime)."""
+        dominates (e.g. a remote/tunneled runtime).
+
+        kv_cache_dtype: "auto" stores pages in the weight dtype; "int8"
+        quantizes K/V pages per-(token, kv-head) with f32 scales (reference:
+        incubate block_multihead_attention cache_*_quant_scales, dynamic
+        mode) — pages cost ~(D + 8)/(2*D) of bf16 bytes, so the same HBM
+        budget holds ~2x the tokens / concurrent slots."""
         cfg = model.config
         self.cfg = cfg
         self.max_batch = max_batch
@@ -202,12 +209,22 @@ class LLMEngine:
         else:
             self.W = {k: jnp.asarray(v) for k, v in W.items()}
             cache_spec = None
-        kp = jnp.zeros((L, self.n_pages, page_size, kvh, D), dtype)
+        self.kv_quant = (kv_cache_dtype == "int8")
+        page_dtype = jnp.int8 if self.kv_quant else dtype
+        kp = jnp.zeros((L, self.n_pages, page_size, kvh, D), page_dtype)
         vp = jnp.zeros_like(kp)
         if cache_spec is not None:
             kp = jax.device_put(kp, cache_spec)
             vp = jax.device_put(vp, cache_spec)
-        self.kp, self.vp = kp, vp
+        if self.kv_quant:
+            ks = jnp.zeros((L, self.n_pages, page_size, kvh), jnp.float32)
+            vs = jnp.zeros_like(ks)
+            if cache_spec is not None:
+                ks = jax.device_put(ks, cache_spec)
+                vs = jax.device_put(vs, cache_spec)
+            self.cache = (kp, vp, ks, vs)
+        else:
+            self.cache = (kp, vp)
 
         # host scheduler state (trash page is never allocated)
         self._free_pages = deque(range(self.n_pages - 1))
@@ -235,7 +252,12 @@ class LLMEngine:
         theta = self.cfg.rope_theta
         use_kernel = self.use_kernel
 
+        quant = self.kv_quant
+
         def layer(carry, wl):
+            from ..ops.pallas.paged_attention import (paged_attention,
+                                                      paged_attention_ref,
+                                                      quantize_kv)
             x, = carry
             h = _rms(x, wl["ln1"], eps)
             q = (h @ wl["wq"]).reshape(-1, nh, D)
@@ -243,32 +265,41 @@ class LLMEngine:
             v = (h @ wl["wv"]).reshape(-1, kvh, D)
             q = _rope(q, pos, theta)
             k = _rope(k, pos, theta)
-            kpl = wl["kp"].at[page_idx, within].set(k)
-            vpl = wl["vp"].at[page_idx, within].set(v)
-            if use_kernel:
-                from ..ops.pallas.paged_attention import paged_attention
-                att = paged_attention(q, kpl, vpl, tables, ctx)
+            attn = paged_attention if use_kernel else paged_attention_ref
+            if quant:
+                kq, ksc = quantize_kv(k)
+                vq, vsc = quantize_kv(v)
+                kpl = wl["kp"].at[page_idx, within].set(kq)
+                vpl = wl["vp"].at[page_idx, within].set(vq)
+                ksl = wl["kps"].at[page_idx, within].set(ksc)
+                vsl = wl["vps"].at[page_idx, within].set(vsc)
+                att = attn(q, kpl, vpl, tables, ctx,
+                           k_scales=ksl, v_scales=vsl)
+                new_cache = (kpl, vpl, ksl, vsl)
             else:
-                from ..ops.pallas.paged_attention import paged_attention_ref
-                att = paged_attention_ref(q, kpl, vpl, tables, ctx)
+                kpl = wl["kp"].at[page_idx, within].set(k)
+                vpl = wl["vp"].at[page_idx, within].set(v)
+                att = attn(q, kpl, vpl, tables, ctx)
+                new_cache = (kpl, vpl)
             x = x + att.reshape(-1, nh * D) @ wl["wo"]
             h = _rms(x, wl["ln2"], eps)
             gate = h @ wl["wg"]
             up = h @ wl["wu"]
             x = x + (jax.nn.silu(gate.astype(jnp.float32)).astype(
                 up.dtype) * up) @ wl["wd"]
-            return (x,), (kpl, vpl)
+            return (x,), new_cache
 
         return layer
 
-    def _scan_layers(self, W, kp, vp, x, layer):
+    def _scan_layers(self, W, cache, x, layer):
         per_layer = {k: W[k] for k in
                      ("wq", "wk", "wv", "wo", "ln1", "ln2",
                       "wg", "wu", "wd")}
-        per_layer["kp"] = kp
-        per_layer["vp"] = vp
-        (x,), (kp2, vp2) = jax.lax.scan(layer, (x,), per_layer)
-        return x, kp2, vp2
+        per_layer["kp"], per_layer["vp"] = cache[0], cache[1]
+        if len(cache) == 4:
+            per_layer["kps"], per_layer["vps"] = cache[2], cache[3]
+        (x,), new_cache = jax.lax.scan(layer, (x,), per_layer)
+        return x, new_cache
 
     # ------------------------------------------------------------------ step
     def _build_decode(self, K):
@@ -283,14 +314,14 @@ class LLMEngine:
         eps = cfg.rms_norm_eps
         trash = self.trash_page
 
-        def block(W, kp, vp, tokens, lens, tables, active,
+        def block(W, cache, tokens, lens, tables, active,
                   greedy, temp, topp, topk, seeds, fold):
             # tokens [B] int32; lens [B] tokens already cached; tables
             # [B, S] page ids; active [B] 0/1; sampling params [B].
             # fold [B]: 1 -> vary the sampling key per block step (seedless
             # requests); 0 -> reuse it (fixed-seed generate parity).
             def one(carry, i):
-                tokens, lens, kp, vp = carry
+                tokens, lens, cache = carry
                 x = W["embed"][tokens]                   # [B, H]
                 pos = lens.astype(jnp.int32)
                 page_idx = jnp.take_along_axis(
@@ -300,7 +331,7 @@ class LLMEngine:
                 within = pos % page
                 ctx = jnp.where(active > 0, pos + 1, 1).astype(jnp.int32)
                 layer = self._layer_fn(page_idx, within, tables, ctx, pos)
-                x, kp, vp = self._scan_layers(W, kp, vp, x, layer)
+                x, cache = self._scan_layers(W, cache, x, layer)
                 h = _rms(x, W["norm"], eps)
                 logits = h.astype(jnp.float32) @ W["head"].astype(
                     jnp.float32)
@@ -309,14 +340,14 @@ class LLMEngine:
                                             topk, seeds + i * fold)
                 tokens = jnp.where(active > 0, nxt, tokens)
                 lens = lens + (active > 0).astype(lens.dtype)
-                return (tokens, lens, kp, vp), nxt
+                return (tokens, lens, cache), nxt
 
-            (_, _, kp2, vp2), toks = jax.lax.scan(
-                one, (tokens, lens, kp, vp),
+            (_, _, cache2), toks = jax.lax.scan(
+                one, (tokens, lens, cache),
                 jnp.arange(K, dtype=jnp.int32))
-            return toks, kp2, vp2                        # toks [K, B]
+            return toks, cache2                          # toks [K, B]
 
-        return jax.jit(block, donate_argnums=(1, 2))
+        return jax.jit(block, donate_argnums=(1,))
 
     def _build_prefill(self):
         cfg = self.cfg
@@ -325,7 +356,7 @@ class LLMEngine:
         trash = self.trash_page
         C = self.chunk
 
-        def prefill(W, kp, vp, tokens, start, table, n_valid,
+        def prefill(W, cache, tokens, start, table, n_valid,
                     greedy, temp, topp, topk, seed):
             # tokens [C] int32 (one slot's prompt chunk, zero-padded);
             # start scalar; table [S]; n_valid scalar <= C. Chunk rows ride
@@ -342,14 +373,14 @@ class LLMEngine:
             ctx = jnp.where(valid, pos + 1, 1).astype(jnp.int32)
             tables = jnp.broadcast_to(table[None, :], (C, table.shape[0]))
             layer = self._layer_fn(page_idx, within, tables, ctx, pos)
-            x, kp2, vp2 = self._scan_layers(W, kp, vp, x, layer)
+            x, cache2 = self._scan_layers(W, cache, x, layer)
             h = _rms(x, W["norm"], eps)
             last = h[jnp.maximum(n_valid - 1, 0)]
             logits = last.astype(jnp.float32) @ W["head"].astype(jnp.float32)
             nxt = _sample_row(logits, greedy, temp, topp, topk, seed)
-            return nxt, kp2, vp2
+            return nxt, cache2
 
-        return jax.jit(prefill, donate_argnums=(1, 2))
+        return jax.jit(prefill, donate_argnums=(1,))
 
     # ------------------------------------------------------------- scheduling
     def add_request(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
@@ -473,8 +504,8 @@ class LLMEngine:
         toks = np.zeros((self.chunk,), np.int32)
         toks[:n] = r.prompt[start:start + n]
         finishes = (start + n) == len(r.prompt)
-        nxt, self.kp, self.vp = self._prefill(
-            self.W, self.kp, self.vp, jnp.asarray(toks),
+        nxt, self.cache = self._prefill(
+            self.W, self.cache, jnp.asarray(toks),
             jnp.asarray(np.int32(start)),
             jnp.asarray(self._slot_tables[slot]),
             jnp.asarray(np.int32(n)),
@@ -538,8 +569,8 @@ class LLMEngine:
         prog = self._decode_programs.get(k)
         if prog is None:
             prog = self._decode_programs[k] = self._build_decode(k)
-        toks, self.kp, self.vp = prog(
-            self.W, self.kp, self.vp, jnp.asarray(tokens),
+        toks, self.cache = prog(
+            self.W, self.cache, jnp.asarray(tokens),
             jnp.asarray(self._lens), jnp.asarray(self._slot_tables),
             jnp.asarray(active), jnp.asarray(greedy), jnp.asarray(temp),
             jnp.asarray(topp), jnp.asarray(topk), jnp.asarray(seeds),
@@ -560,6 +591,11 @@ class LLMEngine:
             self.step()
             steps += 1
         return steps
+
+    def kv_bytes_per_page(self):
+        """HBM bytes one KV page costs across all layers (both K and V,
+        including int8 scales) — the unit of the page_pool budget."""
+        return sum(int(a.nbytes) for a in self.cache) // self.n_pages
 
     def result(self, rid):
         return self._finished[rid].out

@@ -75,13 +75,77 @@ def _kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s, *,
         o_ref[0] = (acc_s[:] / denom).astype(o_ref.dtype)
 
 
+def _kernel_q(bt_ref, cl_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+              m_s, l_s, acc_s, *, scale, page_size, n_slots, kv_heads, group):
+    """int8-page variant (reference capability: block_multihead_attention's
+    cache_k_quant_scales/cache_v_quant_scales, dynamic mode): pages carry
+    int8 values + a per-(token, kv-head) f32 scale; the kernel dequantizes
+    page tiles in VMEM right before the MXU dots, so HBM traffic (and page
+    capacity) is ~half the bf16 cache's."""
+    b = pl.program_id(0)
+    s = pl.program_id(1)
+
+    @pl.when(s == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    cl = cl_ref[b]
+    n_valid = (cl + page_size - 1) // page_size
+
+    @pl.when(s < n_valid)
+    def _compute():
+        tok = s * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        valid = tok < cl                                   # [1, page_size]
+        for h in range(kv_heads):
+            q = q_ref[0, h * group:(h + 1) * group, :]
+            k = (k_ref[0, :, h, :].astype(jnp.float32)
+                 * ks_ref[0, :, h][:, None]).astype(q.dtype)
+            v = (v_ref[0, :, h, :].astype(jnp.float32)
+                 * vs_ref[0, :, h][:, None]).astype(q.dtype)
+            sc = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32,
+                                     precision=jax.lax.Precision.DEFAULT) * scale
+            sc = jnp.where(valid, sc, NEG_INF)             # [group, page]
+            row = slice(h * group, (h + 1) * group)
+            m_prev = m_s[row, 0]
+            m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1))
+            p = jnp.exp(sc - m_new[:, None])
+            corr = jnp.exp(m_prev - m_new)
+            l_s[row, 0] = l_s[row, 0] * corr + jnp.sum(p, axis=1)
+            acc_s[row, :] = acc_s[row, :] * corr[:, None] + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.DEFAULT)
+            m_s[row, 0] = m_new
+
+    @pl.when(s == n_slots - 1)
+    def _finish():
+        denom = jnp.maximum(l_s[:, 0:1], 1e-30)
+        o_ref[0] = (acc_s[:] / denom).astype(o_ref.dtype)
+
+
+def quantize_kv(x):
+    """Per-(row, kv-head) symmetric int8 quantization of K/V rows
+    [..., KVH, D] -> (int8 values, f32 scales [..., KVH])."""
+    xf = x.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / s[..., None]), -127, 127).astype(jnp.int8)
+    return q, s
+
+
 @functools.partial(jax.jit, static_argnames=("scale",))
 def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
-                    *, scale=None):
+                    *, k_scales=None, v_scales=None, scale=None):
     """Decode-step attention against a paged KV cache.
 
     q:             [B, H, D]       current-step queries
-    k_pages/v_pages: [P, page_size, KVH, D]
+    k_pages/v_pages: [P, page_size, KVH, D]  (int8 when *_scales given)
+    k_scales/v_scales: [P, page_size, KVH] f32 per-token-per-head scales
+                   (int8 KV-cache mode; reference: incubate block_multihead_
+                   attention.py:47-48 cache_*_quant_scales)
     block_tables:  [B, S] int32    physical page id per (sequence, slot)
     context_lens:  [B]   int32     tokens already in cache (incl. current)
     returns        [B, H, D]
@@ -93,17 +157,29 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
     group = H // KVH
     if scale is None:
         scale = 1.0 / math.sqrt(D)
+    quant = k_scales is not None
+
+    page_spec = pl.BlockSpec((1, page_size, KVH, D),
+                             lambda b, s, bt, cl: (bt[b, s], 0, 0, 0))
+    scale_spec = pl.BlockSpec((1, page_size, KVH),
+                              lambda b, s, bt, cl: (bt[b, s], 0, 0))
+    in_specs = [pl.BlockSpec((1, H, D), lambda b, s, bt, cl: (b, 0, 0)),
+                page_spec, page_spec]
+    operands = [block_tables, context_lens, q, k_pages, v_pages]
+    if quant:
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scales, v_scales]
+        kern = functools.partial(_kernel_q, scale=scale,
+                                 page_size=page_size, n_slots=S,
+                                 kv_heads=KVH, group=group)
+    else:
+        kern = functools.partial(_kernel, scale=scale, page_size=page_size,
+                                 n_slots=S, kv_heads=KVH, group=group)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, S),
-        in_specs=[
-            pl.BlockSpec((1, H, D), lambda b, s, bt, cl: (b, 0, 0)),
-            pl.BlockSpec((1, page_size, KVH, D),
-                         lambda b, s, bt, cl: (bt[b, s], 0, 0, 0)),
-            pl.BlockSpec((1, page_size, KVH, D),
-                         lambda b, s, bt, cl: (bt[b, s], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, H, D), lambda b, s, bt, cl: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((H, 1), jnp.float32),
@@ -111,17 +187,15 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
             pltpu.VMEM((H, D), jnp.float32),
         ],
     )
-    kern = functools.partial(_kernel, scale=scale, page_size=page_size,
-                             n_slots=S, kv_heads=KVH, group=group)
     return pl.pallas_call(
         kern, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
         interpret=_interpret(),
-    )(block_tables, context_lens, q, k_pages, v_pages)
+    )(*operands)
 
 
 def paged_attention_ref(q, k_pages, v_pages, block_tables, context_lens,
-                        *, scale=None):
+                        *, k_scales=None, v_scales=None, scale=None):
     """jnp reference (gathers pages densely) — golden for the kernel test."""
     B, H, D = q.shape
     P, page_size, KVH, _ = k_pages.shape
@@ -134,6 +208,11 @@ def paged_attention_ref(q, k_pages, v_pages, block_tables, context_lens,
         pages = block_tables[b_i]                       # [S]
         k = k_pages[pages].reshape(S * page_size, KVH, D)
         v = v_pages[pages].reshape(S * page_size, KVH, D)
+        if k_scales is not None:                        # int8 pages: dequant
+            k = (k.astype(jnp.float32) *
+                 k_scales[pages].reshape(S * page_size, KVH)[..., None])
+            v = (v.astype(jnp.float32) *
+                 v_scales[pages].reshape(S * page_size, KVH)[..., None])
         cl = context_lens[b_i]
         mask = jnp.arange(S * page_size) < cl
         qh = q[b_i].reshape(KVH, group, D).astype(jnp.float32)

