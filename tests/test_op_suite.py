@@ -46,6 +46,11 @@ def _flat_outs(out):
 def test_golden(name):
     spec = REGISTRY[name]
     raw, out = _run(spec)
+    if spec.check is not None:
+        # golden-by-property (decompositions with sign/order ambiguity):
+        # the check asserts reconstruction + structural invariants
+        spec.check(raw, out)
+        return
     ref = spec.np_ref(*raw)
     outs = out if isinstance(out, (list, tuple)) else [out]
     refs = ref if isinstance(ref, (list, tuple)) else [ref]
@@ -114,9 +119,12 @@ def test_inplace_semantics():
 
 
 def test_coverage_floor():
-    """VERDICT #3 done-criterion: >= 380 registered ops with OpTest entries
-    (actual as of r2: 472 registered / 260 golden — floors ratchet up)."""
+    """VERDICT r4 #7 done-criterion: golden >= 330, remaining smokes < 30
+    and every one carries a documented reason (RNG-valued output etc.)."""
     rep = coverage_report()
     assert rep["registered_ops"] >= 470, rep
-    assert rep["golden_tested"] >= 255, rep
+    assert rep["golden_tested"] >= 330, rep
     assert rep["grad_checked"] >= 60, rep
+    smokes = rep["smoke_reasons"]
+    assert len(smokes) < 30, smokes
+    assert all(smokes.values()), smokes
