@@ -1,25 +1,66 @@
-"""paddle.onnx analog (reference: python/paddle/onnx/export.py — a thin
-delegation to the external `paddle2onnx` package; ImportError when absent).
+"""paddle.onnx analog (reference: python/paddle/onnx/export.py).
 
-Here export() delegates to `jax2onnx`/`onnx` when installed, else raises the
-same way the reference does without paddle2onnx. The native serialization
-path for this framework is paddle.jit.save (StableHLO), which round-trips
-without any extra dependency."""
+The reference delegates to the external `paddle2onnx` package and raises
+ImportError without it.  This environment bakes in no ONNX tooling, so the
+export path is SELF-CONTAINED: the layer's forward is captured as a jaxpr
+(the framework's program IR) and serialized directly against the public
+onnx.proto schema (_proto.py hand-encodes the protobuf; _export.py maps jax
+primitives onto ONNX ops; _runner.py re-executes exported graphs in numpy so
+tests verify numerics without an ONNX runtime).
+
+Supported op subset: MLP-class inference graphs — Linear stacks, norms,
+standard activations, elementwise math, reshape/transpose/concat/slice.
+Unsupported primitives raise NotImplementedError naming the primitive.  The
+native serialization path for full models remains paddle.jit.save
+(StableHLO), which round-trips any program.
+"""
 from __future__ import annotations
+
+import numpy as np
 
 __all__ = ["export"]
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    """reference: onnx/export.py export."""
-    try:
-        import onnx  # noqa: F401
-    except ImportError as e:
-        raise ImportError(
-            "paddle.onnx.export requires the 'onnx' package (the reference "
-            "requires 'paddle2onnx'); it is not installed in this "
-            "environment. Use paddle.jit.save for the native StableHLO "
-            "serialization path instead.") from e
-    raise NotImplementedError(
-        "ONNX graph emission is not wired up; use paddle.jit.save "
-        "(StableHLO) for portable serialized programs.")
+def export(layer, path, input_spec=None, opset_version=17, **configs):
+    """Export `layer`'s forward as an ONNX model to ``path`` + '.onnx'.
+
+    input_spec: list of example Tensors/arrays, or InputSpec-like objects
+    with .shape and .dtype (reference: static.InputSpec).  Returns the path
+    written.
+    """
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    from . import _export
+
+    if input_spec is None:
+        raise ValueError("paddle.onnx.export requires input_spec (example "
+                         "tensors or InputSpec)")
+
+    examples = []
+    for spec in input_spec:
+        if isinstance(spec, Tensor):
+            examples.append(jnp.asarray(spec._data))
+        elif hasattr(spec, "shape") and hasattr(spec, "dtype") and \
+                not isinstance(spec, np.ndarray):
+            shape = [1 if d in (None, -1) else int(d) for d in spec.shape]
+            examples.append(jnp.zeros(shape, np.dtype(spec.dtype)))
+        else:
+            examples.append(jnp.asarray(spec))
+
+    fn = layer.forward if hasattr(layer, "forward") else layer
+
+    def array_fn(*arrays):
+        outs = fn(*[Tensor(a) for a in arrays])
+        flat = outs if isinstance(outs, (tuple, list)) else [outs]
+        return [o._data if isinstance(o, Tensor) else o for o in flat]
+
+    closed = _export.trace_callable(array_fn, examples)
+    in_names = [f"x{i}" for i in range(len(examples))]
+    out_names = [f"y{i}" for i in range(len(closed.jaxpr.outvars))]
+    blob = _export.jaxpr_to_model(closed, in_names, out_names,
+                                  graph_name=type(layer).__name__,
+                                  opset=opset_version)
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(blob)
+    return out_path

@@ -1,0 +1,255 @@
+"""jaxpr -> ONNX GraphProto conversion (reference capability:
+python/paddle/onnx/export.py, which delegates to paddle2onnx's
+program->ONNX converter; here the captured program IS a jaxpr, so the
+converter maps jax primitives onto ONNX ops directly).
+
+Supported primitive subset (enough for MLP/attention-free inference graphs —
+Linear stacks, norms, standard activations):
+  dot_general (matmul form), add/sub/mul/div/max/min/pow, neg, exp, log,
+  tanh, logistic, sqrt, rsqrt, erf, abs, sign, floor, ceil, integer_pow,
+  reduce_sum/max/min, broadcast_in_dim, reshape, transpose, concatenate,
+  convert_element_type, select_n, slice, custom_jvp_call/pjit (inlined).
+Anything else raises NotImplementedError with the primitive name.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from . import _proto as P
+
+
+class _Converter:
+    def __init__(self):
+        self.nodes: list[bytes] = []
+        self.initializers: list[bytes] = []
+        self.names: dict[int, str] = {}     # id(jax var) -> onnx name
+        self.counter = 0
+
+    def fresh(self, hint="t"):
+        self.counter += 1
+        return f"{hint}_{self.counter}"
+
+    def name_of(self, v):
+        from jax._src.core import Literal
+        if isinstance(v, Literal):
+            return self.add_const(np.asarray(v.val))
+        return self.names[id(v)]
+
+    def add_const(self, arr, hint="const"):
+        name = self.fresh(hint)
+        self.initializers.append(P.tensor_proto(name, np.asarray(arr)))
+        return name
+
+    def emit(self, op, ins, n_out=1, **attrs):
+        outs = [self.fresh(op.lower()) for _ in range(n_out)]
+        self.nodes.append(P.node(op, ins, outs, name=self.fresh(op), **attrs))
+        return outs if n_out > 1 else outs[0]
+
+    def set_name(self, var, name):
+        self.names[id(var)] = name
+
+    # ------------------------------ primitives -------------------------------
+    def convert_eqn(self, eqn):
+        prim = eqn.primitive.name
+        handler = getattr(self, f"_p_{prim}", None)
+        if handler is None:
+            handler = _SIMPLE.get(prim)
+            if handler is None:
+                raise NotImplementedError(
+                    f"onnx export: unsupported primitive '{prim}' — the "
+                    "supported subset is documented in paddle_tpu/onnx")
+            ins = [self.name_of(v) for v in eqn.invars]
+            self.set_name(eqn.outvars[0], self.emit(handler, ins))
+            return
+        handler(eqn)
+
+    def _p_dot_general(self, eqn):
+        ((lc, rc), (lb, rb)) = eqn.params["dimension_numbers"]
+        a, b = eqn.invars
+        an, bn = self.name_of(a), self.name_of(b)
+        if lb or rb:
+            raise NotImplementedError("onnx export: batched dot_general")
+        if len(lc) != 1 or len(rc) != 1:
+            raise NotImplementedError("onnx export: multi-dim contraction")
+        # canonical MatMul contracts lhs last dim with rhs first dim
+        if lc[0] != a.aval.ndim - 1:
+            perm = [d for d in range(a.aval.ndim) if d != lc[0]] + [lc[0]]
+            an = self.emit("Transpose", [an], perm=perm)
+        if rc[0] != 0:
+            perm = [rc[0]] + [d for d in range(b.aval.ndim) if d != rc[0]]
+            bn = self.emit("Transpose", [bn], perm=perm)
+        self.set_name(eqn.outvars[0], self.emit("MatMul", [an, bn]))
+
+    def _p_reshape(self, eqn):
+        shape = self.add_const(np.asarray(eqn.params["new_sizes"], np.int64),
+                               "shape")
+        self.set_name(eqn.outvars[0], self.emit(
+            "Reshape", [self.name_of(eqn.invars[0]), shape]))
+
+    def _p_transpose(self, eqn):
+        self.set_name(eqn.outvars[0], self.emit(
+            "Transpose", [self.name_of(eqn.invars[0])],
+            perm=list(eqn.params["permutation"])))
+
+    def _p_broadcast_in_dim(self, eqn):
+        x = eqn.invars[0]
+        tgt = eqn.params["shape"]
+        bdims = eqn.params["broadcast_dimensions"]
+        xn = self.name_of(x)
+        # place the operand's dims at bdims, 1 elsewhere, then Expand
+        inter = [1] * len(tgt)
+        for i, d in enumerate(bdims):
+            inter[d] = x.aval.shape[i] if x.aval.ndim else 1
+        if tuple(inter) != tuple(x.aval.shape):
+            shape = self.add_const(np.asarray(inter, np.int64), "shape")
+            xn = self.emit("Reshape", [xn, shape])
+        shape = self.add_const(np.asarray(tgt, np.int64), "shape")
+        self.set_name(eqn.outvars[0], self.emit("Expand", [xn, shape]))
+
+    def _p_concatenate(self, eqn):
+        self.set_name(eqn.outvars[0], self.emit(
+            "Concat", [self.name_of(v) for v in eqn.invars],
+            axis=int(eqn.params["dimension"])))
+
+    def _p_convert_element_type(self, eqn):
+        to = P.np_to_onnx_dtype(eqn.params["new_dtype"])
+        self.set_name(eqn.outvars[0], self.emit(
+            "Cast", [self.name_of(eqn.invars[0])], to=int(to)))
+
+    def _p_select_n(self, eqn):
+        c, x0, x1 = (self.name_of(v) for v in eqn.invars)
+        # select_n picks cases[c]: False -> x0, True -> x1; Where picks its
+        # SECOND operand where the condition is true
+        self.set_name(eqn.outvars[0], self.emit("Where", [c, x1, x0]))
+
+    def _p_integer_pow(self, eqn):
+        y = eqn.params["y"]
+        xn = self.name_of(eqn.invars[0])
+        if y == 2:
+            out = self.emit("Mul", [xn, xn])
+        elif y == -1:
+            out = self.emit("Reciprocal", [xn])
+        else:
+            e = self.add_const(np.asarray(float(y), np.float32), "exp")
+            out = self.emit("Pow", [xn, e])
+        self.set_name(eqn.outvars[0], out)
+
+    def _p_square(self, eqn):
+        xn = self.name_of(eqn.invars[0])
+        self.set_name(eqn.outvars[0], self.emit("Mul", [xn, xn]))
+
+    def _p_erfc(self, eqn):
+        one = self.add_const(np.asarray(1.0, np.float32), "one")
+        e = self.emit("Erf", [self.name_of(eqn.invars[0])])
+        self.set_name(eqn.outvars[0], self.emit("Sub", [one, e]))
+
+    def _p_rsqrt(self, eqn):
+        s = self.emit("Sqrt", [self.name_of(eqn.invars[0])])
+        self.set_name(eqn.outvars[0], self.emit("Reciprocal", [s]))
+
+    def _reduce(self, eqn, op, axes_as_input):
+        xn = self.name_of(eqn.invars[0])
+        axes = [int(a) for a in eqn.params["axes"]]
+        if axes_as_input:    # ReduceSum carries axes as an input since opset 13
+            an = self.add_const(np.asarray(axes, np.int64), "axes")
+            out = self.emit(op, [xn, an], keepdims=0)
+        else:                # ReduceMax/Min keep attribute axes through opset 17
+            out = self.emit(op, [xn], axes=axes, keepdims=0)
+        self.set_name(eqn.outvars[0], out)
+
+    def _p_reduce_sum(self, eqn):
+        self._reduce(eqn, "ReduceSum", True)
+
+    def _p_reduce_max(self, eqn):
+        self._reduce(eqn, "ReduceMax", False)
+
+    def _p_reduce_min(self, eqn):
+        self._reduce(eqn, "ReduceMin", False)
+
+    def _p_slice(self, eqn):
+        xn = self.name_of(eqn.invars[0])
+        starts = eqn.params["start_indices"]
+        ends = eqn.params["limit_indices"]
+        strides = eqn.params["strides"] or [1] * len(starts)
+        axes = list(range(len(starts)))
+        ins = [xn,
+               self.add_const(np.asarray(starts, np.int64), "starts"),
+               self.add_const(np.asarray(ends, np.int64), "ends"),
+               self.add_const(np.asarray(axes, np.int64), "axes"),
+               self.add_const(np.asarray(strides, np.int64), "steps")]
+        self.set_name(eqn.outvars[0], self.emit("Slice", ins))
+
+    # nested jaxprs (jit regions, custom_jvp wrappers like relu/gelu): inline
+    def _inline(self, eqn, inner, invals):
+        for iv, outer in zip(inner.jaxpr.invars, invals):
+            self.set_name(iv, outer)
+        for cv, cval in zip(inner.jaxpr.constvars, inner.consts):
+            self.set_name(cv, self.add_const(np.asarray(cval)))
+        for sub in inner.jaxpr.eqns:
+            self.convert_eqn(sub)
+        for ov, outer in zip(inner.jaxpr.outvars, eqn.outvars):
+            self.set_name(outer, self.name_of(ov))
+
+    def _p_pjit(self, eqn):
+        self._inline(eqn, eqn.params["jaxpr"],
+                     [self.name_of(v) for v in eqn.invars])
+
+    _p_jit = _p_pjit          # this jax names the inner-jit primitive 'jit'
+
+    def _p_closed_call(self, eqn):
+        self._inline(eqn, eqn.params["call_jaxpr"],
+                     [self.name_of(v) for v in eqn.invars])
+
+    def _p_custom_jvp_call(self, eqn):
+        self._inline(eqn, eqn.params["call_jaxpr"],
+                     [self.name_of(v) for v in eqn.invars])
+
+    def _p_custom_vjp_call(self, eqn):
+        self._inline(eqn, eqn.params["call_jaxpr"],
+                     [self.name_of(v) for v in eqn.invars])
+
+    def _p_stop_gradient(self, eqn):
+        self.set_name(eqn.outvars[0], self.name_of(eqn.invars[0]))
+
+    def _p_copy(self, eqn):
+        self.set_name(eqn.outvars[0], self.name_of(eqn.invars[0]))
+
+
+_SIMPLE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+    "max": "Max", "min": "Min", "pow": "Pow", "neg": "Neg",
+    "exp": "Exp", "log": "Log", "tanh": "Tanh", "logistic": "Sigmoid",
+    "sqrt": "Sqrt", "erf": "Erf", "abs": "Abs", "sign": "Sign",
+    "floor": "Floor", "ceil": "Ceil",
+    "gt": "Greater", "lt": "Less", "ge": "GreaterOrEqual",
+    "le": "LessOrEqual", "eq": "Equal", "and": "And", "or": "Or",
+    "not": "Not",
+}
+
+
+def jaxpr_to_model(closed, in_names, out_names, graph_name="paddle_tpu",
+                   opset=17):
+    """ClosedJaxpr -> serialized ONNX ModelProto bytes."""
+    cv = _Converter()
+    jaxpr = closed.jaxpr
+    inputs = []
+    for v, nm in zip(jaxpr.invars, in_names):
+        cv.set_name(v, nm)
+        inputs.append(P.value_info(nm, np.dtype(v.aval.dtype), v.aval.shape))
+    for v, cval in zip(jaxpr.constvars, closed.consts):
+        cv.set_name(v, cv.add_const(np.asarray(cval), "param"))
+    for eqn in jaxpr.eqns:
+        cv.convert_eqn(eqn)
+    outputs = []
+    for v, nm in zip(jaxpr.outvars, out_names):
+        # alias the final value to the declared output name
+        cv.nodes.append(P.node("Identity", [cv.name_of(v)], [nm],
+                               name=cv.fresh("out")))
+        outputs.append(P.value_info(nm, np.dtype(v.aval.dtype), v.aval.shape))
+    g = P.graph(cv.nodes, graph_name, cv.initializers, inputs, outputs)
+    return P.model(g, opset=opset)
+
+
+def trace_callable(fn, example_arrays):
+    return jax.make_jaxpr(fn)(*example_arrays)

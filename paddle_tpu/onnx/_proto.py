@@ -1,0 +1,208 @@
+"""Minimal self-contained ONNX protobuf encoder/decoder.
+
+The reference delegates ONNX emission to an external package
+(python/paddle/onnx/export.py -> paddle2onnx); this environment has no onnx
+package baked in, so the serializer is implemented directly against the
+public, stable onnx.proto schema (targets IR version 8 / default opset 17).
+Only the message subset export() emits is implemented: ModelProto,
+GraphProto, NodeProto, AttributeProto, TensorProto, ValueInfoProto.
+
+The decoder is generic protobuf (field -> wire values) and exists so tests
+can round-trip and a numpy evaluator can re-execute exported graphs without
+any external dependency.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+# onnx.TensorProto.DataType (public enum values)
+FLOAT, INT32, INT64, BOOL, FLOAT16, DOUBLE, BFLOAT16 = 1, 6, 7, 9, 10, 11, 16
+
+_NP2ONNX = {
+    np.dtype(np.float32): FLOAT,
+    np.dtype(np.float64): DOUBLE,
+    np.dtype(np.int32): INT32,
+    np.dtype(np.int64): INT64,
+    np.dtype(np.bool_): BOOL,
+    np.dtype(np.float16): FLOAT16,
+}
+
+_ONNX2NP = {v: k for k, v in _NP2ONNX.items()}
+
+
+def np_to_onnx_dtype(dt) -> int:
+    dt = np.dtype(dt)
+    if dt.name == "bfloat16":
+        return BFLOAT16
+    if dt not in _NP2ONNX:
+        raise NotImplementedError(f"onnx export: unsupported dtype {dt}")
+    return _NP2ONNX[dt]
+
+
+# ------------------------------ wire encoding --------------------------------
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def field_varint(num: int, value: int) -> bytes:
+    return _varint(num << 3 | 0) + _varint(int(value))
+
+
+def field_bytes(num: int, payload: bytes) -> bytes:
+    return _varint(num << 3 | 2) + _varint(len(payload)) + payload
+
+
+def field_string(num: int, s: str) -> bytes:
+    return field_bytes(num, s.encode())
+
+
+def packed_int64(num: int, values) -> bytes:
+    body = b"".join(_varint(int(v)) for v in values)
+    return field_bytes(num, body)
+
+
+# ------------------------------ message builders -----------------------------
+def tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    """TensorProto: dims=1, data_type=2, name=8, raw_data=9 (little-endian)."""
+    arr = np.ascontiguousarray(arr)
+    dt = np_to_onnx_dtype(arr.dtype)
+    raw = arr.tobytes()
+    msg = b"".join(field_varint(1, d) for d in arr.shape)
+    msg += field_varint(2, dt)
+    msg += field_string(8, name)
+    msg += field_bytes(9, raw)
+    return msg
+
+
+def value_info(name: str, dtype, shape) -> bytes:
+    """ValueInfoProto{name=1, type=2} / TypeProto{tensor_type=1} /
+    Tensor{elem_type=1, shape=2} / TensorShapeProto{dim=1{dim_value=1}}."""
+    dims = b"".join(
+        field_bytes(1, field_varint(1, int(d))) for d in shape)
+    tshape = dims
+    ttensor = field_varint(1, np_to_onnx_dtype(dtype)) + field_bytes(2, tshape)
+    ttype = field_bytes(1, ttensor)
+    return field_string(1, name) + field_bytes(2, ttype)
+
+
+ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_TENSOR = 1, 2, 3, 4
+ATTR_FLOATS, ATTR_INTS = 6, 7
+
+
+def attribute(name: str, value) -> bytes:
+    """AttributeProto: name=1, f=2, i=3, s=4, t=5, floats=7, ints=8, type=20."""
+    msg = field_string(1, name)
+    if isinstance(value, bool) or isinstance(value, (int, np.integer)):
+        msg += _varint(3 << 3 | 0) + _varint(int(value) & ((1 << 64) - 1))
+        msg += field_varint(20, ATTR_INT)
+    elif isinstance(value, float):
+        msg += _varint(2 << 3 | 5) + struct.pack("<f", value)
+        msg += field_varint(20, ATTR_FLOAT)
+    elif isinstance(value, str):
+        msg += field_bytes(4, value.encode())
+        msg += field_varint(20, ATTR_STRING)
+    elif isinstance(value, np.ndarray):
+        msg += field_bytes(5, tensor_proto(name + "_t", value))
+        msg += field_varint(20, ATTR_TENSOR)
+    elif isinstance(value, (list, tuple)) and all(
+            isinstance(v, (int, np.integer)) for v in value):
+        msg += packed_int64(8, value)
+        msg += field_varint(20, ATTR_INTS)
+    elif isinstance(value, (list, tuple)):
+        msg += field_bytes(7, b"".join(struct.pack("<f", float(v))
+                                       for v in value))
+        msg += field_varint(20, ATTR_FLOATS)
+    else:
+        raise NotImplementedError(f"attribute {name}: {type(value)}")
+    return msg
+
+
+def node(op_type: str, inputs, outputs, name="", **attrs) -> bytes:
+    """NodeProto: input=1, output=2, name=3, op_type=4, attribute=5."""
+    msg = b"".join(field_string(1, i) for i in inputs)
+    msg += b"".join(field_string(2, o) for o in outputs)
+    if name:
+        msg += field_string(3, name)
+    msg += field_string(4, op_type)
+    for k, v in attrs.items():
+        msg += field_bytes(5, attribute(k, v))
+    return msg
+
+
+def graph(nodes, name, initializers, inputs, outputs) -> bytes:
+    """GraphProto: node=1, name=2, initializer=5, input=11, output=12."""
+    msg = b"".join(field_bytes(1, n) for n in nodes)
+    msg += field_string(2, name)
+    msg += b"".join(field_bytes(5, t) for t in initializers)
+    msg += b"".join(field_bytes(11, v) for v in inputs)
+    msg += b"".join(field_bytes(12, v) for v in outputs)
+    return msg
+
+
+def model(graph_msg: bytes, opset: int = 17, producer="paddle_tpu") -> bytes:
+    """ModelProto: ir_version=1, producer_name=2, graph=7, opset_import=8
+    (OperatorSetIdProto{domain=1, version=2})."""
+    msg = field_varint(1, 8)                   # IR version 8
+    msg += field_string(2, producer)
+    msg += field_bytes(7, graph_msg)
+    msg += field_bytes(8, field_string(1, "") + field_varint(2, opset))
+    return msg
+
+
+# ------------------------------ generic decoder ------------------------------
+def decode(buf: bytes):
+    """Parse a protobuf message into {field_number: [values]}; length-
+    delimited fields come back as raw bytes (decode nested messages by
+    calling decode again)."""
+    out: dict[int, list] = {}
+    i, n = 0, len(buf)
+    while i < n:
+        tag, i = _read_varint(buf, i)
+        num, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = _read_varint(buf, i)
+        elif wt == 2:
+            ln, i = _read_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = struct.unpack("<f", buf[i:i + 4])[0]
+            i += 4
+        elif wt == 1:
+            v = struct.unpack("<d", buf[i:i + 8])[0]
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        out.setdefault(num, []).append(v)
+    return out
+
+
+def _read_varint(buf, i):
+    shift = v = 0
+    while True:
+        b = buf[i]
+        i += 1
+        v |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return v, i
+        shift += 7
+
+
+def decode_tensor(buf: bytes) -> tuple[str, np.ndarray]:
+    f = decode(buf)
+    dims = [int(d) for d in f.get(1, [])]
+    dt = _ONNX2NP[int(f[2][0])]
+    name = f.get(8, [b""])[0].decode()
+    arr = np.frombuffer(f[9][0], dt).reshape(dims)
+    return name, arr

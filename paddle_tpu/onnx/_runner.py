@@ -1,0 +1,115 @@
+"""Dependency-free numpy evaluator for the ONNX op subset export() emits.
+
+Exists so exported models can be VERIFIED in-tree (decode the protobuf,
+re-execute the graph, compare against the framework's own forward) without
+an onnx runtime in the image — and doubles as executable documentation of
+the op subset's semantics."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import _proto as P
+
+
+def _get_attrs(node_fields):
+    attrs = {}
+    for raw in node_fields.get(5, []):
+        f = P.decode(raw)
+        name = f[1][0].decode()
+        atype = int(f.get(20, [0])[0])
+        if atype == P.ATTR_INT:
+            v = int(f[3][0])
+            if v >= 1 << 63:
+                v -= 1 << 64
+            attrs[name] = v
+        elif atype == P.ATTR_FLOAT:
+            attrs[name] = float(f[2][0])
+        elif atype == P.ATTR_STRING:
+            attrs[name] = f[4][0].decode()
+        elif atype == P.ATTR_INTS:
+            vals, i = [], 0
+            buf = f[8][0]
+            while i < len(buf):
+                v, i = P._read_varint(buf, i)
+                if v >= 1 << 63:
+                    v -= 1 << 64
+                vals.append(v)
+            attrs[name] = vals
+        elif atype == P.ATTR_TENSOR:
+            attrs[name] = P.decode_tensor(f[5][0])[1]
+    return attrs
+
+
+def run(model_bytes: bytes, inputs: dict[str, np.ndarray]):
+    """Execute a serialized ModelProto; returns {output_name: array}."""
+    mf = P.decode(model_bytes)
+    gf = P.decode(mf[7][0])
+    env = dict(inputs)
+    for raw in gf.get(5, []):                       # initializers
+        name, arr = P.decode_tensor(raw)
+        env[name] = arr
+    out_names = []
+    for raw in gf.get(12, []):                      # declared outputs
+        out_names.append(P.decode(raw)[1][0].decode())
+    for raw in gf.get(1, []):                       # nodes, topological
+        f = P.decode(raw)
+        ins = [env[b.decode()] for b in f.get(1, [])]
+        outs = [b.decode() for b in f.get(2, [])]
+        op = f[4][0].decode()
+        attrs = _get_attrs(f)
+        env[outs[0]] = _OPS[op](ins, attrs)
+    return {n: env[n] for n in out_names}
+
+
+def _reduce(fn, ins, attrs, axes_from_input):
+    x = ins[0]
+    axes = tuple(int(a) for a in (ins[1] if axes_from_input
+                                  else attrs.get("axes", [])))
+    return fn(x, axis=axes or None, keepdims=bool(attrs.get("keepdims", 1)))
+
+
+_OPS = {
+    "Add": lambda i, a: i[0] + i[1],
+    "Sub": lambda i, a: i[0] - i[1],
+    "Mul": lambda i, a: i[0] * i[1],
+    "Div": lambda i, a: i[0] / i[1],
+    "Max": lambda i, a: np.maximum(i[0], i[1]),
+    "Min": lambda i, a: np.minimum(i[0], i[1]),
+    "Pow": lambda i, a: np.power(i[0], i[1]),
+    "Neg": lambda i, a: -i[0],
+    "Exp": lambda i, a: np.exp(i[0]),
+    "Log": lambda i, a: np.log(i[0]),
+    "Tanh": lambda i, a: np.tanh(i[0]),
+    "Sigmoid": lambda i, a: 1.0 / (1.0 + np.exp(-i[0])),
+    "Sqrt": lambda i, a: np.sqrt(i[0]),
+    "Erf": lambda i, a: __import__("scipy.special",
+                                   fromlist=["erf"]).erf(i[0]),
+    "Abs": lambda i, a: np.abs(i[0]),
+    "Sign": lambda i, a: np.sign(i[0]),
+    "Floor": lambda i, a: np.floor(i[0]),
+    "Ceil": lambda i, a: np.ceil(i[0]),
+    "Reciprocal": lambda i, a: 1.0 / i[0],
+    "MatMul": lambda i, a: i[0] @ i[1],
+    "Transpose": lambda i, a: np.transpose(i[0], a["perm"]),
+    "Reshape": lambda i, a: i[0].reshape([int(d) for d in i[1]]),
+    "Expand": lambda i, a: np.broadcast_to(
+        i[0], [int(d) for d in i[1]]).copy(),
+    "Concat": lambda i, a: np.concatenate(i, axis=a["axis"]),
+    "Cast": lambda i, a: i[0].astype(P._ONNX2NP[a["to"]]),
+    "Where": lambda i, a: np.where(i[0], i[1], i[2]),
+    "Identity": lambda i, a: i[0],
+    "Greater": lambda i, a: i[0] > i[1],
+    "Less": lambda i, a: i[0] < i[1],
+    "GreaterOrEqual": lambda i, a: i[0] >= i[1],
+    "LessOrEqual": lambda i, a: i[0] <= i[1],
+    "Equal": lambda i, a: i[0] == i[1],
+    "And": lambda i, a: np.logical_and(i[0], i[1]),
+    "Or": lambda i, a: np.logical_or(i[0], i[1]),
+    "Not": lambda i, a: np.logical_not(i[0]),
+    "ReduceSum": lambda i, a: _reduce(np.sum, i, a, True),
+    "ReduceMax": lambda i, a: _reduce(np.max, i, a, False),
+    "ReduceMin": lambda i, a: _reduce(np.min, i, a, False),
+    "Slice": lambda i, a: i[0][tuple(
+        slice(int(s), int(e), int(st))
+        for s, e, st in zip(i[1], i[2], i[4]))],
+}

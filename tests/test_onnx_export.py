@@ -1,0 +1,94 @@
+"""Self-contained ONNX export (VERDICT r4 missing #5 / row #91): models
+export to real .onnx protobuf files whose graphs re-execute (via the in-tree
+numpy runner) to the same numbers as the framework forward."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.onnx import export
+from paddle_tpu.onnx import _proto as P
+from paddle_tpu.onnx import _runner
+
+
+def _roundtrip(layer, inputs, tmp_path, atol=1e-5):
+    path = export(layer, str(tmp_path / "m"), input_spec=inputs)
+    blob = open(path, "rb").read()
+    feeds = {f"x{i}": np.asarray(t._data) for i, t in enumerate(inputs)}
+    got = _runner.run(blob, feeds)
+    ref = layer(*inputs)
+    refs = ref if isinstance(ref, (tuple, list)) else [ref]
+    for i, r in enumerate(refs):
+        np.testing.assert_allclose(got[f"y{i}"], np.asarray(r._data),
+                                   atol=atol, rtol=1e-4)
+    return blob
+
+
+class TestOnnxExport:
+    def test_linear_relu_stack(self, tmp_path):
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                          nn.Linear(16, 4), nn.Sigmoid())
+        x = paddle.to_tensor(np.random.RandomState(0).rand(
+            5, 8).astype(np.float32))
+        blob = _roundtrip(m, [x], tmp_path)
+        # structural: a real ModelProto with IR version, opset and our graph
+        mf = P.decode(blob)
+        assert int(mf[1][0]) == 8                       # ir_version
+        opset = P.decode(mf[8][0])
+        assert int(opset[2][0]) == 17
+        gf = P.decode(mf[7][0])
+        ops = [P.decode(n)[4][0].decode() for n in gf[1]]
+        assert "MatMul" in ops and "Sigmoid" in ops
+
+    def test_layernorm_gelu_mlp(self, tmp_path):
+        paddle.seed(1)
+        m = nn.Sequential(nn.Linear(6, 12), nn.LayerNorm(12), nn.GELU(),
+                          nn.Linear(12, 3))
+        x = paddle.to_tensor(np.random.RandomState(1).rand(
+            4, 6).astype(np.float32))
+        _roundtrip(m, [x], tmp_path, atol=1e-4)
+
+    def test_functional_callable_and_multi_output(self, tmp_path):
+        def f(a, b):
+            s = a + b.exp()
+            return s.tanh(), (s * 2.0).mean()
+
+        x = paddle.to_tensor(np.random.RandomState(2).rand(
+            3, 4).astype(np.float32))
+        y = paddle.to_tensor(np.random.RandomState(3).rand(
+            3, 4).astype(np.float32))
+        path = export(f, str(tmp_path / "fn"), input_spec=[x, y])
+        got = _runner.run(open(path, "rb").read(),
+                          {"x0": np.asarray(x._data),
+                           "x1": np.asarray(y._data)})
+        np.testing.assert_allclose(
+            got["y0"], np.tanh(np.asarray(x._data) + np.exp(np.asarray(y._data))),
+            atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(
+            got["y1"],
+            ((np.asarray(x._data) + np.exp(np.asarray(y._data))) * 2).mean(),
+            atol=1e-5, rtol=1e-5)
+
+    def test_unsupported_primitive_raises_with_name(self, tmp_path):
+        def f(a):
+            return paddle.ops.cumsum(a)   # cumsum is outside the subset
+
+        x = paddle.to_tensor(np.ones((3,), np.float32))
+        with pytest.raises(NotImplementedError, match="cumsum"):
+            export(f, str(tmp_path / "bad"), input_spec=[x])
+
+    def test_input_spec_objects(self, tmp_path):
+        paddle.seed(2)
+        m = nn.Linear(4, 2)
+
+        class Spec:
+            shape = [None, 4]
+            dtype = "float32"
+
+        path = export(m, str(tmp_path / "spec"), input_spec=[Spec()])
+        got = _runner.run(open(path, "rb").read(),
+                          {"x0": np.zeros((1, 4), np.float32)})
+        ref = m(paddle.to_tensor(np.zeros((1, 4), np.float32)))
+        np.testing.assert_allclose(got["y0"], np.asarray(ref._data),
+                                   atol=1e-6)
