@@ -15,7 +15,11 @@ import itertools
 import os
 import time
 
-__all__ = ["AutoTuner", "candidate_configs", "Recorder"]
+from .cost_model import (AnalyticCostModel, HardwareSpec, ModelDesc,  # noqa: F401
+                         HW_PRESETS)
+
+__all__ = ["AutoTuner", "candidate_configs", "Recorder",
+           "AnalyticCostModel", "HardwareSpec", "ModelDesc", "HW_PRESETS"]
 
 
 def _divisors(n):
@@ -95,7 +99,13 @@ class AutoTuner:
     """reference: tuner.py:21 — iterate search_once()/add_cfg until
     candidates are exhausted, then best_cfg."""
 
-    def __init__(self, tuner_cfg):
+    def __init__(self, tuner_cfg, cost_model=None):
+        """cost_model: optional AnalyticCostModel. When given, candidates are
+        RANKED by estimated step time before any trial runs, infeasible
+        layouts (per-chip memory over HBM) are dropped, and
+        tuner_cfg['prune_to'] keeps only the top-K — the reference's
+        estimate_cost.py pre-pruning, which a purely empirical tuner cannot
+        do (it cannot rank candidates it never runs)."""
         self.cfg = dict(tuner_cfg)
         self.recorder = Recorder()
         self._candidates = candidate_configs(
@@ -106,6 +116,14 @@ class AutoTuner:
             max_pp=self.cfg.get("max_pp_degree"),
             global_batch=self.cfg.get("global_batch_size"),
             micro_batches=tuple(self.cfg.get("micro_batches", (1, 2, 4, 8))))
+        self.cost_model = cost_model
+        if cost_model is not None:
+            ranked = cost_model.rank(self._candidates)
+            ranked = [c for c in ranked if c["_estimate"]["feasible"]]
+            prune_to = self.cfg.get("prune_to")
+            if prune_to:
+                ranked = ranked[:int(prune_to)]
+            self._candidates = ranked
         self._idx = 0
         self.direction = self.cfg.get("direction", "Maximize")
 

@@ -1,0 +1,164 @@
+"""Analytic parallelism cost model (reference:
+python/paddle/distributed/auto_parallel/static/cost/estimate_cost.py +
+base_cost.py + comm_op_cost.py — per-op compute/communication estimates that
+let the search RANK candidates it never runs).
+
+TPU framing: a candidate is a (dp, mp, pp, sharding, micro_batch) layout of
+a transformer workload over a chip count.  The estimate decomposes a train
+step into:
+
+  compute    — model flops / (chips x peak x matmul-efficiency); efficiency
+               degrades when mp slices contractions below the 128/256-wide
+               MXU sweet spot (the scaling-book "shrinking matmul" effect).
+  tp_comm    — Megatron TP: 2 all-reduces of activations per layer forward
+               (+2 backward), ring cost 2(n-1)/n x bytes / ici_bw.
+  grad_sync  — dp all-reduce (or sharding reduce-scatter+all-gather, same
+               ring volume) of the local parameter bytes, once per step.
+  pp         — GPipe/1F1B bubble (pp-1)/(m+pp-1) stretching compute+tp, plus
+               per-microbatch boundary activation sends.
+  memory     — params x (weight+grad+opt bytes, sharded as the layout
+               shards them) + activation working set; a candidate whose
+               per-chip bytes exceed HBM is infeasible (cost = inf), which
+               is the analytic pruning the empirical tuner cannot do.
+
+Numbers are deliberately coarse (public spec sheets, overridable): the model
+exists to ORDER candidates and rule out infeasible ones so the empirical
+tuner (auto_tuner.run_trials) spends its trial budget on the plausible few.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+__all__ = ["HardwareSpec", "ModelDesc", "AnalyticCostModel", "HW_PRESETS"]
+
+
+@dataclass
+class HardwareSpec:
+    peak_flops: float = 197e12      # bf16 per chip
+    hbm_bytes: float = 16e9
+    hbm_bw: float = 819e9
+    ici_bw: float = 90e9            # effective per-direction bytes/s
+    ici_latency: float = 1e-6       # per collective hop
+
+
+HW_PRESETS = {
+    "v5e": HardwareSpec(197e12, 16e9, 819e9, 90e9),
+    "v5p": HardwareSpec(459e12, 95e9, 2765e9, 300e9),
+    "v4": HardwareSpec(275e12, 32e9, 1228e9, 135e9),
+    "v6e": HardwareSpec(918e12, 32e9, 1640e9, 180e9),
+}
+
+
+@dataclass
+class ModelDesc:
+    num_layers: int
+    hidden: int
+    seq_len: int
+    vocab: int = 32000
+    intermediate: int = None        # default 4x hidden
+    global_batch: int = 8
+    dtype_bytes: int = 2            # bf16 weights/activations
+    opt_bytes_per_param: int = 8    # AdamW f32 moments
+    extra: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.intermediate is None:
+            self.intermediate = 4 * self.hidden
+
+    @property
+    def params(self) -> float:
+        per_layer = (4 * self.hidden * self.hidden          # qkvo
+                     + 3 * self.hidden * self.intermediate)  # swiglu mlp
+        return self.num_layers * per_layer + self.vocab * self.hidden
+
+
+class AnalyticCostModel:
+    def __init__(self, model: ModelDesc, hw: HardwareSpec | str = "v5e",
+                 base_efficiency=0.5):
+        self.m = model
+        self.hw = HW_PRESETS[hw] if isinstance(hw, str) else hw
+        self.base_eff = base_efficiency
+
+    # ------------------------------ pieces -----------------------------------
+    def _ring_allreduce_s(self, bytes_, n):
+        if n <= 1 or bytes_ <= 0:
+            return 0.0
+        return (2 * (n - 1) / n) * bytes_ / self.hw.ici_bw \
+            + (n - 1) * self.hw.ici_latency
+
+    def _efficiency(self, mp):
+        """Matmul efficiency falls as mp slices the contraction/output dims
+        below the MXU tile; coarse but monotone (scaling-book shape rule)."""
+        eff = self.base_eff
+        min_dim = min(self.m.hidden, self.m.intermediate) / max(mp, 1)
+        if min_dim < 128:
+            eff *= min_dim / 128.0
+        elif min_dim < 256:
+            eff *= 0.85
+        return max(eff, 1e-3)
+
+    # ------------------------------ estimate ---------------------------------
+    def estimate(self, cfg) -> dict:
+        m, hw = self.m, self.hw
+        dp = cfg.get("dp_degree", 1)
+        mp = cfg.get("mp_degree", 1)
+        pp = cfg.get("pp_degree", 1)
+        sh = cfg.get("sharding_degree", 1)
+        mbs = cfg.get("micro_batch_size", 1)
+        chips = dp * mp * pp * sh
+
+        local_batch = m.global_batch / (dp * sh)
+        micro = max(1, int(math.ceil(local_batch / mbs)))
+        tokens = m.global_batch * m.seq_len
+
+        # -- memory feasibility (params sharded by mp x pp x sharding) --------
+        p_local = m.params / (mp * pp * max(sh, 1))
+        state = p_local * (m.dtype_bytes + m.dtype_bytes
+                           + m.opt_bytes_per_param)
+        act = (mbs * m.seq_len * m.hidden * m.dtype_bytes
+               * (m.num_layers / pp) * 6 / mp)   # ~6 live tensors/layer
+        logits = mbs * m.seq_len * m.vocab * 4 / mp if pp == 1 else 0
+        mem = state + act + logits
+        feasible = mem <= hw.hbm_bytes
+
+        # -- compute ----------------------------------------------------------
+        flops = tokens * (6 * m.params
+                          + 12 * m.num_layers * m.hidden * m.seq_len)
+        compute = flops / (chips * hw.peak_flops * self._efficiency(mp))
+
+        # -- TP activation all-reduces ---------------------------------------
+        act_bytes = mbs * m.seq_len * m.hidden * m.dtype_bytes
+        per_micro = 4 * m.num_layers / pp * self._ring_allreduce_s(
+            act_bytes, mp)
+        tp_comm = per_micro * micro
+
+        # -- gradient sync over dp x sharding ---------------------------------
+        grad_sync = self._ring_allreduce_s(
+            (m.params / (mp * pp)) * m.dtype_bytes, dp * sh)
+
+        # -- pipeline ---------------------------------------------------------
+        bubble = (pp - 1) / (micro + pp - 1) if pp > 1 else 0.0
+        p2p = 0.0
+        if pp > 1:
+            p2p = 2 * (pp - 1) * micro * act_bytes / hw.ici_bw
+
+        work = (compute + tp_comm) / max(1 - bubble, 1e-6) + p2p + grad_sync
+        return {
+            "step_time_s": work if feasible else float("inf"),
+            "compute_s": compute, "tp_comm_s": tp_comm,
+            "grad_sync_s": grad_sync, "p2p_s": p2p,
+            "pp_bubble_frac": bubble,
+            "mem_bytes_per_chip": mem, "feasible": feasible,
+            "tokens_per_sec": (tokens / work) if feasible and work > 0 else 0.0,
+        }
+
+    def rank(self, cfgs) -> list:
+        """Candidates ordered best-first by estimated step time (infeasible
+        last); each gets an '_estimate' key attached."""
+        scored = []
+        for cfg in cfgs:
+            est = self.estimate(cfg)
+            scored.append({**cfg, "_estimate": est})
+        scored.sort(key=lambda c: c["_estimate"]["step_time_s"])
+        return scored

@@ -101,3 +101,80 @@ class TestTunerLoop:
         best = tuner.run_trials(make_step, warmup=1, iters=2)
         assert best is not None
         assert best["pp_degree"] == 1 and best["sharding_degree"] == 1
+
+
+class TestAnalyticCostModel:
+    """VERDICT r4 missing #4: analytic comp/comm cost estimates so the
+    search can rank candidates it never runs (reference
+    auto_parallel/static/cost/estimate_cost.py)."""
+
+    def _model(self, **kw):
+        from paddle_tpu.distributed.auto_tuner import (AnalyticCostModel,
+                                                       ModelDesc)
+        desc = dict(num_layers=32, hidden=4096, seq_len=4096, vocab=128256,
+                    intermediate=14336, global_batch=64)
+        desc.update(kw)
+        return AnalyticCostModel(ModelDesc(**desc), hw="v5p")
+
+    def test_memory_infeasible_pruned(self):
+        cm = self._model()
+        # Llama-8B-ish on ONE chip: weights+AdamW alone bust HBM
+        est = cm.estimate({"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                           "sharding_degree": 1, "micro_batch_size": 1})
+        assert not est["feasible"] and est["step_time_s"] == float("inf")
+        # sharded over 64 chips: fits
+        est64 = cm.estimate({"dp_degree": 8, "mp_degree": 8, "pp_degree": 1,
+                             "sharding_degree": 1, "micro_batch_size": 1})
+        assert est64["feasible"]
+
+    def test_tp_comm_grows_with_mp(self):
+        cm = self._model()
+        base = dict(pp_degree=1, sharding_degree=1, micro_batch_size=1)
+        e2 = cm.estimate({**base, "dp_degree": 32, "mp_degree": 2})
+        e8 = cm.estimate({**base, "dp_degree": 8, "mp_degree": 8})
+        assert e8["tp_comm_s"] > e2["tp_comm_s"]
+
+    def test_pp_bubble_shrinks_with_more_microbatches(self):
+        cm = self._model()
+        base = dict(dp_degree=2, mp_degree=4, pp_degree=4,
+                    sharding_degree=1)
+        few = cm.estimate({**base, "micro_batch_size": 16})
+        many = cm.estimate({**base, "micro_batch_size": 1})
+        assert many["pp_bubble_frac"] < few["pp_bubble_frac"]
+
+    def test_rank_orders_feasible_first_and_by_time(self):
+        from paddle_tpu.distributed.auto_tuner import candidate_configs
+        cm = self._model()
+        cfgs = candidate_configs(64, num_layers=32, global_batch=64)
+        ranked = cm.rank(cfgs)
+        times = [c["_estimate"]["step_time_s"] for c in ranked]
+        assert times == sorted(times)
+        assert ranked[0]["_estimate"]["feasible"]
+
+    def test_autotuner_prunes_with_cost_model(self):
+        from paddle_tpu.distributed.auto_tuner import AutoTuner
+        cm = self._model()
+        tuner = AutoTuner({"num_devices": 64, "num_layers": 32,
+                           "global_batch_size": 64, "prune_to": 5},
+                          cost_model=cm)
+        assert tuner.search_space_size == 5
+        # every surviving candidate is feasible and carries its estimate
+        seen = []
+        while True:
+            cfg = tuner.search_once()
+            if cfg is None:
+                break
+            assert cfg["_estimate"]["feasible"]
+            seen.append(cfg)
+        assert len(seen) == 5
+
+    def test_small_model_prefers_pure_dp(self):
+        """A small model fitting on one chip: splitting it (mp) only adds
+        comm, so pure dp must rank first among 8-chip layouts."""
+        cm = self._model(num_layers=12, hidden=768, seq_len=1024,
+                         vocab=50257, intermediate=3072, global_batch=64)
+        from paddle_tpu.distributed.auto_tuner import candidate_configs
+        cfgs = candidate_configs(8, num_layers=12, global_batch=64)
+        best = cm.rank(cfgs)[0]
+        assert best["mp_degree"] == 1 and best["pp_degree"] == 1
+        assert best["dp_degree"] * best["sharding_degree"] == 8
