@@ -125,7 +125,7 @@ class LLMEngine:
     def __init__(self, model, mesh=None, mp_axis="mp", pp_axis="pp",
                  max_batch=4, max_len=256, page_size=16, prefill_chunk=32,
                  page_pool=None, decode_block=1, use_kernel=None, seed=0,
-                 kv_cache_dtype="auto"):
+                 kv_cache_dtype="auto", decode_block_max=32):
         """page_pool: usable KV pages (the HBM budget). Defaults to the
         worst case (max_batch * ceil(max_len/page)); set it SMALLER to
         oversubscribe — on-demand growth means slots only claim what they
@@ -134,7 +134,12 @@ class LLMEngine:
         decode_block: max decode steps fused into one dispatch (power-of-two
         blocks are chosen per step, shrinking near max_new; eos-bearing
         requests force 1). Raise it when dispatch latency, not throughput,
-        dominates (e.g. a remote/tunneled runtime).
+        dominates (e.g. a remote/tunneled runtime) — or pass "auto": the
+        engine then samples wall time at two block sizes, solves the
+        dispatch model t(k) = RTT + k*c for the session's actual round-trip
+        latency and per-token device time, and picks the power-of-two block
+        where RTT costs <= ~25% of device time (re-estimated as timing
+        samples accumulate, capped at decode_block_max).
 
         kv_cache_dtype: "auto" stores pages in the weight dtype; "int8"
         quantizes K/V pages per-(token, kv-head) with f32 scales (reference:
@@ -239,7 +244,13 @@ class LLMEngine:
         self._admit_seq = 0
         self._seed_counter = np.int64(seed) * 1_000_003
         self.preemptions = 0
-        self.decode_block = max(1, int(decode_block))
+        self._auto_block = decode_block == "auto"
+        if self._auto_block:
+            self.decode_block = max(1, int(decode_block_max))
+            self._block_target = 1          # sample k=1 first, then k=2
+            self._block_samples: dict = {}  # k -> recent wall dts
+        else:
+            self.decode_block = max(1, int(decode_block))
         self._decode_programs: dict = {}
         self._prefill = self._build_prefill()
 
@@ -532,10 +543,10 @@ class LLMEngine:
         if not live:
             return 0
         # block size: largest power of two <= every slot's remaining budget,
-        # capped by decode_block; any eos request needs per-token host
-        # inspection -> 1
-        k = min(self.decode_block,
-                min(r.max_new - len(r.out) for _, r in live))
+        # capped by decode_block (or the RTT-adapted target in auto mode);
+        # any eos request needs per-token host inspection -> 1
+        cap = self._block_target if self._auto_block else self.decode_block
+        k = min(cap, min(r.max_new - len(r.out) for _, r in live))
         if any(r.eos is not None for _, r in live):
             k = 1
         k = 1 << max(0, k.bit_length() - 1)              # floor to pow2
@@ -567,8 +578,10 @@ class LLMEngine:
             seeds[slot] = self._next_seed(r)
             fold[slot] = 1 if r.seed is None else 0
         prog = self._decode_programs.get(k)
-        if prog is None:
+        compile_call = prog is None
+        if compile_call:
             prog = self._decode_programs[k] = self._build_decode(k)
+        t0 = time.perf_counter()
         toks, self.cache = prog(
             self.W, self.cache, jnp.asarray(tokens),
             jnp.asarray(self._lens), jnp.asarray(self._slot_tables),
@@ -576,6 +589,9 @@ class LLMEngine:
             jnp.asarray(topp), jnp.asarray(topk), jnp.asarray(seeds),
             jnp.asarray(fold))
         toks = np.asarray(toks)                          # [k, B]
+        if self._auto_block and not compile_call:
+            # host sync above makes the wall time a true dispatch sample
+            self._record_block_sample(k, time.perf_counter() - t0)
         for j in range(k):
             for slot, r in live:
                 if self._slots[slot] is not r:           # released mid-block
@@ -583,6 +599,35 @@ class LLMEngine:
                 self._lens[slot] += 1
                 self._emit(slot, int(toks[j, slot]))
         return len(live)
+
+    def _record_block_sample(self, k, wall_dt):
+        """Auto decode-block: fit t(k) = RTT + k*c from the two smallest
+        sampled block sizes and target the power-of-two k where the
+        per-dispatch constant costs <= ~25% of device time (k >= 3*RTT/c)."""
+        samples = self._block_samples.setdefault(k, [])
+        samples.append(wall_dt)
+        del samples[:-8]
+        sampled = {kk: sorted(v)[len(v) // 2]
+                   for kk, v in self._block_samples.items() if v}
+        if len(sampled) < 2:
+            # force a second sample size next step so the model is solvable
+            self._block_target = min(2, self.decode_block) \
+                if 1 in sampled else 1
+            return
+        (ka, ta), (kb, tb) = sorted(sampled.items())[:2]
+        c = (tb - ta) / (kb - ka)
+        rtt = ta - ka * c
+        if c <= 0 or rtt <= 0:       # noise/local runtime: RTT negligible
+            self._block_target = min(2, self.decode_block)
+            return
+        want = max(1, int(3 * rtt / c))
+        want = 1 << (want.bit_length() - 1)              # floor to pow2
+        self._block_target = min(want, self.decode_block)
+
+    @property
+    def auto_decode_block(self):
+        """Current RTT-adapted block target (auto mode only)."""
+        return self._block_target if self._auto_block else self.decode_block
 
     def run_until_done(self, max_steps=10000):
         steps = 0
