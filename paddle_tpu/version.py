@@ -1,6 +1,6 @@
-__version__ = "0.4.0"
+__version__ = "0.5.0"
 full_version = __version__
-major, minor, patch = 0, 4, 0
+major, minor, patch = 0, 5, 0
 
 
 def show():
