@@ -1079,6 +1079,23 @@ def _istft_smoke():
     return S.istft(spec, 16, length=64)
 
 
+# fd-grad eligibility for the r5-converted goldens: linear/smooth ops with
+# plain float tensor inputs (decompositions, integer/complex outputs and
+# list-input ops stay un-graded — op_test's harness can't finite-difference
+# those shapes)
+for _gname in [
+    "expand_as", "masked_fill", "take_along_axis",
+    "index_sample", "tensordot", "einsum", "cholesky_solve",
+    "triangular_solve", "reduce_as", "unfold", "as_strided",
+    "slice", "strided_slice", "slice_scatter", "select_scatter",
+    "diagonal_scatter", "fill_diagonal", "index_fill", "index_put",
+    "scatter", "scatter_nd_add", "put_along_axis", "gather_nd",
+    "split", "chunk", "tensor_split", "hsplit", "vsplit", "dsplit",
+    "unbind", "unstack", "frame", "overlap_add",
+]:
+    REGISTRY[_gname].grad = True
+
+
 # =============================================================================
 # coverage report
 # =============================================================================
