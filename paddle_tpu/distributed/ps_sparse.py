@@ -73,6 +73,7 @@ class SparseShard:
         self._db.execute("CREATE TABLE IF NOT EXISTS rows ("
                          "id INTEGER PRIMARY KEY, row BLOB, accum REAL, "
                          "score REAL DEFAULT 0)")
+        self._migrate_schema()
         # resident pool: id -> pool slot; LRU tick per slot
         self.pool = np.zeros((self.capacity, self.dim), np.float32)
         self.accum = np.zeros((self.capacity,), np.float32)   # adagrad state
@@ -85,7 +86,22 @@ class SparseShard:
         # pre-admission candidates: id -> cumulative push count (bounded)
         self._candidates: dict[int, float] = {}
         self._cand_budget = max(8 * self.capacity, 1024)
+        # the one fixed row every unadmitted pull returns (reference: missing
+        # features pull default values) — drawn ONCE at init so read-only
+        # pulls neither perturb the init RNG stream nor return a different
+        # vector per call
+        self._unadmitted_row = self._init_row()
         self.lock = threading.Lock()
+
+    def _migrate_schema(self):
+        """Spill DBs / checkpoints written before the accessor policy have a
+        3-column rows table; add the score column in place so old data loads
+        (scores start at 0 = coldest, which is the honest prior)."""
+        cols = [r[1] for r in self._db.execute("PRAGMA table_info(rows)")]
+        if "score" not in cols:
+            self._db.execute(
+                "ALTER TABLE rows ADD COLUMN score REAL DEFAULT 0")
+            self._db.commit()
 
     # -- row lifecycle --------------------------------------------------------
     def _init_row(self):
@@ -153,7 +169,7 @@ class SparseShard:
             for i, rid in enumerate(ids):
                 slot = self._resident(int(rid), create=create)
                 out[i] = self.pool[slot] if slot is not None \
-                    else self._init_row()
+                    else self._unadmitted_row
             self._commit_evictions()
         return out
 
@@ -256,6 +272,8 @@ class SparseShard:
             self._db.commit()       # backup needs no open txn on the dest
             src.backup(self._db)
             src.close()
+            self._migrate_schema()  # a pre-score-column checkpoint replaces
+            # the whole schema via backup(); re-add the column if needed
             self.slot_of.clear()
             self.id_of[:] = -1
             self.tick_of[:] = 0

@@ -144,8 +144,9 @@ class LLMEngine:
         kv_cache_dtype: "auto" stores pages in the weight dtype; "int8"
         quantizes K/V pages per-(token, kv-head) with f32 scales (reference:
         incubate block_multihead_attention cache_*_quant_scales, dynamic
-        mode) — pages cost ~(D + 8)/(2*D) of bf16 bytes, so the same HBM
-        budget holds ~2x the tokens / concurrent slots."""
+        mode) — pages cost (D + 4)/(2*D) of bf16 bytes (~0.52 at
+        head_dim=128), so the same HBM budget holds ~2x the tokens /
+        concurrent slots."""
         cfg = model.config
         self.cfg = cfg
         self.max_batch = max_batch

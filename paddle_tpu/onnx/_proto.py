@@ -30,6 +30,11 @@ _NP2ONNX = {
 }
 
 _ONNX2NP = {v: k for k, v in _NP2ONNX.items()}
+try:                                   # bf16 graphs decode via ml_dtypes
+    import ml_dtypes as _mld
+    _ONNX2NP[BFLOAT16] = np.dtype(_mld.bfloat16)
+except ImportError:                    # pragma: no cover
+    pass
 
 
 def np_to_onnx_dtype(dt) -> int:

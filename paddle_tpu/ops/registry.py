@@ -901,7 +901,7 @@ g("create_parameter", None, lambda: [], "creation", kind="smoke",
   kwargs={"shape": [3, 4], "dtype": "float32"},
   reason="RNG-valued (default initializer draws from the global seed)")
 g("is_tensor", None, None, "logic",
-  check=lambda raw, out: _tonp(out).shape == (2,),
+  check=lambda raw, out: np.testing.assert_equal(_tonp(out).shape, (2,)),
   op="paddle_tpu.ops.registry._is_tensor_smoke")
 g("is_complex", lambda x: False, lambda: [U(2)], "logic")
 g("is_integer", lambda x: True, lambda: [I(2)], "logic")

@@ -92,3 +92,21 @@ class TestOnnxExport:
         ref = m(paddle.to_tensor(np.zeros((1, 4), np.float32)))
         np.testing.assert_allclose(got["y0"], np.asarray(ref._data),
                                    atol=1e-6)
+
+
+class TestBf16Export:
+    def test_bf16_initializers_decode(self, tmp_path):
+        """bf16 models export and their initializers decode in-tree (the
+        runner's dtype table covers BFLOAT16)."""
+        import ml_dtypes
+        paddle.seed(3)
+        m = nn.Linear(4, 3)
+        m.to(dtype="bfloat16")
+        x = paddle.to_tensor(
+            np.zeros((2, 4), np.float32)).astype("bfloat16")
+        path = export(m, str(tmp_path / "bf16"), input_spec=[x])
+        mf = P.decode(open(path, "rb").read())
+        gf = P.decode(mf[7][0])
+        decoded = [P.decode_tensor(t) for t in gf.get(5, [])]
+        assert any(arr.dtype == np.dtype(ml_dtypes.bfloat16)
+                   for _, arr in decoded)

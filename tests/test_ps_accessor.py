@@ -157,3 +157,32 @@ class TestWireAuth:
         finally:
             if proc.poll() is None:
                 proc.kill()
+
+
+class TestMigrationAndDeterminism:
+    def test_legacy_3col_spill_db_migrates(self, tmp_path):
+        """Spill DBs written before the score column must load and serve."""
+        import sqlite3
+        con = sqlite3.connect(tmp_path / "t.spill.sqlite")
+        con.execute("CREATE TABLE rows (id INTEGER PRIMARY KEY, "
+                    "row BLOB, accum REAL)")
+        con.execute("INSERT INTO rows VALUES (?, ?, ?)",
+                    (5, np.full((4,), 2.0, np.float32).tobytes(), 0.0))
+        con.commit()
+        con.close()
+        sh = SparseShard("t", dim=4, capacity_rows=2, data_dir=str(tmp_path),
+                         lr=1.0, initializer="zeros")
+        np.testing.assert_allclose(sh.pull(np.array([5])), 2.0)
+        # eviction path writes 4 columns into the migrated table
+        sh.push(np.arange(10, dtype=np.int64), np.ones((10, 4), np.float32))
+        assert sh.stats()["spilled"] >= 8
+
+    def test_unadmitted_pull_is_deterministic(self, tmp_path):
+        """Read-only pulls of unadmitted ids return ONE fixed default row
+        and never perturb the init RNG stream."""
+        sh = SparseShard("t", dim=8, capacity_rows=8, data_dir=str(tmp_path),
+                         admit_threshold=2, initializer="uniform")
+        a = sh.pull(np.array([1]))
+        b = sh.pull(np.array([1, 999]))
+        np.testing.assert_array_equal(a[0], b[0])
+        np.testing.assert_array_equal(b[0], b[1])
