@@ -375,9 +375,11 @@ class TestTextDatasets:
 
 
 class TestOnnxGate:
-    def test_export_gated(self):
+    def test_export_requires_input_spec(self):
+        """export() is real now (tests/test_onnx_export.py); the remaining
+        gate is the input_spec requirement."""
         import paddle_tpu.onnx as onnx_mod
-        with pytest.raises((ImportError, NotImplementedError)):
+        with pytest.raises(ValueError, match="input_spec"):
             onnx_mod.export(None, "/tmp/x.onnx")
 
 
