@@ -247,3 +247,38 @@ class TestFloatBreakStitching:
         group = next(iter(step._cache.values()))
         assert group.eager_only                  # documented restriction
         assert len(metrics) == 6                 # but all steps really ran
+
+
+class TestMultipleBreaks:
+    def test_two_floats_and_numpy_in_order(self):
+        """Several breaks per step: values arrive in program order, every
+        call, with the step still compiled."""
+        m, opt = _model_and_opt()
+        seen = []
+
+        def train_step(x, y):
+            pred = m(x)
+            loss = ((pred - y) ** 2).mean()
+            pre = float(loss)                  # break 1 (pre-update loss)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            seen.append((pre, pred.numpy().mean(), float(loss)))  # 2, 3
+            return loss
+
+        step = paddle.jit.to_static(train_step)
+        data = _data()
+        step(*data[0])                         # capture warmup
+        step(*data[1])
+        seen.clear()
+        vals = [float(np.asarray(step(x, y)._data)) for x, y in data[2:5]]
+        group = next(iter(step._cache.values()))
+        assert not group.eager_only
+        assert group.variants[0].break_kinds == ("float", "numpy", "float")
+        assert len(seen) == 3
+        for (pre, pmean, post), v in zip(seen, vals):
+            assert pre == pytest.approx(v, rel=1e-5)   # same tensor read 2x
+            assert post == pytest.approx(v, rel=1e-5)
+            assert np.isfinite(pmean)
+        # distinct calls observed distinct values
+        assert seen[0][0] != seen[1][0]
