@@ -1,0 +1,32 @@
+"""Export a model to ONNX and verify it with the in-tree numpy runner.
+
+No external onnx package needed: the exporter serializes the captured jaxpr
+directly against the public onnx.proto schema.
+
+Run:  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python examples/export_onnx.py
+"""
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.onnx import export, _runner
+
+
+def main():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 4))
+    x = paddle.to_tensor(np.random.RandomState(0).rand(
+        3, 16).astype(np.float32))
+    path = export(model, tempfile.mkdtemp() + "/mlp", input_spec=[x])
+    got = _runner.run(open(path, "rb").read(),
+                      {"x0": np.asarray(x._data)})["y0"]
+    ref = np.asarray(model(x)._data)
+    np.testing.assert_allclose(got, ref, atol=1e-4, rtol=1e-4)
+    print(f"exported {path} and verified: max|Δ| = "
+          f"{np.abs(got - ref).max():.2e}")
+
+
+if __name__ == "__main__":
+    main()

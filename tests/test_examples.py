@@ -1,0 +1,21 @@
+"""The examples/ scripts are the user's first contact — they must run."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = sorted(f for f in os.listdir(os.path.join(ROOT, "examples"))
+                  if f.endswith(".py"))
+
+
+@pytest.mark.parametrize("script", EXAMPLES)
+def test_example_runs(script):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run([sys.executable, os.path.join("examples", script)],
+                       cwd=ROOT, env=env, capture_output=True, text=True,
+                       timeout=420)
+    assert r.returncode == 0, f"{script}:\n{r.stderr[-2000:]}"
