@@ -81,7 +81,14 @@ def _kernel_q(bt_ref, cl_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
     cache_k_quant_scales/cache_v_quant_scales, dynamic mode): pages carry
     int8 values + a per-(token, kv-head) f32 scale; the kernel dequantizes
     page tiles in VMEM right before the MXU dots, so HBM traffic (and page
-    capacity) is ~half the bf16 cache's."""
+    capacity) is ~half the bf16 cache's.
+
+    Validation status: numerics proven against the dense reference in
+    interpret mode (tests/test_kv_int8.py); Mosaic lowering of the int8
+    VMEM loads has not yet run on a real chip (the tunnel was down for the
+    whole r5 round) — the serving bench exercises it first thing on chip
+    and its extras are isolated, so a lowering failure cannot take down the
+    engine's bf16 path or the flagship metric."""
     b = pl.program_id(0)
     s = pl.program_id(1)
 
