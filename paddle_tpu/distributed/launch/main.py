@@ -34,6 +34,13 @@ def _parse(argv=None):
                         "times on worker failure")
     p.add_argument("--backend", default=None,
                    help="set JAX_PLATFORMS for workers (e.g. cpu)")
+    p.add_argument("--backend_probe_timeout", type=float, default=90.0,
+                   help="before spawning accelerator workers, verify the "
+                        "backend initializes in a throwaway child within "
+                        "this many seconds — a dead/unreachable tunnel then "
+                        "fails the launch immediately with one clear error "
+                        "instead of N workers hanging to their timeouts. "
+                        "0 disables the probe.")
     p.add_argument("script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -102,8 +109,33 @@ def _supervise(procs):
     return rc
 
 
+def _probe_backend(timeout):
+    """True if a fresh interpreter can initialize the accelerator backend.
+    Runs in a child so a hang/failure never wedges the launcher itself."""
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.devices(); print('BACKEND_READY')"],
+        capture_output=True, text=True, timeout=timeout)
+    return r.returncode == 0 and "BACKEND_READY" in r.stdout
+
+
 def launch(argv=None):
     args = _parse(argv)
+    probe_accel = (args.backend != "cpu"
+                   and args.backend_probe_timeout > 0
+                   and os.environ.get("PALLAS_AXON_POOL_IPS"))
+    if probe_accel:
+        try:
+            ok = _probe_backend(args.backend_probe_timeout)
+        except subprocess.TimeoutExpired:
+            ok = False
+        if not ok:
+            print("launch: accelerator backend failed to initialize within "
+                  f"{args.backend_probe_timeout:.0f}s (tunnel down or chip "
+                  "held by another process). Fix the backend, or run on CPU "
+                  "with --backend cpu, or skip this check with "
+                  "--backend_probe_timeout 0.", file=sys.stderr)
+            return 3
     if args.nproc_per_node is None:
         try:
             import jax

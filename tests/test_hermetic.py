@@ -91,3 +91,33 @@ class TestSpawnPathsAreHermetic:
             p.wait(timeout=10)
         finally:
             os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+
+class TestLaunchBackendProbe:
+    def test_dead_tunnel_fails_fast_with_clear_error(self, tmp_path, capsys):
+        """An accelerator launch against a dead tunnel must fail in ONE probe
+        child with one clear message, not N workers hanging to timeouts."""
+        from paddle_tpu.distributed.launch.main import launch
+        script = tmp_path / "t.py"
+        script.write_text("print('ran')\n")
+        os.environ["PALLAS_AXON_POOL_IPS"] = UNREACHABLE
+        try:
+            rc = launch(["--nproc_per_node=2", "--backend_probe_timeout=20",
+                         f"--log_dir={tmp_path}/log", str(script)])
+        finally:
+            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        assert rc == 3
+        assert not (tmp_path / "log" / "workerlog.0").exists()
+
+    def test_cpu_backend_skips_probe(self, tmp_path):
+        from paddle_tpu.distributed.launch.main import launch
+        script = tmp_path / "t.py"
+        script.write_text("print('ran')\n")
+        os.environ["PALLAS_AXON_POOL_IPS"] = UNREACHABLE
+        try:
+            rc = launch(["--nproc_per_node=1", "--backend=cpu",
+                         f"--log_dir={tmp_path}/log", str(script)])
+        finally:
+            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        assert rc == 0
+        assert "ran" in (tmp_path / "log" / "workerlog.0").read_text()
