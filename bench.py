@@ -437,11 +437,15 @@ def _serving_bench(paddle, on_tpu):
         try:
             bpp_fp = eng.kv_bytes_per_page()
             del eng
+            # same block policy as the bf16 engine so the decode-rate
+            # comparison isolates the quantization, not the dispatch count
             engq = LLMEngine(m, max_batch=2, max_len=P + NEW + 8,
                              page_size=16, prefill_chunk=CHUNK,
-                             decode_block=16, kv_cache_dtype="int8")
+                             decode_block="auto", kv_cache_dtype="int8")
             engq.add_request(prompt, max_new_tokens=NEW)
             engq.run_until_done()                           # warm compile
+            engq.add_request(prompt, max_new_tokens=NEW)
+            engq.run_until_done()               # warm the fitted block size
             rid = engq.add_request(prompt, max_new_tokens=NEW)
             t0 = time.perf_counter()
             engq.run_until_done()
@@ -451,6 +455,7 @@ def _serving_bench(paddle, on_tpu):
                 "ttft_ms": round(tq * 1e3, 1),
                 "decode_tokens_per_sec":
                     round((NEW - 1) / max(dtq - tq, 1e-9), 1),
+                "auto_decode_block": engq.auto_decode_block,
                 "page_bytes_vs_full_precision":
                     round(engq.kv_bytes_per_page() / bpp_fp, 3)}
         except Exception as e:  # noqa: BLE001
@@ -608,9 +613,12 @@ def _llama_bench(on_tpu, budget_left_s):
     try:
         env = dict(os.environ, BENCH_LLAMA_GEOMETRY="1")
         env.pop("BENCH_GEOMETRY", None)
+        # clamp to the remaining attempt budget so a slow llama child can
+        # never push the whole attempt past the supervisor's timeout and get
+        # the already-measured flagship numbers killed with it
         proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
                               env=env, capture_output=True, text=True,
-                              timeout=1500)
+                              timeout=min(1500, budget_left_s))
         for line in proc.stderr.splitlines():
             if line.startswith("LLAMA_CHILD "):
                 return json.loads(line[len("LLAMA_CHILD "):])

@@ -1,7 +1,8 @@
 """Export a model to ONNX and verify it with the in-tree numpy runner.
 
 No external onnx package needed: the exporter serializes the captured jaxpr
-directly against the public onnx.proto schema.
+directly against the public onnx.proto schema, and `load_and_run` re-executes
+the exported graph for verification.
 
 Run:  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python examples/export_onnx.py
 """
@@ -11,7 +12,7 @@ import numpy as np
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
-from paddle_tpu.onnx import export, _runner
+from paddle_tpu.onnx import export, load_and_run
 
 
 def main():
@@ -19,13 +20,12 @@ def main():
     model = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 4))
     x = paddle.to_tensor(np.random.RandomState(0).rand(
         3, 16).astype(np.float32))
-    path = export(model, tempfile.mkdtemp() + "/mlp", input_spec=[x])
-    got = _runner.run(open(path, "rb").read(),
-                      {"x0": np.asarray(x._data)})["y0"]
-    ref = np.asarray(model(x)._data)
+    with tempfile.TemporaryDirectory() as d:
+        path = export(model, d + "/mlp", input_spec=[x])
+        got = load_and_run(path, {"x0": x.numpy()})["y0"]
+    ref = model(x).numpy()
     np.testing.assert_allclose(got, ref, atol=1e-4, rtol=1e-4)
-    print(f"exported {path} and verified: max|Δ| = "
-          f"{np.abs(got - ref).max():.2e}")
+    print(f"exported and verified: max|Δ| = {np.abs(got - ref).max():.2e}")
 
 
 if __name__ == "__main__":

@@ -46,8 +46,7 @@ def main(steps=3):
         ids = rng.randint(0, cfg.vocab_size, (4, 33)).astype(np.int32)
         loss = step(paddle.to_tensor(ids[:, :-1]),
                     paddle.to_tensor(ids[:, 1:]))
-        print(f"step {i}: loss {float(np.asarray(loss._data)):.4f} "
-              f"(dp=2 x mp=4 mesh)")
+        print(f"step {i}: loss {float(loss.numpy()):.4f} (dp=2 x mp=4 mesh)")
 
 
 if __name__ == "__main__":

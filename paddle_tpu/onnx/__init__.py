@@ -18,7 +18,17 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["export"]
+__all__ = ["export", "load_and_run"]
+
+
+def load_and_run(path, inputs):
+    """Execute an exported .onnx file with the in-tree numpy evaluator
+    (covers exactly the op subset export() emits).  ``inputs`` maps input
+    names ("x0", "x1", ...) to numpy arrays; returns {output_name: array}.
+    The public verification entry point — no external ONNX runtime needed."""
+    from . import _runner
+    with open(path, "rb") as f:
+        return _runner.run(f.read(), inputs)
 
 
 def export(layer, path, input_spec=None, opset_version=17, **configs):
