@@ -1,0 +1,26 @@
+"""graftlint — trace-safety and registry-parity static analysis.
+
+The reference repo's ~19k LoC of ``tools/`` CI linters, reimagined for the
+jax_graft reproduction: AST passes that catch registry drift, stale
+``__all__`` exports, and JAX trace-unsafe idioms (the silent-recompile /
+tracer-leak bug class) without running any device code.
+
+Usage::
+
+    python -m paddle_tpu.analysis paddle_tpu/ [--format json]
+    graftlint paddle_tpu/ --select trace-safety,registry-parity
+
+Programmatic::
+
+    from paddle_tpu.analysis import run
+    result = run(["paddle_tpu/"])
+    assert not result.findings
+
+Pass modules live in :mod:`paddle_tpu.analysis.passes`; new passes register
+with :func:`register_pass` and are picked up by the CLI automatically.
+"""
+from .framework import (AnalysisPass, Finding, PASSES, Project,  # noqa: F401
+                        RunResult, SourceFile, register_pass, run)
+
+__all__ = ["AnalysisPass", "Finding", "PASSES", "Project", "RunResult",
+           "SourceFile", "register_pass", "run"]

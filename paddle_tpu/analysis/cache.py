@@ -1,0 +1,82 @@
+"""Per-file result cache for graftlint.
+
+Keyed on (absolute path, content sha1, pass name, pass version): re-linting an
+unchanged tree is pure cache replay.  Project-scope passes (registry-parity,
+namespace-parity) are never cached — they depend on cross-file state.
+
+Location: ``$GRAFTLINT_CACHE`` if set, else
+``~/.cache/graftlint/cache.json``.  The file is best-effort: unreadable or
+corrupt caches are ignored, and write failures never fail the lint run.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+from .framework import Finding
+
+_SCHEMA = 1
+
+
+def default_cache_path():
+    env = os.environ.get("GRAFTLINT_CACHE")
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache", "graftlint",
+                        "cache.json")
+
+
+class FileCache:
+    def __init__(self, path: str | None = None):
+        self.path = path or default_cache_path()
+        self._data: dict = {}
+        self._dirty = False
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                loaded = json.load(f)
+            if loaded.get("schema") == _SCHEMA:
+                self._data = loaded.get("files", {})
+        except (OSError, ValueError):
+            self._data = {}
+        self._sha: dict[str, str] = {}
+
+    def _digest(self, src) -> str:
+        sha = self._sha.get(src.path)
+        if sha is None:
+            sha = hashlib.sha1(src.text.encode("utf-8")).hexdigest()
+            self._sha[src.path] = sha
+        return sha
+
+    def get(self, src, pass_obj) -> list[Finding] | None:
+        entry = self._data.get(os.path.abspath(src.path))
+        if not entry or entry.get("sha") != self._digest(src):
+            return None
+        rec = entry.get("passes", {}).get(pass_obj.name)
+        if not rec or rec.get("version") != pass_obj.version:
+            return None
+        return [Finding.from_dict(d) for d in rec.get("findings", [])]
+
+    def put(self, src, pass_obj, findings: list[Finding]):
+        key = os.path.abspath(src.path)
+        entry = self._data.get(key)
+        sha = self._digest(src)
+        if not entry or entry.get("sha") != sha:
+            entry = self._data[key] = {"sha": sha, "passes": {}}
+        entry["passes"][pass_obj.name] = {
+            "version": pass_obj.version,
+            "findings": [f.to_dict() for f in findings]}
+        self._dirty = True
+
+    def save(self):
+        if not self._dirty:
+            return
+        try:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"schema": _SCHEMA, "files": self._data}, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass
+        self._dirty = False

@@ -1,0 +1,92 @@
+"""graftlint CLI — ``python -m paddle_tpu.analysis`` / the ``graftlint``
+console script.
+
+Exit codes: 0 clean, 1 findings, 2 usage/internal error.
+
+JSON report schema (``--format json``)::
+
+    {
+      "graftlint": 1,                 # schema version
+      "passes": ["jit-cache-hygiene", ...],
+      "files": 182,
+      "suppressed": 3,                # pragma-suppressed findings
+      "cache_hits": 170,
+      "findings": [
+        {"pass": "trace-safety", "code": "TS101",
+         "path": "paddle_tpu/x.py", "line": 42,
+         "message": "...", "hint": "..."}
+      ]
+    }
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _parser():
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description="trace-safety and registry-parity static analysis for "
+                    "the paddle_tpu tree")
+    p.add_argument("paths", nargs="*", default=["."],
+                   help="files or directories to lint (default: .)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--select", metavar="PASS[,PASS]",
+                   help="run only these passes")
+    p.add_argument("--disable", metavar="PASS[,PASS]",
+                   help="skip these passes")
+    p.add_argument("--no-cache", action="store_true",
+                   help="ignore and don't write the per-file result cache")
+    p.add_argument("--cache", metavar="FILE",
+                   help="cache file (default: $GRAFTLINT_CACHE or "
+                        "~/.cache/graftlint/cache.json)")
+    p.add_argument("--list-passes", action="store_true")
+    return p
+
+
+def _split(s):
+    return [x.strip() for x in s.split(",") if x.strip()] if s else None
+
+
+def main(argv=None) -> int:
+    args = _parser().parse_args(argv)
+    from . import passes as _passes  # noqa: F401 — register built-ins
+    from .framework import PASSES, run
+    if args.list_passes:
+        for name in sorted(PASSES):
+            p = PASSES[name]
+            scope = "project" if p.project_scope else "file"
+            print(f"{name:20s} v{p.version} [{scope}]  {p.description}")
+        return 0
+    cache = None
+    if not args.no_cache:
+        from .cache import FileCache
+        cache = FileCache(args.cache)
+    try:
+        result = run(args.paths, select=_split(args.select),
+                     disable=_split(args.disable), cache=cache)
+    except KeyError as e:
+        print(f"graftlint: {e.args[0]}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(json.dumps({
+            "graftlint": 1,
+            "passes": result.passes,
+            "files": result.files,
+            "suppressed": result.suppressed,
+            "cache_hits": result.cache_hits,
+            "findings": [f.to_dict() for f in result.findings],
+        }, indent=2))
+    else:
+        for f in result.findings:
+            print(f.render())
+        tail = (f"{len(result.findings)} finding(s) in {result.files} "
+                f"file(s); {result.suppressed} suppressed by pragma")
+        print(("FAILED: " if result.findings else "OK: ") + tail)
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,215 @@
+"""graftlint core — pass registry, source model, pragma suppression.
+
+The reference repo spends ~19k LoC of ``tools/`` on CI linters that keep the
+declarative op table, the generated API surface, and the exported namespaces
+consistent.  This is the same idea for the jax_graft reproduction: a small
+AST-based framework whose passes catch the bug classes unit tests can't —
+registry drift, stale ``__all__`` exports, and JAX trace-unsafe idioms that
+silently recompile or leak tracers.
+
+Pass contract: subclass :class:`AnalysisPass` and register with
+:func:`register_pass`.  A pass implements either
+
+  * ``check_file(source_file) -> list[Finding]``   (per-file; cacheable), or
+  * ``check_project(project) -> list[Finding]``    (whole-tree; never cached)
+
+Suppression pragmas (the clang-tidy ``NOLINT`` analog):
+
+  * ``# graftlint: disable=<pass>[,<pass>...]``       on the flagged line
+  * ``# graftlint: disable-file=<pass>[,<pass>...]``  anywhere in the file
+  * ``all`` is accepted as a pass name in both forms.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic: a pass, a location, a short code, and a fix hint."""
+    pass_name: str
+    code: str
+    path: str
+    line: int
+    message: str
+    hint: str = ""
+
+    def to_dict(self):
+        return {"pass": self.pass_name, "code": self.code, "path": self.path,
+                "line": self.line, "message": self.message, "hint": self.hint}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(d["pass"], d["code"], d["path"], d["line"], d["message"],
+                   d.get("hint", ""))
+
+    def render(self):
+        tail = f"  [fix: {self.hint}]" if self.hint else ""
+        return (f"{self.path}:{self.line}: {self.code} "
+                f"[{self.pass_name}] {self.message}{tail}")
+
+
+_PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*(disable(?:-file)?)\s*=\s*([A-Za-z0-9_,\- ]+)")
+
+
+class SourceFile:
+    """A parsed python file plus its suppression pragmas."""
+
+    def __init__(self, path: str, text: str | None = None):
+        self.path = path
+        if text is None:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                text = f.read()
+        self.text = text
+        self.syntax_error: SyntaxError | None = None
+        try:
+            self.tree = ast.parse(text, filename=path)
+        except SyntaxError as e:            # surfaced as a framework finding
+            self.tree = ast.Module(body=[], type_ignores=[])
+            self.syntax_error = e
+        # line -> set of disabled pass names; "all" disables every pass
+        self.line_pragmas: dict[int, set[str]] = {}
+        self.file_pragmas: set[str] = set()
+        for i, line in enumerate(text.splitlines(), start=1):
+            m = _PRAGMA_RE.search(line)
+            if not m:
+                continue
+            names = {n.strip() for n in m.group(2).split(",") if n.strip()}
+            if m.group(1) == "disable-file":
+                self.file_pragmas |= names
+            else:
+                self.line_pragmas.setdefault(i, set()).update(names)
+
+    def suppressed(self, finding: Finding) -> bool:
+        if {"all", finding.pass_name} & self.file_pragmas:
+            return True
+        on_line = self.line_pragmas.get(finding.line, ())
+        return bool({"all", finding.pass_name} & set(on_line))
+
+
+class Project:
+    """The analyzed file set with module-name resolution."""
+
+    def __init__(self, files: list[SourceFile]):
+        self.files = files
+        self.by_path = {f.path: f for f in files}
+
+    @staticmethod
+    def module_name(path: str) -> str | None:
+        """Dotted module name for ``path`` if it sits inside an importable
+        package chain (``__init__.py`` all the way up to a ``sys.path``
+        root); None for loose scripts and test fixtures."""
+        path = os.path.abspath(path)
+        d, base = os.path.split(path)
+        parts = [] if base == "__init__.py" else [base[:-3]]
+        while os.path.isfile(os.path.join(d, "__init__.py")):
+            d, pkg = os.path.split(d)
+            parts.insert(0, pkg)
+        if not parts:
+            return None
+        root_ok = any(os.path.abspath(p or ".") == d for p in sys.path)
+        return ".".join(parts) if root_ok else None
+
+
+class AnalysisPass:
+    """Base class: set ``name`` (kebab-case, the pragma key), bump ``version``
+    whenever the pass's rules change (invalidates per-file cache entries)."""
+
+    name: str = ""
+    version: int = 1
+    description: str = ""
+    project_scope: bool = False   # True -> check_project, uncacheable
+
+    def check_file(self, src: SourceFile) -> list[Finding]:
+        return []
+
+    def check_project(self, project: Project) -> list[Finding]:
+        return []
+
+
+PASSES: dict[str, AnalysisPass] = {}
+
+
+def register_pass(cls):
+    """Class decorator: instantiate and add to the pass registry."""
+    inst = cls()
+    assert inst.name and inst.name not in PASSES, f"bad pass {cls}"
+    PASSES[inst.name] = inst
+    return cls
+
+
+def iter_python_files(paths):
+    """Expand files/dirs into .py paths, skipping caches and hidden dirs."""
+    skip = {"__pycache__", "build", "dist", ".git"}
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in skip and not d.startswith("."))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+@dataclass
+class RunResult:
+    findings: list[Finding] = field(default_factory=list)
+    files: int = 0
+    passes: list[str] = field(default_factory=list)
+    suppressed: int = 0
+    cache_hits: int = 0
+
+
+def run(paths, select=None, disable=None, cache=None) -> RunResult:
+    """Run the selected passes over ``paths``; returns findings with
+    pragma-suppressed ones dropped (counted in ``suppressed``)."""
+    # load pass modules lazily so `import paddle_tpu` never pays for them
+    from . import passes as _passes  # noqa: F401  (registration side effect)
+    names = sorted(PASSES) if not select else list(select)
+    for n in names:
+        if n not in PASSES:
+            raise KeyError(f"unknown pass {n!r} (have: {', '.join(sorted(PASSES))})")
+    if disable:
+        names = [n for n in names if n not in set(disable)]
+    files = [SourceFile(p) for p in iter_python_files(paths)]
+    project = Project(files)
+    result = RunResult(files=len(files), passes=names)
+    raw: list[Finding] = []
+    for f in files:
+        if f.syntax_error is not None:
+            raw.append(Finding("framework", "GL000", f.path,
+                               f.syntax_error.lineno or 1,
+                               f"syntax error: {f.syntax_error.msg}"))
+    for n in names:
+        p = PASSES[n]
+        if p.project_scope:
+            raw.extend(p.check_project(project))
+            continue
+        for f in files:
+            cached = cache.get(f, p) if cache is not None else None
+            if cached is not None:
+                result.cache_hits += 1
+                raw.extend(cached)
+                continue
+            found = p.check_file(f)
+            if cache is not None:
+                cache.put(f, p, found)
+            raw.extend(found)
+    for fd in raw:
+        src = project.by_path.get(fd.path)
+        if src is not None and src.suppressed(fd):
+            result.suppressed += 1
+        else:
+            result.findings.append(fd)
+    result.findings.sort(key=lambda x: (x.path, x.line, x.code))
+    if cache is not None:
+        cache.save()
+    return result

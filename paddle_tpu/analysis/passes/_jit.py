@@ -1,0 +1,167 @@
+"""Shared helpers for the jit-aware passes: finding functions that enter a
+trace (``jit.to_static`` / ``jax.jit`` / ``scan_steps``), their static
+arguments, and the per-file function table used for reachability."""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+# dotted-name suffixes that mark a trace entry point
+_JIT_CALLS = ("jax.jit", "jit.to_static", "paddle.jit.to_static",
+              "paddle_tpu.jit.to_static")
+_JIT_BARE = ("to_static", "scan_steps", "pjit")
+
+
+def dotted(node) -> str | None:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def is_jit_name(node) -> bool:
+    d = dotted(node)
+    if d is None:
+        return False
+    last = d.rsplit(".", 1)[-1]
+    return d in _JIT_CALLS or d.endswith(".scan_steps") or last in _JIT_BARE
+
+
+def _literal_strs(node):
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    return []
+
+
+def _literal_ints(node):
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    return []
+
+
+@dataclass
+class JitSite:
+    """One jit entry: the wrapped function name (if resolvable) and the
+    declared static arguments."""
+    func_name: str | None
+    node: ast.AST
+    static_names: set = field(default_factory=set)
+    static_nums: set = field(default_factory=set)
+
+
+def _statics_from_call(call: ast.Call):
+    names, nums = set(), set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names |= set(_literal_strs(kw.value))
+        elif kw.arg == "static_argnums":
+            nums |= set(_literal_ints(kw.value))
+    return names, nums
+
+
+def jit_decorator_info(deco):
+    """(static_names, static_nums) if ``deco`` marks a jit entry, else None.
+
+    Recognizes ``@jax.jit``, ``@to_static``, ``@scan_steps``, the called
+    forms with kwargs, and ``@functools.partial(jax.jit, static_*=...)``."""
+    if is_jit_name(deco):
+        return set(), set()
+    if isinstance(deco, ast.Call):
+        d = dotted(deco.func)
+        if d and d.rsplit(".", 1)[-1] == "partial" and deco.args \
+                and is_jit_name(deco.args[0]):
+            return _statics_from_call(deco)
+        if is_jit_name(deco.func):
+            return _statics_from_call(deco)
+    return None
+
+
+class FunctionTable(ast.NodeVisitor):
+    """All function/method defs in a module, keyed by bare name (last def
+    wins) — a deliberate approximation that is robust for the intra-file
+    reachability walk these passes need."""
+
+    def __init__(self):
+        self.defs: dict[str, ast.AST] = {}
+        self.parent_class: dict[int, str | None] = {}
+        self._class: list[str] = []
+
+    def visit_ClassDef(self, node):
+        self._class.append(node.name)
+        self.generic_visit(node)
+        self._class.pop()
+
+    def _def(self, node):
+        self.defs[node.name] = node
+        self.parent_class[id(node)] = self._class[-1] if self._class else None
+        self.generic_visit(node)
+
+    visit_FunctionDef = _def
+    visit_AsyncFunctionDef = _def
+
+
+def collect_jit_sites(tree, table: FunctionTable) -> list[JitSite]:
+    """Every jit entry in the module: decorated defs plus call-site wraps
+    like ``jax.jit(fn, ...)`` / ``to_static(fn)`` where ``fn`` is a local
+    function name."""
+    sites = []
+    for fn in table.defs.values():
+        for deco in fn.decorator_list:
+            info = jit_decorator_info(deco)
+            if info is not None:
+                sites.append(JitSite(fn.name, fn, *info))
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and is_jit_name(node.func)
+                and node.args):
+            continue
+        target = node.args[0]
+        fname = None
+        if isinstance(target, ast.Name) and target.id in table.defs:
+            fname = target.id
+        elif (isinstance(target, ast.Attribute)
+              and isinstance(target.value, ast.Name)
+              and target.value.id in ("self", "cls")
+              and target.attr in table.defs):
+            fname = target.attr          # to_static(self._train_step)
+        if fname is not None:
+            names, nums = _statics_from_call(node)
+            sites.append(JitSite(fname, node, names, nums))
+    return sites
+
+
+def param_names(fn) -> list[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    names += [p.arg for p in a.kwonlyargs]
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def traced_params(fn, site: JitSite) -> set:
+    """Params of a jit-entry function that carry traced values: everything
+    except self/cls and the declared static args."""
+    a = fn.args
+    pos = [p.arg for p in a.posonlyargs + a.args]
+    out = set(pos) | {p.arg for p in a.kwonlyargs}
+    if a.vararg:
+        out.add(a.vararg.arg)
+    for i in sorted(site.static_nums):
+        if 0 <= i < len(pos):
+            out.discard(pos[i])
+    out -= site.static_names
+    out -= {"self", "cls"}
+    return out

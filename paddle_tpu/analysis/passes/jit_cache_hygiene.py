@@ -1,0 +1,129 @@
+"""jit-cache-hygiene — catch idioms that silently recompile per call.
+
+``jax.jit``'s compile cache is keyed on static-arg *values* and abstract
+shapes.  A tensor-valued default argument is a fresh object every trace; an
+unhashable (list/dict/set) value for a declared static arg either raises or,
+when wrapped, recompiles on every call.  Both degrade "compiled once" into
+"compiled always" with no functional symptom — only latency.
+
+  * JH001 mutable (list/dict/set) default argument on a jit entry
+  * JH002 tensor/array-valued default argument on a jit entry
+  * JH003 container literal passed for a declared static arg at a call site
+  * JH004 declared static arg whose default is an unhashable container
+"""
+from __future__ import annotations
+
+import ast
+
+from ..framework import AnalysisPass, Finding, register_pass
+from ._jit import FunctionTable, collect_jit_sites, dotted
+
+_MUTABLE_NODES = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                  ast.SetComp)
+# call prefixes whose result is array-valued: a fresh object per trace
+_ARRAY_FACTORIES = ("np.", "numpy.", "jnp.", "jax.numpy.")
+_ARRAY_CALLS = {"to_tensor", "zeros", "ones", "array", "asarray", "arange",
+                "full", "empty", "tensor"}
+
+
+def _is_array_default(node) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted(node.func)
+    if d is None:
+        return False
+    last = d.rsplit(".", 1)[-1]
+    return d.startswith(_ARRAY_FACTORIES) or last in _ARRAY_CALLS
+
+
+def _defaults(fn):
+    """[(param_name, default_node)] for every defaulted parameter."""
+    a = fn.args
+    pos = a.posonlyargs + a.args
+    out = list(zip([p.arg for p in pos[len(pos) - len(a.defaults):]],
+                   a.defaults))
+    out += [(p.arg, d) for p, d in zip(a.kwonlyargs, a.kw_defaults)
+            if d is not None]
+    return out
+
+
+@register_pass
+class JitCacheHygienePass(AnalysisPass):
+    name = "jit-cache-hygiene"
+    version = 1
+    description = ("unhashable/tensor-valued defaults and non-static "
+                   "containers as static args on jit entries")
+
+    def check_file(self, src) -> list[Finding]:
+        table = FunctionTable()
+        table.visit(src.tree)
+        sites = collect_jit_sites(src.tree, table)
+        if not sites:
+            return []
+        findings: list[Finding] = []
+        seen = set()
+
+        def emit(line, code, msg, hint):
+            if (line, code) in seen:
+                return
+            seen.add((line, code))
+            findings.append(Finding(self.name, code, src.path, line, msg,
+                                    hint))
+
+        statics_of: dict[str, set] = {}
+        for site in sites:
+            fn = table.defs.get(site.func_name or "")
+            if fn is None:
+                continue
+            a = fn.args
+            pos = [p.arg for p in a.posonlyargs + a.args]
+            statics = set(site.static_names)
+            for i in site.static_nums:
+                if 0 <= i < len(pos):
+                    statics.add(pos[i])
+            statics_of.setdefault(fn.name, set()).update(statics)
+            for pname, default in _defaults(fn):
+                if isinstance(default, _MUTABLE_NODES):
+                    code = "JH004" if pname in statics else "JH001"
+                    what = ("static arg with unhashable container default"
+                            if pname in statics else
+                            "mutable container default")
+                    emit(default.lineno, code,
+                         f"jit entry '{fn.name}' param '{pname}': {what} — "
+                         "hashing fails or every call recompiles",
+                         "use None + an in-function default, or a tuple")
+                elif _is_array_default(default):
+                    emit(default.lineno, "JH002",
+                         f"jit entry '{fn.name}' param '{pname}' defaults "
+                         "to a fresh array per call — each trace sees a new "
+                         "object and recompiles",
+                         "hoist the array to a module constant or pass it "
+                         "explicitly")
+        # call sites passing container literals for declared static args
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = node.func.id if isinstance(node.func, ast.Name) else None
+            statics = statics_of.get(fname or "")
+            if not statics:
+                continue
+            fn = table.defs[fname]
+            a = fn.args
+            pos = [p.arg for p in a.posonlyargs + a.args]
+            for i, arg in enumerate(node.args):
+                if i < len(pos) and pos[i] in statics \
+                        and isinstance(arg, _MUTABLE_NODES):
+                    emit(arg.lineno, "JH003",
+                         f"call passes a {type(arg).__name__.lower()} for "
+                         f"static arg '{pos[i]}' of '{fname}' — unhashable "
+                         "static values recompile (or fail) per call",
+                         "pass a tuple/scalar, or drop it from static args")
+            for kw in node.keywords:
+                if kw.arg in statics and isinstance(kw.value, _MUTABLE_NODES):
+                    emit(kw.value.lineno, "JH003",
+                         f"call passes a {type(kw.value).__name__.lower()} "
+                         f"for static arg '{kw.arg}' of '{fname}' — "
+                         "unhashable static values recompile (or fail) per "
+                         "call",
+                         "pass a tuple/scalar, or drop it from static args")
+        return findings

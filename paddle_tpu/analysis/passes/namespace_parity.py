@@ -1,0 +1,127 @@
+"""namespace-parity — the static analog of ``tests/test_namespace_parity.py``.
+
+Every name a module declares in ``__all__`` must actually exist on the
+module; a stale export breaks ``from paddle_tpu.x import *`` users and the
+reference-parity sweep, and nothing else catches it until an import happens
+to touch the missing attribute.
+
+For files inside an importable package, ground truth is the imported module's
+attribute set.  For loose files (fixtures, scripts) a static approximation is
+used: top-level defs, classes, assignments and import aliases — unless a
+``from x import *`` makes the static view unsound, in which case the file is
+skipped rather than guessed at.
+
+  * NS001 name declared in ``__all__`` but absent from the module
+  * NS002 duplicate name inside ``__all__``
+"""
+from __future__ import annotations
+
+import ast
+import importlib
+
+from ..framework import AnalysisPass, Finding, Project, register_pass
+
+
+def _all_decls(tree):
+    """[(line, [names...])] for ``__all__ = [...]`` and ``__all__ += [...]``;
+    non-literal constructions return names=None (unknowable)."""
+    decls = []
+    for node in tree.body:
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            target = node.targets[0].id
+        elif isinstance(node, ast.AugAssign) \
+                and isinstance(node.target, ast.Name):
+            target = node.target.id
+        if target != "__all__":
+            continue
+        value = node.value
+        if isinstance(value, (ast.List, ast.Tuple, ast.Set)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in value.elts):
+            decls.append((node.lineno, [e.value for e in value.elts]))
+        else:
+            decls.append((node.lineno, None))
+    return decls
+
+
+def _static_names(tree):
+    """(names defined at module top level, sound: bool).  A star import makes
+    the static view unsound."""
+    names, sound = set(), True
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        names.add(n.id)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            if isinstance(node.target, ast.Name):
+                names.add(node.target.id)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                names.add((a.asname or a.name).split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == "*":
+                    sound = False
+                else:
+                    names.add(a.asname or a.name)
+        elif isinstance(node, (ast.If, ast.Try)):
+            # common guarded-import shape: collect from all branches
+            sub = ast.Module(body=list(ast.iter_child_nodes(node)),
+                             type_ignores=[])
+            inner, inner_sound = _static_names(sub)
+            names |= inner
+            sound &= inner_sound
+    return names, sound
+
+
+@register_pass
+class NamespaceParityPass(AnalysisPass):
+    name = "namespace-parity"
+    version = 1
+    description = "__all__ entries must resolve to real module attributes"
+    project_scope = True    # imports modules for ground truth
+
+    def check_project(self, project: Project) -> list[Finding]:
+        findings = []
+        for src in project.files:
+            decls = _all_decls(src.tree)
+            if not any(names for _, names in decls):
+                continue
+            have, sound = self._module_names(src)
+            for line, names in decls:
+                if names is None:
+                    continue
+                seen = set()
+                for n in names:
+                    if n in seen:
+                        findings.append(Finding(
+                            self.name, "NS002", src.path, line,
+                            f"'{n}' listed twice in __all__",
+                            hint="drop the duplicate"))
+                    seen.add(n)
+                    if sound and have is not None and n not in have:
+                        findings.append(Finding(
+                            self.name, "NS001", src.path, line,
+                            f"__all__ exports '{n}' but the module has no "
+                            "such attribute",
+                            hint="define/import the name or remove the "
+                                 "stale export"))
+        return findings
+
+    @staticmethod
+    def _module_names(src):
+        mod_name = Project.module_name(src.path)
+        if mod_name is not None:
+            try:
+                mod = importlib.import_module(mod_name)
+                return set(dir(mod)), True
+            except Exception:
+                pass            # fall back to the static view
+        return _static_names(src.tree)

@@ -1,0 +1,228 @@
+"""registry-parity — keep the declarative op table honest.
+
+The whole op surface is driven by one table (``ops/registry.py``, the
+ops.yaml analog), so drift there is invisible until a runtime test happens to
+hit the broken entry.  This pass cross-checks every entry:
+
+  * RP001 duplicate registration (later entry silently shadows the earlier)
+  * RP002 unknown category (not in ``registry.CATEGORIES``)
+  * RP003 ``kind="golden"`` without a numpy reference or property check
+  * RP004 unknown ``kind``
+  * RP005 alias/inplace target does not resolve
+  * RP006 resolver missing (the public op the entry points at doesn't exist)
+  * RP007 resolver arity incompatible with the sample builder + kwargs
+  * RP008 sample builder raises
+
+Static checks run on any module that registers ops through the canonical
+helpers (``u``/``b``/``g``/``smoke``/``alias``/``inplace``); the runtime
+checks additionally import the module and inspect the live ``REGISTRY`` when
+the file belongs to an importable package.
+"""
+from __future__ import annotations
+
+import ast
+import importlib
+import inspect
+
+from ..framework import AnalysisPass, Finding, Project, register_pass
+
+_HELPERS = {"u", "b", "g", "smoke", "alias", "inplace"}
+# helper -> positional index / keyword of its category argument
+_CAT_ARG = {"u": (None, "cat"), "b": (None, "cat"), "g": (3, "cat"),
+            "smoke": (2, "cat"), "alias": (2, "cat"), "inplace": (2, "cat")}
+_FALLBACK_CATEGORIES = {
+    "math", "reduce", "linalg", "logic", "manip", "search", "stat",
+    "creation", "random", "fft", "signal", "inplace"}
+_KINDS = {"golden", "smoke", "alias", "inplace"}
+
+
+def _const(node):
+    return node.value if isinstance(node, ast.Constant) else None
+
+
+def _kwarg(call, name):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+class _RegCall:
+    def __init__(self, helper, call):
+        self.helper = helper
+        self.call = call
+        self.line = call.lineno
+        self.name = _const(call.args[0]) if call.args else None
+
+    def category(self):
+        pos, kw = _CAT_ARG[self.helper]
+        node = _kwarg(self.call, kw)
+        if node is None and pos is not None and len(self.call.args) > pos:
+            node = self.call.args[pos]
+        return _const(node) if node is not None else None
+
+
+@register_pass
+class RegistryParityPass(AnalysisPass):
+    name = "registry-parity"
+    version = 1
+    description = ("op-registry consistency: resolver existence/arity, "
+                   "golden references, duplicate names, categories")
+    project_scope = True    # runtime half imports the live registry
+
+    def check_project(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for src in project.files:
+            regs = self._collect(src)
+            if not regs:
+                continue
+            categories = _FALLBACK_CATEGORIES
+            mod = Project.module_name(src.path)
+            live = None
+            if mod is not None:
+                try:
+                    live = importlib.import_module(mod)
+                    categories = getattr(live, "CATEGORIES", categories)
+                except Exception as e:   # import failure IS a finding
+                    findings.append(Finding(
+                        self.name, "RP006", src.path, 1,
+                        f"registry module {mod!r} failed to import: "
+                        f"{type(e).__name__}: {e}"))
+            findings.extend(self._static(src, regs, categories))
+            if live is not None and hasattr(live, "REGISTRY"):
+                lines = {r.name: r.line for r in regs if r.name}
+                findings.extend(self._runtime(src, live, lines, categories))
+        return findings
+
+    # ---- static half -----------------------------------------------------
+    def _collect(self, src):
+        # only treat a file as a registry if it touches the canonical table
+        mentions = {n.id for n in ast.walk(src.tree)
+                    if isinstance(n, ast.Name)}
+        if not {"REGISTRY", "OpSpec"} & mentions:
+            return []
+        regs = []
+        for node in ast.walk(src.tree):
+            if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                    and node.func.id in _HELPERS and node.args
+                    and isinstance(_const(node.args[0]), str)):
+                regs.append(_RegCall(node.func.id, node))
+        return regs
+
+    def _static(self, src, regs, categories):
+        findings = []
+        seen: dict[str, int] = {}
+        for r in regs:
+            if r.name in seen:
+                findings.append(Finding(
+                    self.name, "RP001", src.path, r.line,
+                    f"duplicate registration of op '{r.name}' (first at "
+                    f"line {seen[r.name]}) — the earlier entry is silently "
+                    "shadowed",
+                    hint="rename one entry or delete the stale duplicate"))
+            else:
+                seen[r.name] = r.line
+            cat = r.category()
+            if cat is not None and cat not in categories:
+                findings.append(Finding(
+                    self.name, "RP002", src.path, r.line,
+                    f"op '{r.name}' registered under unknown category "
+                    f"'{cat}'",
+                    hint=f"use one of: {', '.join(sorted(categories))}"))
+            if r.helper in ("u", "b", "g"):
+                ref = r.call.args[1] if len(r.call.args) > 1 else None
+                kind = _const(_kwarg(r.call, "kind") or ast.Constant("golden"))
+                if (isinstance(ref, ast.Constant) and ref.value is None
+                        and _kwarg(r.call, "check") is None
+                        and kind == "golden"):
+                    findings.append(Finding(
+                        self.name, "RP003", src.path, r.line,
+                        f"golden op '{r.name}' has neither np_ref nor a "
+                        "property check — nothing verifies its output",
+                        hint="add np_ref/check, or register it as "
+                             "kind=\"smoke\" with a reason"))
+        return findings
+
+    # ---- runtime half ----------------------------------------------------
+    def _runtime(self, src, live, lines, categories):
+        findings = []
+
+        def emit(name, code, msg, hint=""):
+            findings.append(Finding(self.name, code, src.path,
+                                    lines.get(name, 1), msg, hint))
+
+        for rec in getattr(live, "DUPLICATE_REGISTRATIONS", ()):
+            emit(rec, "RP001",
+                 f"duplicate registration of op '{rec}' observed at import "
+                 "time — the earlier entry is silently shadowed",
+                 "rename one entry or delete the stale duplicate")
+        for name, spec in live.REGISTRY.items():
+            if spec.kind not in _KINDS:
+                emit(name, "RP004", f"op '{name}' has unknown kind "
+                     f"'{spec.kind}'")
+                continue
+            if spec.category not in categories:
+                emit(name, "RP002", f"op '{name}' registered under unknown "
+                     f"category '{spec.category}'",
+                     f"use one of: {', '.join(sorted(categories))}")
+            if spec.kind in ("alias", "inplace"):
+                base = live.REGISTRY.get(spec.alias_of)
+                target = spec.alias_of if spec.kind == "alias" else name
+                try:
+                    import paddle_tpu.ops as O
+                    ok = callable(getattr(O, target, None))
+                    if spec.kind == "inplace" and not ok:
+                        from paddle_tpu.core.tensor import Tensor
+                        ok = callable(getattr(Tensor, name, None))
+                except Exception:
+                    ok = False
+                if base is None and not ok:
+                    emit(name, "RP005",
+                         f"{spec.kind} op '{name}' points at "
+                         f"'{spec.alias_of}', which neither the registry nor "
+                         "the op surface resolves",
+                         "fix alias_of or register the base op")
+                continue
+            if spec.kind == "golden" and spec.np_ref is None \
+                    and spec.check is None:
+                emit(name, "RP003",
+                     f"golden op '{name}' has neither np_ref nor a property "
+                     "check — nothing verifies its output",
+                     "add np_ref/check, or register it as kind=\"smoke\" "
+                     "with a reason")
+            try:
+                resolver = spec.resolve()
+            except Exception as e:
+                emit(name, "RP006",
+                     f"op '{name}' resolver is missing "
+                     f"({type(e).__name__}: {e})",
+                     "export the op or point the entry's `op` at the "
+                     "right target")
+                continue
+            try:
+                sample = spec.sample() if spec.sample else []
+            except Exception as e:
+                emit(name, "RP008",
+                     f"op '{name}' sample builder raised "
+                     f"{type(e).__name__}: {e}")
+                continue
+            self._check_arity(emit, name, resolver, len(sample),
+                              set(spec.kwargs))
+        return findings
+
+    @staticmethod
+    def _check_arity(emit, name, resolver, n_inputs, kw_names):
+        try:
+            sig = inspect.signature(resolver)
+        except (TypeError, ValueError):
+            return                       # builtins without introspection
+        try:
+            sig.bind(*([None] * n_inputs), **dict.fromkeys(kw_names))
+        except TypeError as e:
+            emit(name, "RP007",
+                 f"op '{name}' resolver signature {sig} cannot take its "
+                 f"sample inputs ({n_inputs} positional"
+                 + (f" + kwargs {sorted(kw_names)}" if kw_names else "")
+                 + f"): {e}",
+                 "align the sample builder/kwargs with the resolver "
+                 "signature")

@@ -1,0 +1,338 @@
+"""trace-safety — flag JAX trace-unsafe idioms in code reachable from
+``jit.to_static`` / ``jax.jit`` / ``scan_steps`` entry points.
+
+The bug class: python that runs *at trace time* but looks like it runs per
+call.  A data-dependent ``if`` on a traced tensor either raises
+``TracerBoolConversionError`` or (via a value guard) silently recompiles per
+branch; ``float()``/``.numpy()`` escapes force a device sync or bake a stale
+constant into the trace; ``np.*`` on a tracer concretizes it; writes to
+globals fire once at trace time and never again.  None of these are visible
+to unit tests that happen to take one branch — exactly the class the
+north-star "fast as the hardware allows" goal cannot afford in production.
+
+Mechanics: per file, build a function table, seed a taint set from each jit
+entry's non-static parameters, propagate through local assignments and
+intra-file calls (including ``self.method`` and bare-name references such as
+``jax.lax.scan(body, ...)``) to a fixpoint, then sweep reachable functions
+for the four violation shapes.  Static contexts never taint or trigger:
+``x is None``, ``isinstance``/``len``/``hasattr``, and shape/dtype metadata
+attributes — those are host-known under jit.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..framework import AnalysisPass, Finding, register_pass
+from ._jit import (FunctionTable, collect_jit_sites, dotted, param_names,
+                   traced_params)
+
+# attribute reads that are static under jit (shape metadata, framework flags)
+_META_ATTRS = {"shape", "ndim", "dtype", "size", "device", "name",
+               "stop_gradient", "persistable", "itemsize"}
+# builtins whose result is host-static even on traced args
+_STATIC_FUNCS = {"len", "isinstance", "hasattr", "getattr", "type", "id",
+                 "repr", "str", "format", "print", "issubclass"}
+# host-escape method calls on a traced value
+_ESCAPE_METHODS = {"numpy", "item", "tolist"}
+_ESCAPE_BUILTINS = {"bool", "int", "float"}
+
+_HINTS = {
+    "TS101": "use jnp.where/lax.cond, or hoist the branch out of the traced "
+             "function (declare the arg static if it is host metadata)",
+    "TS102": "keep the value on-device (array compare / jnp op) or move the "
+             "read outside the jitted region",
+    "TS103": "host materialization breaks the trace; return the tensor and "
+             "read it after the step",
+    "TS104": "use the jax.numpy equivalent so the op stays in the trace",
+    "TS105": "trace-time side effect: it will NOT re-run per call once "
+             "compiled; thread the value through returns or framework state",
+}
+
+
+def _is_static_compare(node) -> bool:
+    return (isinstance(node, ast.Compare)
+            and all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops))
+
+
+def _scan(node, tainted, uses, *, taint_mode):
+    """Collect tainted-name usages in ``node``.
+
+    taint_mode=False (branch/arg checks): attribute reads on a tainted name
+    are allowed (host attributes), method calls are not.
+    taint_mode=True (assignment RHS): attribute access propagates taint.
+    """
+    if node is None or _is_static_compare(node):
+        return
+    if isinstance(node, ast.Name):
+        if node.id in tainted:
+            uses.append(node)
+        return
+    if isinstance(node, ast.Attribute):
+        if node.attr in _META_ATTRS:
+            return
+        if taint_mode:
+            _scan(node.value, tainted, uses, taint_mode=taint_mode)
+        return
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in _STATIC_FUNCS:
+            return
+        if isinstance(f, ast.Attribute):
+            if f.attr in _META_ATTRS:
+                return
+            # method call on a traced receiver is a traced use
+            _scan(f.value, tainted, uses, taint_mode=True)
+        else:
+            _scan(f, tainted, uses, taint_mode=taint_mode)
+        for a in node.args:
+            _scan(a, tainted, uses, taint_mode=taint_mode)
+        for kw in node.keywords:
+            _scan(kw.value, tainted, uses, taint_mode=taint_mode)
+        return
+    for child in ast.iter_child_nodes(node):
+        _scan(child, tainted, uses, taint_mode=taint_mode)
+
+
+def _is_tainted(expr, tainted) -> bool:
+    uses: list = []
+    _scan(expr, tainted, uses, taint_mode=True)
+    return bool(uses)
+
+
+def _target_names(target):
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out = []
+        for e in target.elts:
+            out.extend(_target_names(e))
+        return out
+    if isinstance(target, ast.Starred):
+        return _target_names(target.value)
+    return []
+
+
+class _FuncModel:
+    """One propagation step over a function body: given tainted params,
+    compute tainted locals and the tainted-arg call edges."""
+
+    def __init__(self, fn, table: FunctionTable):
+        self.fn = fn
+        self.table = table
+
+    def propagate(self, tainted: set) -> tuple[set, list]:
+        """Returns (final tainted names, [(callee_name, tainted_param_names
+        or None-for-all)])."""
+        tainted = set(tainted)
+        edges = []
+        body = self.fn.body
+        for _ in range(2):                     # handle use-before-def loops
+            before = len(tainted)
+            for stmt in body:
+                self._stmt(stmt, tainted)
+            if len(tainted) == before:
+                break
+        for node in ast.walk(self.fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not self.fn:
+                continue                       # nested defs analyzed on ref
+            if isinstance(node, ast.Call):
+                edge = self._call_edge(node, tainted)
+                if edge:
+                    edges.append(edge)
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if node.id in self.table.defs and node.id not in tainted:
+                    edges.append((node.id, None))   # bare ref: e.g. scan body
+        return tainted, edges
+
+    def _stmt(self, stmt, tainted):
+        if isinstance(stmt, ast.Assign):
+            if _is_tainted(stmt.value, tainted):
+                for t in stmt.targets:
+                    tainted.update(_target_names(t))
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            if stmt.value is not None and _is_tainted(stmt.value, tainted):
+                tainted.update(_target_names(stmt.target))
+        elif isinstance(stmt, ast.For):
+            self._pair(stmt.target, stmt.iter, tainted, unwrap=True)
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s, tainted)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s, tainted)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if item.optional_vars is not None \
+                        and _is_tainted(item.context_expr, tainted):
+                    tainted.update(_target_names(item.optional_vars))
+            for s in stmt.body:
+                self._stmt(s, tainted)
+        elif isinstance(stmt, ast.Try):
+            for s in stmt.body + stmt.orelse + stmt.finalbody:
+                self._stmt(s, tainted)
+            for h in stmt.handlers:
+                for s in h.body:
+                    self._stmt(s, tainted)
+
+    def _pair(self, target, expr, tainted, unwrap=False):
+        """Precise taint for ``for a, b in zip(X, Y)`` / ``enumerate(X)``
+        loop targets: each name is tainted only by its own source, so a
+        static mask zipped against traced values stays untainted."""
+        if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name):
+            args = expr.args
+            if expr.func.id == "enumerate" and args and unwrap \
+                    and isinstance(target, (ast.Tuple, ast.List)) \
+                    and len(target.elts) == 2:
+                self._pair(target.elts[1], args[0], tainted)
+                return
+            if expr.func.id == "zip" \
+                    and isinstance(target, (ast.Tuple, ast.List)) \
+                    and len(target.elts) == len(args) \
+                    and not any(isinstance(a, ast.Starred) for a in args):
+                for t, a in zip(target.elts, args):
+                    self._pair(t, a, tainted)
+                return
+        if _is_tainted(expr, tainted):
+            tainted.update(_target_names(target))
+
+    def _call_edge(self, call, tainted):
+        f = call.func
+        callee = None
+        offset = 0
+        if isinstance(f, ast.Name) and f.id in self.table.defs:
+            callee = f.id
+        elif (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+              and f.value.id in ("self", "cls") and f.attr in self.table.defs
+              and self.table.parent_class.get(
+                  id(self.table.defs[f.attr])) is not None):
+            callee = f.attr
+            offset = 1                          # skip the self param
+        if callee is None:
+            return None
+        params = param_names(self.table.defs[callee])[offset:]
+        hit = set()
+        for i, a in enumerate(call.args):
+            if isinstance(a, ast.Starred):
+                if _is_tainted(a.value, tainted):
+                    hit.update(params[i:])
+                continue
+            if i < len(params) and _is_tainted(a, tainted):
+                hit.add(params[i])
+        for kw in call.keywords:
+            if kw.arg is not None and _is_tainted(kw.value, tainted):
+                hit.add(kw.arg)
+        return (callee, hit) if hit else None
+
+
+@register_pass
+class TraceSafetyPass(AnalysisPass):
+    name = "trace-safety"
+    version = 1
+    description = ("data-dependent branching, host escapes, np.* calls and "
+                   "global mutation inside jit-traced code")
+
+    def check_file(self, src) -> list[Finding]:
+        table = FunctionTable()
+        table.visit(src.tree)
+        sites = collect_jit_sites(src.tree, table)
+        if not sites:
+            return []
+        # ---- taint fixpoint across the intra-file call graph -------------
+        taints: dict[str, set] = {}
+        work = []
+        for s in sites:
+            fn = table.defs.get(s.func_name or "")
+            if fn is None:
+                continue
+            t = traced_params(fn, s)
+            if taints.get(fn.name, set()) != t:
+                taints[fn.name] = taints.get(fn.name, set()) | t
+                work.append(fn.name)
+        models = {n: _FuncModel(f, table) for n, f in table.defs.items()}
+        reachable = set(taints)
+        for _ in range(200):                   # fixpoint with a hard bound
+            if not work:
+                break
+            name = work.pop()
+            _, edges = models[name].propagate(taints.get(name, set()))
+            for callee, hit in edges:
+                if hit is None:                # bare reference: all traced
+                    hit = set(param_names(table.defs[callee])) - {"self", "cls"}
+                cur = taints.get(callee, set())
+                if callee not in reachable or not hit <= cur:
+                    taints[callee] = cur | hit
+                    reachable.add(callee)
+                    work.append(callee)
+        # ---- findings sweep over reachable functions ---------------------
+        findings: list[Finding] = []
+        seen = set()
+
+        def emit(node, code, msg):
+            key = (node.lineno, code)
+            if key not in seen:
+                seen.add(key)
+                findings.append(Finding(self.name, code, src.path,
+                                        node.lineno, msg, _HINTS[code]))
+
+        for name in sorted(reachable):
+            fn = table.defs[name]
+            tainted, _ = models[name].propagate(taints.get(name, set()))
+            self._sweep(fn, tainted, emit)
+        return findings
+
+    def _sweep(self, fn, tainted, emit):
+        globs = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                globs.update(node.names)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                continue                       # nested defs swept separately
+            if isinstance(node, (ast.If, ast.While)):
+                uses: list = []
+                _scan(node.test, tainted, uses, taint_mode=False)
+                if uses:
+                    kind = "while" if isinstance(node, ast.While) else "if"
+                    emit(node, "TS101",
+                         f"data-dependent `{kind}` on traced value "
+                         f"'{uses[0].id}' — concretizes the tracer or "
+                         "recompiles per branch value")
+            elif isinstance(node, ast.Call):
+                self._sweep_call(node, tainted, emit)
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    for n in _target_names(t):
+                        if n in globs:
+                            emit(node, "TS105",
+                                 f"write to global/nonlocal '{n}' inside "
+                                 "traced code")
+
+    def _sweep_call(self, node, tainted, emit):
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in _ESCAPE_BUILTINS:
+            if any(_tainted_use(a, tainted) for a in node.args):
+                emit(node, "TS102",
+                     f"`{f.id}()` on a traced value — forces concretization")
+            return
+        if isinstance(f, ast.Attribute) and f.attr in _ESCAPE_METHODS:
+            if _tainted_use(f.value, tainted):
+                emit(node, "TS103",
+                     f"`.{f.attr}()` on a traced value — host round trip "
+                     "inside the trace")
+            return
+        d = dotted(f)
+        if d and (d.startswith("np.") or d.startswith("numpy.")):
+            if any(_tainted_use(a, tainted) for a in node.args) or any(
+                    _tainted_use(kw.value, tainted) for kw in node.keywords):
+                emit(node, "TS104",
+                     f"`{d}()` called on a traced value — numpy "
+                     "concretizes tracers")
+
+
+def _tainted_use(expr, tainted) -> bool:
+    uses: list = []
+    _scan(expr, tainted, uses, taint_mode=False)
+    return bool(uses)

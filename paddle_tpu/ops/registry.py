@@ -24,24 +24,21 @@ def _rng(seed=0):
     return np.random.RandomState(seed)
 
 
-def U(*shape, lo=-2.0, hi=2.0, dtype=np.float32, seed=0):
-    return _rng(seed).uniform(lo, hi, shape).astype(dtype)
+def uniform_builder(default_lo, default_hi):
+    """The one seeded sample-builder factory: every float range builder
+    (U/POS/UNIT/GT1/PROB) is an instance, so they all share one canonical
+    signature ``(*shape, lo=..., hi=..., dtype=np.float32, seed=0)`` for the
+    registry-parity pass to check."""
+    def build(*shape, lo=default_lo, hi=default_hi, dtype=np.float32, seed=0):
+        return _rng(seed).uniform(lo, hi, shape).astype(dtype)
+    return build
 
 
-def POS(*shape, seed=0):
-    return U(*shape, lo=0.1, hi=3.0, seed=seed)
-
-
-def UNIT(*shape, seed=0):
-    return U(*shape, lo=-0.9, hi=0.9, seed=seed)
-
-
-def GT1(*shape, seed=0):
-    return U(*shape, lo=1.1, hi=3.0, seed=seed)
-
-
-def PROB(*shape, seed=0):
-    return U(*shape, lo=0.05, hi=0.95, seed=seed)
+U = uniform_builder(-2.0, 2.0)       # generic signed values
+POS = uniform_builder(0.1, 3.0)      # strictly positive (log/sqrt domains)
+UNIT = uniform_builder(-0.9, 0.9)    # open unit interval (atanh/asin domains)
+GT1 = uniform_builder(1.1, 3.0)      # > 1 (acosh domain)
+PROB = uniform_builder(0.05, 0.95)   # probabilities bounded away from 0/1
 
 
 def I(*shape, lo=0, hi=5, seed=0):
@@ -88,8 +85,20 @@ class OpSpec:
 
 REGISTRY: dict[str, OpSpec] = {}
 
+# the category vocabulary; registry-parity rejects entries outside it
+CATEGORIES = frozenset({
+    "math", "reduce", "linalg", "logic", "manip", "search", "stat",
+    "creation", "random", "fft", "signal", "inplace"})
+
+# names registered more than once (the later entry shadows the earlier);
+# recorded instead of raising so the registry-parity pass can report every
+# collision with a location rather than dying on the first
+DUPLICATE_REGISTRATIONS: list[str] = []
+
 
 def register(spec: OpSpec):
+    if spec.name in REGISTRY:
+        DUPLICATE_REGISTRATIONS.append(spec.name)
     REGISTRY[spec.name] = spec
     return spec
 

@@ -1,0 +1,378 @@
+"""graftlint (paddle_tpu.analysis) — per-pass fixture tests + repo self-check.
+
+Each pass gets a known-bad fixture (seeded violations it must catch) and a
+known-clean fixture (idioms it must NOT flag).  The repo self-check at the
+bottom is the tier-1 CI gate: the analyzer must exit clean on the tree.
+"""
+import importlib
+import json
+import pathlib
+import textwrap
+
+import pytest
+
+from paddle_tpu.analysis import PASSES, run
+from paddle_tpu.analysis import cli
+from paddle_tpu.analysis.cache import FileCache
+from paddle_tpu.analysis.framework import Finding, SourceFile
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _lint(tmp_path, source, select=None, name="fixture.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return run([str(p)], select=select)
+
+
+def _codes(result):
+    return {f.code for f in result.findings}
+
+
+# ---------------------------------------------------------------- trace-safety
+
+TS_BAD = """
+    import jax
+    import numpy as np
+
+    _STEP = 0
+
+    @jax.jit
+    def bad(x, y):
+        global _STEP
+        if x > 0:                  # TS101: data-dependent branch
+            y = y + 1
+        v = float(x)               # TS102: host escape builtin
+        h = y.numpy()              # TS103: host escape method
+        w = np.tanh(x)             # TS104: numpy on a tracer
+        _STEP = _STEP + 1          # TS105: trace-time side effect
+        return helper(y) + v + h + w
+
+    def helper(z):
+        while z.sum() > 0:         # TS101 via interprocedural taint
+            z = z - 1
+        return z
+"""
+
+TS_CLEAN = """
+    import jax
+    import numpy as np
+
+    TABLE = np.arange(8)           # numpy on host constants is fine
+
+    @jax.jit
+    def clean(x, mask=None):
+        if mask is None:           # identity compare is static
+            mask = x * 0
+        if len(x.shape) == 2:      # shape metadata is host-known
+            x = x + 1
+        for dim in range(x.ndim):  # ndim is static
+            x = x * 1
+        vals = [x, x + 1]
+        out = 0
+        for v, keep in zip(vals, [True, False]):   # static mask: no taint
+            if keep:
+                out = out + v
+        return out
+"""
+
+
+def test_trace_safety_catches_seeded_violations(tmp_path):
+    res = _lint(tmp_path, TS_BAD, select=["trace-safety"])
+    assert {"TS101", "TS102", "TS103", "TS104", "TS105"} <= _codes(res)
+    # the interprocedural edge reaches helper()'s while loop
+    lines = {f.line for f in res.findings if f.code == "TS101"}
+    assert len(lines) >= 2
+
+
+def test_trace_safety_clean_idioms_not_flagged(tmp_path):
+    res = _lint(tmp_path, TS_CLEAN, select=["trace-safety"])
+    assert res.findings == []
+
+
+def test_trace_safety_respects_static_argnames(tmp_path):
+    src = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("mode",))
+        def f(x, mode):
+            if mode == "train":    # static arg: host branch is fine
+                return x * 2
+            return x
+    """
+    res = _lint(tmp_path, src, select=["trace-safety"])
+    assert res.findings == []
+
+
+def test_trace_safety_every_finding_has_hint(tmp_path):
+    res = _lint(tmp_path, TS_BAD, select=["trace-safety"])
+    assert res.findings and all(f.hint for f in res.findings)
+
+
+# ------------------------------------------------------------- registry-parity
+
+RP_STATIC_BAD = """
+    REGISTRY = {}
+
+    def u(name, ref, cat="math", **kw):
+        REGISTRY[name] = (ref, cat, kw)
+
+    u("tanh", None)                 # RP003: golden without np_ref/check
+    u("tanh", abs)                  # RP001: duplicate registration
+    u("warp", abs, cat="astral")    # RP002: unknown category
+"""
+
+RP_RUNTIME_PKG = """
+    REGISTRY = {}
+    CATEGORIES = frozenset({"math"})
+    DUPLICATE_REGISTRATIONS = []
+
+    class OpSpec:
+        def __init__(self, name, op, np_ref=None, sample=None, kwargs=(),
+                     kind="golden", category="math", check=None,
+                     alias_of=None):
+            self.name, self.op, self.np_ref = name, op, np_ref
+            self.sample, self.kwargs, self.kind = sample, kwargs, kind
+            self.category, self.check, self.alias_of = category, check, alias_of
+
+        def resolve(self):
+            if self.op is None:
+                raise AttributeError(f"no resolver for {self.name}")
+            return self.op
+
+    def _one(x):
+        return x
+
+    def u(name, ref, cat="math", **kw):
+        REGISTRY[name] = OpSpec(name, kw.pop("op", None), np_ref=ref,
+                                category=cat, **kw)
+
+    u("good", abs, op=_one, sample=lambda: [1.0])
+    u("two_into_one", abs, op=_one, sample=lambda: [1.0, 2.0])  # RP007
+    u("ghost", abs, op=None, sample=lambda: [1.0])              # RP006
+"""
+
+
+def test_registry_parity_static_checks(tmp_path):
+    res = _lint(tmp_path, RP_STATIC_BAD, select=["registry-parity"])
+    assert {"RP001", "RP002", "RP003"} <= _codes(res)
+
+
+def test_registry_parity_runtime_checks(tmp_path, monkeypatch):
+    pkg = tmp_path / "graftlint_fixture_pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "registry.py").write_text(textwrap.dedent(RP_RUNTIME_PKG))
+    monkeypatch.syspath_prepend(str(tmp_path))
+    importlib.invalidate_caches()
+    res = run([str(pkg)], select=["registry-parity"])
+    codes = _codes(res)
+    assert "RP007" in codes     # resolver arity vs sample builder
+    assert "RP006" in codes     # missing resolver
+    flagged = {f.message.split("'")[1] for f in res.findings}
+    assert "good" not in flagged
+
+
+def test_registry_parity_clean_on_non_registry_files(tmp_path):
+    res = _lint(tmp_path, "def u(x):\n    return x\nu(3)\n",
+                select=["registry-parity"])
+    assert res.findings == []
+
+
+# ------------------------------------------------------------ namespace-parity
+
+NS_BAD = """
+    __all__ = ["real", "ghost", "real"]    # NS001 ghost, NS002 dup
+
+    def real():
+        return 1
+"""
+
+NS_CLEAN = """
+    import os as _os
+
+    __all__ = ["real", "CONST", "_os"]
+
+    CONST = 3
+
+    def real():
+        return 1
+"""
+
+
+def test_namespace_parity_catches_stale_and_duplicate(tmp_path):
+    res = _lint(tmp_path, NS_BAD, select=["namespace-parity"])
+    assert _codes(res) == {"NS001", "NS002"}
+    msgs = " ".join(f.message for f in res.findings)
+    assert "ghost" in msgs
+
+
+def test_namespace_parity_clean(tmp_path):
+    res = _lint(tmp_path, NS_CLEAN, select=["namespace-parity"])
+    assert res.findings == []
+
+
+def test_namespace_parity_skips_star_import_files(tmp_path):
+    src = """
+        from os.path import *
+
+        __all__ = ["join", "whatever"]
+    """
+    res = _lint(tmp_path, src, select=["namespace-parity"])
+    assert not any(f.code == "NS001" for f in res.findings)
+
+
+# ----------------------------------------------------------- jit-cache-hygiene
+
+JH_BAD = """
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    @jax.jit
+    def f(x, scale=jnp.ones(3), opts=[1, 2]):   # JH002, JH001
+        return x * scale
+
+    @partial(jax.jit, static_argnames=("cfg",))
+    def g(x, cfg={"a": 1}):                      # JH004
+        return x
+
+    def caller(x):
+        return g(x, cfg={"b": 2})                # JH003
+"""
+
+JH_CLEAN = """
+    import jax
+
+    @jax.jit
+    def f(x, scale=None, shape=(3, 3)):          # None/tuple defaults hash fine
+        return x
+
+    def plain(x, opts=[1]):                      # not a jit entry: no finding
+        return x
+"""
+
+
+def test_jit_cache_hygiene_catches_seeded_violations(tmp_path):
+    res = _lint(tmp_path, JH_BAD, select=["jit-cache-hygiene"])
+    assert _codes(res) == {"JH001", "JH002", "JH003", "JH004"}
+
+
+def test_jit_cache_hygiene_clean(tmp_path):
+    res = _lint(tmp_path, JH_CLEAN, select=["jit-cache-hygiene"])
+    assert res.findings == []
+
+
+# ----------------------------------------------------- framework: pragmas etc.
+
+def test_line_pragma_suppresses(tmp_path):
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:  # graftlint: disable=trace-safety
+                return x
+            return -x
+    """
+    res = _lint(tmp_path, src, select=["trace-safety"])
+    assert res.findings == [] and res.suppressed == 1
+
+
+def test_file_pragma_suppresses_all(tmp_path):
+    res = _lint(tmp_path, "# graftlint: disable-file=all\n"
+                + textwrap.dedent(TS_BAD), select=["trace-safety"])
+    assert res.findings == [] and res.suppressed >= 5
+
+
+def test_pragma_is_pass_specific(tmp_path):
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x, opts=[1]):  # graftlint: disable=trace-safety
+            return x
+    """
+    res = _lint(tmp_path, src, select=["jit-cache-hygiene"])
+    assert _codes(res) == {"JH001"}     # wrong pass name: not suppressed
+
+
+def test_syntax_error_is_a_finding(tmp_path):
+    res = _lint(tmp_path, "def broken(:\n")
+    assert _codes(res) == {"GL000"}
+
+
+def test_cache_replay_matches_fresh_run(tmp_path):
+    p = tmp_path / "bad.py"
+    p.write_text(textwrap.dedent(TS_BAD))
+    cpath = str(tmp_path / "cache.json")
+    r1 = run([str(p)], select=["trace-safety"], cache=FileCache(cpath))
+    r2 = run([str(p)], select=["trace-safety"], cache=FileCache(cpath))
+    assert r1.cache_hits == 0 and r2.cache_hits == 1
+    assert [f.to_dict() for f in r1.findings] == \
+           [f.to_dict() for f in r2.findings]
+    # editing the file invalidates the entry
+    p.write_text(textwrap.dedent(TS_BAD) + "\n# touched\n")
+    r3 = run([str(p)], select=["trace-safety"], cache=FileCache(cpath))
+    assert r3.cache_hits == 0
+
+
+def test_finding_dict_round_trip():
+    f = Finding("trace-safety", "TS101", "a.py", 3, "msg", "hint")
+    assert Finding.from_dict(f.to_dict()) == f
+
+
+def test_all_four_passes_registered():
+    assert {"trace-safety", "registry-parity", "namespace-parity",
+            "jit-cache-hygiene"} <= set(PASSES)
+
+
+def test_unknown_pass_rejected(tmp_path):
+    with pytest.raises(KeyError):
+        _lint(tmp_path, "x = 1\n", select=["no-such-pass"])
+
+
+# ----------------------------------------------------------------------- CLI
+
+def test_cli_json_schema_and_exit_code(tmp_path, capsys):
+    p = tmp_path / "bad.py"
+    p.write_text(textwrap.dedent(TS_BAD))
+    rc = cli.main([str(p), "--format", "json", "--no-cache"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert data["graftlint"] == 1
+    assert data["files"] == 1
+    assert {f["code"] for f in data["findings"]} >= {"TS101", "TS105"}
+    assert all({"pass", "code", "path", "line", "message", "hint"}
+               <= set(f) for f in data["findings"])
+
+
+def test_cli_clean_exit_zero(tmp_path, capsys):
+    p = tmp_path / "ok.py"
+    p.write_text("x = 1\n")
+    assert cli.main([str(p), "--no-cache"]) == 0
+    assert "OK:" in capsys.readouterr().out
+
+
+def test_cli_unknown_pass_is_usage_error(tmp_path, capsys):
+    p = tmp_path / "ok.py"
+    p.write_text("x = 1\n")
+    assert cli.main([str(p), "--select", "bogus", "--no-cache"]) == 2
+
+
+def test_cli_list_passes(capsys):
+    assert cli.main(["--list-passes"]) == 0
+    out = capsys.readouterr().out
+    assert "trace-safety" in out and "registry-parity" in out
+
+
+# ------------------------------------------------------- repo self-check gate
+
+def test_repo_tree_is_clean(tmp_path):
+    """The tier-1 CI gate: graftlint must exit clean on paddle_tpu/."""
+    res = run([str(REPO / "paddle_tpu")],
+              cache=FileCache(str(tmp_path / "cache.json")))
+    assert res.files > 100
+    assert not res.findings, "\n" + "\n".join(
+        f.render() for f in res.findings)
