@@ -461,6 +461,31 @@ def _serving_bench(paddle, on_tpu):
         except Exception as e:  # noqa: BLE001
             print(f"int8-kv serving extra failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
+        # prefix cache: the same long prompt re-served — the second request
+        # skips prefill for every fully-cached page, so its TTFT vs the cold
+        # request isolates the shared-prefix win of automatic prefix caching
+        try:
+            engc = LLMEngine(m, max_batch=2, max_len=P + NEW + 8,
+                             page_size=16, prefill_chunk=CHUNK,
+                             decode_block="auto", prefix_cache=True)
+            rid0 = engc.add_request(prompt, max_new_tokens=NEW)
+            engc.run_until_done()                  # cold: populates cache
+            rid1 = engc.add_request(prompt, max_new_tokens=NEW)
+            engc.run_until_done()
+            st = engc.prefix_cache_stats()
+            out["prefix_cache"] = {
+                "ttft_ms_hit": round(engc.ttft(rid1) * 1e3, 1),
+                "ttft_ms_cold": round(engc.ttft(rid0) * 1e3, 1),
+                "prefill_dispatches_cold":
+                    engc._finished[rid0].prefill_dispatches,
+                "prefill_dispatches_hit":
+                    engc._finished[rid1].prefill_dispatches,
+                "page_hits": st["hits"], "page_misses": st["misses"],
+                "evictions": st["evictions"],
+                "cow_copies": st["cow_copies"]}
+        except Exception as e:  # noqa: BLE001
+            print(f"prefix-cache serving extra failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
         return out
     except Exception as e:  # noqa: BLE001 — extras must not kill the bench
         print(f"serving bench failed: {type(e).__name__}: {e}",
@@ -849,22 +874,44 @@ def supervise():
     # their per-child timeout + the in-process final shape + extras) so a slow
     #-but-working run is never killed mid-measurement
     attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "5400"))
+    # hard ceiling on TOTAL supervisor wall time (attempts + backoffs). The
+    # driver kills the whole process at its own deadline and a killed
+    # supervisor prints nothing — the BENCH_r05 rc=124 failure mode. Default
+    # sits well under the driver's timeout so the JSON line always lands.
+    wall_budget = float(os.environ.get("BENCH_WALL_BUDGET", "3000"))
+    margin = 30.0                      # reserved for emitting the artifact
+    t_start = time.time()
+
+    def budget_left():
+        return wall_budget - margin - (time.time() - t_start)
+
     backoffs = [15.0, 60.0]
     attempts = []
     for i in range(max_attempts):
+        left = budget_left()
+        if left < 60.0:                # not enough to learn anything new
+            attempts.append({
+                "attempt": i + 1, "elapsed_s": 0.0,
+                "reason": f"wall budget exhausted before attempt {i + 1} "
+                          f"(BENCH_WALL_BUDGET={wall_budget:.0f}s)"})
+            break
+        this_timeout = min(attempt_timeout, left)
         t0 = time.time()
         reason = None
         try:
             # own session: a timeout must killpg the whole tree, or orphaned
             # geometry grandchildren keep holding HBM and poison the retry
+            # (the clamped per-attempt timeout rides into the child so its
+            # own sub-budgets — llama geometry children — scale down too)
             proc = subprocess.Popen(
                 [sys.executable, os.path.abspath(__file__)],
-                env=dict(os.environ, BENCH_SUPERVISED="1"),
+                env=dict(os.environ, BENCH_SUPERVISED="1",
+                         BENCH_ATTEMPT_TIMEOUT=f"{this_timeout:.0f}"),
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
                 start_new_session=True)
             timed_out = False
             try:
-                out, errout = proc.communicate(timeout=attempt_timeout)
+                out, errout = proc.communicate(timeout=this_timeout)
             except subprocess.TimeoutExpired:
                 timed_out = True
                 try:
@@ -892,7 +939,7 @@ def supervise():
                 return 0
             tail = "\n".join((errout or "").strip().splitlines()[-12:])
             if timed_out:
-                reason = (f"attempt hung past {attempt_timeout:.0f}s; "
+                reason = (f"attempt hung past {this_timeout:.0f}s; "
                           f"child stderr tail: {tail[-600:]}")
             else:
                 reason = f"child rc={proc.returncode}: {tail[-800:]}"
@@ -904,10 +951,12 @@ def supervise():
         print(f"bench attempt {i + 1}/{max_attempts} failed: {reason[:300]}",
               file=sys.stderr)
         if i < max_attempts - 1:
-            time.sleep(backoffs[min(i, len(backoffs) - 1)])
+            time.sleep(max(0.0, min(backoffs[min(i, len(backoffs) - 1)],
+                                    budget_left())))
     print(json.dumps({
         "metric": METRIC, "value": None, "unit": UNIT, "vs_baseline": None,
-        "error": attempts[-1]["reason"][:500],
+        "error": (attempts[-1]["reason"] if attempts else "no attempts ran")
+                 [:500],
         "extra": {"attempts": attempts,
                   "note": "all bench attempts failed; structured error "
                           "artifact emitted so the driver records data, "

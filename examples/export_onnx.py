@@ -6,6 +6,13 @@ the exported graph for verification.
 
 Run:  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python examples/export_onnx.py
 """
+import os
+import sys
+
+# runnable from any cwd: the repo root (one level up) on sys.path
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
 import tempfile
 
 import numpy as np

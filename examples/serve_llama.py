@@ -6,6 +6,13 @@ KV-cache pages (~2x slots at the same HBM budget).
 
 Run:  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python examples/serve_llama.py
 """
+import os
+import sys
+
+# runnable from any cwd: the repo root (one level up) on sys.path
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
 import numpy as np
 
 import paddle_tpu as paddle

@@ -6,6 +6,13 @@ Run:
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python examples/sharded_train.py
 """
+import os
+import sys
+
+# runnable from any cwd: the repo root (one level up) on sys.path
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
 import numpy as np
 
 import paddle_tpu as paddle

@@ -1,12 +1,21 @@
 """Pretrain a (tiny) GPT-2 with the compiled train step.
 
 The pattern scales to the real chip unchanged: `jit.scan_steps` fuses K
-optimizer steps into one dispatch (one tunnel round trip buys K updates),
-and `float(loss)` inside the step is a stitched break — the step stays one
-fused XLA program while your logging sees true per-call values.
+optimizer steps into one dispatch (one tunnel round trip buys K updates).
+Losses come back STACKED on the leading [K] axis and are read on the host
+after the dispatch — scan_steps raises a permanent MissedCapture on any
+in-step scalar event, so a `float(loss)` inside the step would silently
+pin the whole example eager (stitched breaks are a `to_static` feature).
 
 Run:  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python examples/train_gpt2.py
 """
+import os
+import sys
+
+# runnable from any cwd: the repo root (one level up) on sys.path
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
 import numpy as np
 
 import paddle_tpu as paddle
@@ -30,18 +39,20 @@ def main(steps=4, k=2, batch=2, seqlen=64):
         loss.backward()
         opt.step()
         opt.clear_grad()
-        losses.append(float(loss))      # stitched break: stays compiled
-        return loss
+        return loss                     # host read happens AFTER dispatch
 
     step = paddle.jit.scan_steps(train_step) if k > 1 \
         else paddle.jit.to_static(train_step)
     rng = np.random.RandomState(0)
+    # one fixed batch, revisited every step: loss must fall as the model
+    # memorizes it (fresh random ids each step would just bounce around)
+    ids = rng.randint(0, cfg.vocab_size,
+                      (k, batch, seqlen + 1)).astype(np.int32)
+    x = paddle.to_tensor(ids[:, :, :-1] if k > 1 else ids[0, :, :-1])
+    y = paddle.to_tensor(ids[:, :, 1:] if k > 1 else ids[0, :, 1:])
     for i in range(steps):
-        ids = rng.randint(0, cfg.vocab_size,
-                          (k, batch, seqlen + 1)).astype(np.int32)
-        x = paddle.to_tensor(ids[:, :, :-1] if k > 1 else ids[0, :, :-1])
-        y = paddle.to_tensor(ids[:, :, 1:] if k > 1 else ids[0, :, 1:])
-        step(x, y)
+        loss = step(x, y)               # [k] stacked under scan_steps
+        losses.extend(np.asarray(loss.numpy()).reshape(-1).tolist())
     print(f"losses (k={k} updates/dispatch): "
           f"{[round(v, 3) for v in losses]}")
     assert losses[-1] < losses[0]

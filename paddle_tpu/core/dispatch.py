@@ -123,7 +123,7 @@ def _apply_op_inner(name, fn, *inputs):
             else:
                 outs, vjp_fn = jax.vjp(fn, *arrays)
         except Exception as e:   # op-attributed errors (ref error summary)
-            e.add_note(_op_error_note(name, arrays))
+            _attach_op_note(e, name, arrays)
             raise
         single = not isinstance(outs, (tuple, list))
         outs_t = (outs,) if single else tuple(outs)
@@ -150,7 +150,7 @@ def _apply_op_inner(name, fn, *inputs):
         try:
             outs = fn(*arrays)
         except Exception as e:
-            e.add_note(_op_error_note(name, arrays))
+            _attach_op_note(e, name, arrays)
             raise
         single = not isinstance(outs, (tuple, list))
         wrapped = [_wrap_out(o, True)
@@ -179,6 +179,14 @@ def _op_error_note(name, arrays):
         for a in arrays[:6])
     more = "..." if len(arrays) > 6 else ""
     return f"[paddle_tpu] raised while dispatching op '{name}' ({metas}{more})"
+
+
+def _attach_op_note(e, name, arrays):
+    note = _op_error_note(name, arrays)
+    if hasattr(e, "add_note"):           # PEP 678, python >= 3.11
+        e.add_note(note)
+    else:                                # 3.10: fold into the message instead
+        e.args = ((f"{e.args[0]}\n{note}",) + e.args[1:]) if e.args else (note,)
 
 
 def _attach_replay(name, fn, inputs, arrays, wrapped):

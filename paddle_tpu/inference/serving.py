@@ -24,6 +24,18 @@ TPU-native design:
   `page_pool` SMALLER than the worst case (the HBM budget knob)
   oversubscribes safely — when the pool runs dry the youngest slot is
   preempted back to the waiting queue (vLLM-style recompute).
+- AUTOMATIC PREFIX CACHING (`prefix_cache=True`): every FULL prompt page is
+  hashed by its prefix chain (key_i = H(key_{i-1}, page_i tokens) — the
+  radix-trie lookup collapsed to a chain-hash dict, SGLang-style), physical
+  pages are REFCOUNTED so several slots map the same page, and admission
+  skips prefill over every fully-cached page (`req.pos` jumps ahead; only
+  the tail chunk dispatches). A slot writing into a page another slot still
+  maps gets a COPY-ON-WRITE private page first; released pages whose
+  content is cached stay resident in an LRU and are reclaimed (evicted)
+  only when the free list runs dry, so preemption stays the last resort.
+  Cached KV is bit-identical to what recomputation would write (same
+  program, same absolute RoPE positions), so hits change dispatch counts,
+  never tokens.
 - Weights are extracted from the model once, stacked [L, ...] and placed
   with NamedShardings: layers sharded over the pp axis, head/ffn dims over
   the mp axis. GSPMD inserts the collectives.
@@ -32,7 +44,7 @@ from __future__ import annotations
 
 import math
 import time
-from collections import deque
+from collections import OrderedDict, deque
 
 import numpy as np
 import jax
@@ -65,6 +77,9 @@ class Request:
         self.admit_seq = -1          # preemption picks the youngest
         self.t_submit = time.perf_counter()
         self.ttft = None             # seconds to first generated token
+        self.prefill_dispatches = 0  # prefill programs dispatched for us
+        self.cached_tokens = 0       # prompt tokens served from prefix cache
+        self.cache_keys = ()         # chain keys of the prompt's full pages
 
 
 def _rope(x, pos, theta):
@@ -125,11 +140,31 @@ class LLMEngine:
     def __init__(self, model, mesh=None, mp_axis="mp", pp_axis="pp",
                  max_batch=4, max_len=256, page_size=16, prefill_chunk=32,
                  page_pool=None, decode_block=1, use_kernel=None, seed=0,
-                 kv_cache_dtype="auto", decode_block_max=32):
+                 kv_cache_dtype="auto", decode_block_max=32,
+                 prefix_cache=False):
         """page_pool: usable KV pages (the HBM budget). Defaults to the
         worst case (max_batch * ceil(max_len/page)); set it SMALLER to
         oversubscribe — on-demand growth means slots only claim what they
         use, and a dry pool preempts the youngest slot (recompute).
+
+        prefix_cache: automatic prefix caching (vLLM shared pages + CoW,
+        SGLang-style chain-hash lookup). Full prompt pages are hashed by
+        (prefix chain, page tokens) and refcounted; a later request whose
+        prompt starts with a cached page chain maps those physical pages
+        into its table and skips their prefill entirely (at least the final
+        prompt token always re-prefills — its logits sample the first output
+        token, and when that token's page is still shared the write goes
+        through a copy-on-write private page). Released-but-cached pages
+        park in an LRU and are evicted only when the free list runs dry.
+        Counters: ``cache_hits`` / ``cache_misses`` (pages, at admission),
+        ``cache_evictions``, ``cache_cow_copies`` — see
+        :meth:`prefix_cache_stats`. Token streams are byte-identical to a
+        ``prefix_cache=False`` engine at the same seeds; only dispatch
+        counts and TTFT change. (One caveat shared with generate(): a
+        do_sample request WITHOUT a fixed seed draws from the engine's
+        global seed counter, which advances once per prefill dispatch —
+        fewer dispatches shift later seedless draws. Seeded and greedy
+        requests are unaffected.)
 
         decode_block: max decode steps fused into one dispatch (power-of-two
         blocks are chosen per step, shrinking near max_new; eos-bearing
@@ -234,6 +269,20 @@ class LLMEngine:
 
         # host scheduler state (trash page is never allocated)
         self._free_pages = deque(range(self.n_pages - 1))
+        # prefix cache: refcounts + chain-hash index + reclaimable LRU.
+        # With prefix_cache=False nothing is ever hashed, so every released
+        # page goes straight back to _free_pages (legacy behavior).
+        self.prefix_cache = bool(prefix_cache)
+        self._page_ref = np.zeros(self.n_pages, np.int64)
+        self._page_key: dict = {}          # physical page -> chain key
+        self._key_page: dict = {}          # chain key -> physical page
+        self._lru: OrderedDict = OrderedDict()  # cached, refcount==0 pages
+        self.cache_hits = 0                # pages served from cache (admit)
+        self.cache_misses = 0              # full prompt pages not cached
+        self.cache_evictions = 0           # cached pages reclaimed from LRU
+        self.cache_cow_copies = 0          # copy-on-write page copies
+        self.prefill_dispatches = 0        # total prefill programs run
+        self._copy_page_fn = None
         self._slots: list = [None] * max_batch
         self._slot_tables = np.zeros((max_batch, self.pages_per_slot),
                                      np.int32)
@@ -250,6 +299,7 @@ class LLMEngine:
             self.decode_block = max(1, int(decode_block_max))
             self._block_target = 1          # sample k=1 first, then k=2
             self._block_samples: dict = {}  # k -> recent wall dts
+            self._block_n = 0               # total samples recorded
         else:
             self.decode_block = max(1, int(decode_block))
         self._decode_programs: dict = {}
@@ -421,6 +471,98 @@ class LLMEngine:
         self._waiting.append(r)
         return r.rid
 
+    # ------------------------------------------------------ page accounting
+    def _page_keys(self, tokens):
+        """Chain key per FULL page: key_i = hash(key_{i-1}, page_i tokens).
+        A page is shareable only as the tail of an identical-from-position-0
+        prefix — RoPE bakes absolute positions into cached K, so content
+        alone is not enough. This is the radix-trie prefix lookup collapsed
+        to one dict probe per page."""
+        keys, h = [], None
+        for i in range(0, (len(tokens) // self.page) * self.page, self.page):
+            h = hash((h,) + tuple(tokens[i:i + self.page]))
+            keys.append(h)
+        return keys
+
+    def _ref_page(self, p):
+        self._page_ref[p] += 1
+        self._lru.pop(p, None)        # referenced again: not reclaimable
+
+    def _unref_page(self, p):
+        self._page_ref[p] -= 1
+        if self._page_ref[p] > 0:
+            return
+        if p in self._page_key:       # content cached: park reclaimable
+            self._lru[p] = None
+            self._lru.move_to_end(p)
+        else:
+            self._free_pages.append(p)
+
+    def _alloc_page(self):
+        """A writable page with refcount 1: free list first, then LRU
+        eviction of the oldest cached-but-unreferenced page. Returns None
+        when both are dry (the caller preempts — last resort)."""
+        if self._free_pages:
+            p = self._free_pages.popleft()
+        elif self._lru:
+            p, _ = self._lru.popitem(last=False)
+            self._key_page.pop(self._page_key.pop(p), None)
+            self.cache_evictions += 1
+        else:
+            return None
+        self._page_ref[p] = 1
+        return p
+
+    def _copy_page(self, src, dst):
+        """Device-side copy of one physical KV page (all layers, K and V,
+        int8 scales included) — the copy half of copy-on-write."""
+        if self._copy_page_fn is None:
+            def cp(cache, s, d):
+                return tuple(a.at[:, d].set(a[:, s]) for a in cache)
+            self._copy_page_fn = jax.jit(cp, donate_argnums=(0,))
+        self.cache = self._copy_page_fn(
+            self.cache, jnp.asarray(np.int32(src)), jnp.asarray(np.int32(dst)))
+        self.cache_cow_copies += 1
+
+    def _cow_unshare(self, slot, start, n):
+        """Copy-on-write before a prefill write into [start, start+n): any
+        touched page another slot still maps (refcount > 1) gets a private
+        copy so the write can't clobber the shared prefix. Hit on exactly
+        one path: a fully-cached prompt re-prefills its final token into the
+        last shared page."""
+        for j in range(start // self.page, (start + n - 1) // self.page + 1):
+            p = int(self._slot_tables[slot, j])
+            while int(self._page_ref[p]) > 1:
+                q = self._alloc_page()
+                if q is None:
+                    # preemption may release the OTHER reference, making the
+                    # copy unnecessary — the while re-checks
+                    if not self._preempt_youngest(excluding=slot):
+                        raise RuntimeError(
+                            "page pool exhausted during copy-on-write — "
+                            "engine misconfigured (max_len vs page pool)")
+                    continue
+                self._copy_page(p, q)
+                self._page_ref[p] -= 1
+                self._slot_tables[slot, j] = q
+                if j == int(self._n_alloc[slot]) - 1:
+                    self._slot_tables[slot, j + 1:] = q   # repoint padding
+                p = q
+
+    def _register_pages(self, slot, r):
+        """Hash-register every completed full prompt page of this slot so
+        later requests can hit it. First registration wins; a page whose
+        content another physical page already serves stays private."""
+        for j in range(int(self._lens[slot]) // self.page):
+            p = int(self._slot_tables[slot, j])
+            if p in self._page_key:
+                continue                  # hit page / already registered
+            key = r.cache_keys[j]
+            if key in self._key_page:
+                continue
+            self._page_key[p] = key
+            self._key_page[key] = p
+
     def _admit(self):
         for slot in range(self.max_batch):
             if self._slots[slot] is not None or not self._waiting:
@@ -430,15 +572,41 @@ class LLMEngine:
             # grows page-by-page (cf. the r3 engine's worst-case
             # prompt+max_new reservation, which gave paging no benefit)
             need = math.ceil(len(r.prompt) / self.page)
-            if len(self._free_pages) < need:
+            keys = self._page_keys(r.prompt) if self.prefix_cache else []
+            hits = []
+            for key in keys:
+                p = self._key_page.get(key)
+                if p is None:
+                    break
+                hits.append(p)
+            # pages admission must newly claim; hit pages sitting in the LRU
+            # are about to be re-referenced, so they are NOT allocatable
+            fresh = need - len(hits)
+            avail = (len(self._free_pages) + len(self._lru)
+                     - sum(1 for p in hits if p in self._lru))
+            if avail < fresh:
                 break
             self._waiting.popleft()
-            pages = [self._free_pages.popleft() for _ in range(need)]
+            pages = []
+            for p in hits:                # ref hits BEFORE allocating fresh
+                self._ref_page(p)         # pages so eviction can't take them
+                pages.append(p)
+            for _ in range(fresh):
+                pages.append(self._alloc_page())
             self._slot_tables[slot, :need] = pages
             self._slot_tables[slot, need:] = pages[-1]
             self._n_alloc[slot] = need
-            self._lens[slot] = 0
-            r.pos = 0
+            # skip prefill over fully-cached pages. At least the prompt's
+            # FINAL token always re-prefills: its logits sample the first
+            # output token (a 100%-cached prompt therefore re-enters its
+            # last shared page, which is the copy-on-write path).
+            skip = min(len(hits) * self.page, len(r.prompt) - 1)
+            self.cache_hits += len(hits)
+            self.cache_misses += len(keys) - len(hits)
+            r.cache_keys = keys
+            r.cached_tokens = skip
+            r.pos = skip
+            self._lens[slot] = skip
             r.slot = slot
             r.admit_seq = self._admit_seq
             self._admit_seq += 1
@@ -447,7 +615,7 @@ class LLMEngine:
     def _release(self, slot, finished=True):
         r = self._slots[slot]
         for p in self._slot_tables[slot, :int(self._n_alloc[slot])]:
-            self._free_pages.append(int(p))
+            self._unref_page(int(p))
         self._slots[slot] = None
         self._lens[slot] = 0
         self._n_alloc[slot] = 0
@@ -480,13 +648,13 @@ class LLMEngine:
         youngest other slot if the pool is dry."""
         needed = (int(self._lens[slot]) + ahead + self.page - 1) // self.page
         while int(self._n_alloc[slot]) < needed:
-            if not self._free_pages:
+            p = self._alloc_page()
+            if p is None:
                 if not self._preempt_youngest(excluding=slot):
                     raise RuntimeError(
                         "page pool exhausted with a single slot — engine "
                         "misconfigured (max_len vs page pool)")
                 continue
-            p = self._free_pages.popleft()
             na = int(self._n_alloc[slot])
             self._slot_tables[slot, na] = p
             self._slot_tables[slot, na + 1:] = p
@@ -513,9 +681,16 @@ class LLMEngine:
         r = self._slots[slot]
         start = r.pos
         n = min(self.chunk, len(r.prompt) - start)
+        if self.prefix_cache:
+            # about to write [start, start+n): un-share any page another
+            # slot still maps (a fully-cached prompt re-prefilling its
+            # final token into the last shared page lands here)
+            self._cow_unshare(slot, start, n)
         toks = np.zeros((self.chunk,), np.int32)
         toks[:n] = r.prompt[start:start + n]
         finishes = (start + n) == len(r.prompt)
+        r.prefill_dispatches += 1
+        self.prefill_dispatches += 1
         nxt, self.cache = self._prefill(
             self.W, self.cache, jnp.asarray(toks),
             jnp.asarray(np.int32(start)),
@@ -528,6 +703,8 @@ class LLMEngine:
             jnp.asarray(np.int32(self._next_seed(r))))
         r.pos += n
         self._lens[slot] = start + n
+        if self.prefix_cache:
+            self._register_pages(slot, r)
         if finishes:
             self._emit(slot, int(np.asarray(nxt)))
 
@@ -602,12 +779,17 @@ class LLMEngine:
         return len(live)
 
     def _record_block_sample(self, k, wall_dt):
-        """Auto decode-block: fit t(k) = RTT + k*c from the two smallest
-        sampled block sizes and target the power-of-two k where the
-        per-dispatch constant costs <= ~25% of device time (k >= 3*RTT/c)."""
+        """Auto decode-block: least-squares fit of t(k) = RTT + k*c over
+        the per-size medians of EVERY sampled block size, targeting the
+        power-of-two k where per-dispatch constant costs <= ~25% of device
+        time (k >= 3*RTT/c). Fitting all sizes (instead of the two
+        earliest medians) lets late samples at large k keep correcting the
+        model, and every 64th sample the target drops back to a small k
+        for one dispatch so the intercept estimate can't go stale."""
         samples = self._block_samples.setdefault(k, [])
         samples.append(wall_dt)
         del samples[:-8]
+        self._block_n += 1
         sampled = {kk: sorted(v)[len(v) // 2]
                    for kk, v in self._block_samples.items() if v}
         if len(sampled) < 2:
@@ -615,15 +797,19 @@ class LLMEngine:
             self._block_target = min(2, self.decode_block) \
                 if 1 in sampled else 1
             return
-        (ka, ta), (kb, tb) = sorted(sampled.items())[:2]
-        c = (tb - ta) / (kb - ka)
-        rtt = ta - ka * c
+        ks = sorted(sampled)
+        c, rtt = np.polyfit(np.asarray(ks, np.float64),
+                            np.asarray([sampled[kk] for kk in ks],
+                                       np.float64), 1)
         if c <= 0 or rtt <= 0:       # noise/local runtime: RTT negligible
             self._block_target = min(2, self.decode_block)
             return
         want = max(1, int(3 * rtt / c))
         want = 1 << (want.bit_length() - 1)              # floor to pow2
         self._block_target = min(want, self.decode_block)
+        if self._block_n % 64 == 0:
+            # periodic small-k re-sample refreshes the RTT intercept
+            self._block_target = min(2, self.decode_block)
 
     @property
     def auto_decode_block(self):
@@ -637,6 +823,19 @@ class LLMEngine:
             self.step()
             steps += 1
         return steps
+
+    def prefix_cache_stats(self):
+        """Counters for the automatic prefix cache (all zero when the
+        `prefix_cache` knob is off)."""
+        return {
+            "hits": self.cache_hits,
+            "misses": self.cache_misses,
+            "evictions": self.cache_evictions,
+            "cow_copies": self.cache_cow_copies,
+            "prefill_dispatches": self.prefill_dispatches,
+            "cached_pages": len(self._key_page),
+            "reclaimable_pages": len(self._lru),
+        }
 
     def kv_bytes_per_page(self):
         """HBM bytes one KV page costs across all layers (both K and V,

@@ -61,7 +61,10 @@ the whole train step eager-only forever).  The scheme:
   RUN is detected by the echo tape mismatch; feeding it into an op attribute
   is not detectable and is unsupported (hoist it, or use bool()/int() guards
   which re-specialize).  Side effects before a detected mismatch may run
-  twice for that one call (echo, then the eager fallback).
+  twice for that one call (echo, then the eager fallback).  A TENSOR kept
+  past the step (``history.append(loss)`` inside the fn, read after it) is a
+  shape-only echo placeholder: any later host read raises, pointing here —
+  read the value inside the step or return it from the step instead.
 
 Shapes are static per signature; variable seq-len is handled by bucketing
 above (SURVEY §7).
@@ -104,6 +107,37 @@ class EchoMismatch(Exception):
 
 _GUARD_KINDS = ("bool", "int")
 _BREAK_KINDS = ("float", "numpy")
+
+
+class EchoPlaceholderTensor(Tensor):
+    """Shape-only stand-in the echo pass returns from every short-circuited
+    op dispatch (its buffer is a ShapeDtypeStruct, never data). User code
+    that smuggles one past the step — ``history.append(loss)`` inside the
+    captured fn, read outside it — used to hit an opaque numpy error on a
+    ShapeDtypeStruct; any post-echo host read now raises pointing at the
+    break-stitching scheme. Inside capture/echo passes reads still route
+    through the active trace context like any Tensor."""
+
+    __slots__ = ()
+
+    def _post_echo_error(self):
+        return RuntimeError(
+            "host read of an echo-pass placeholder Tensor: this value was "
+            "produced inside a to_static/scan_steps step and carries no "
+            "data outside the call that made it. Read it inside the step "
+            "(float()/.numpy() there are stitched breaks) or return it "
+            "from the step function — see the break-stitching notes in "
+            "paddle_tpu/jit/to_static.py.")
+
+    def numpy(self):
+        if _state.trace_ctx is None:
+            raise self._post_echo_error()
+        return super().numpy()
+
+    def _convert_scalar(self, kind, caster):
+        if _state.trace_ctx is None:
+            raise self._post_echo_error()
+        return super()._convert_scalar(kind, caster)
 
 
 def _is_tensor(x):
@@ -207,8 +241,10 @@ class _ReplayContext:
             self.guard_outs.append((val != 0).astype(jnp.int32))
         elif kind == "int":
             self.guard_outs.append(val.astype(jnp.int32))  # trunc toward zero
-        else:  # float break: ride out as f32, no equality guard
-            self.break_outs.append(val.astype(jnp.float32))
+        else:  # float break: ride out in the traced dtype, no equality guard
+            # (an f32 round-trip would be observable for f64/int64 tensors
+            # under jax_enable_x64 — float() happens host-side in the echo)
+            self.break_outs.append(val)
         return planned
 
     def on_materialize(self, t):
@@ -333,7 +369,8 @@ class _EchoContext:
                 "float()/.numpy() break value")
         self.op_idx += 1
         _, single, out_meta = self.op_tape[i]
-        outs = [Tensor(sds, stop_gradient=sg) for sds, sg in out_meta]
+        outs = [EchoPlaceholderTensor(sds, stop_gradient=sg)
+                for sds, sg in out_meta]
         return outs[0] if single else tuple(outs)
 
     def finish(self):

@@ -59,6 +59,12 @@ assert len(jax.devices()) == 8, (
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tests excluded from the tier-1 gate (-m 'not slow')")
+
+
 def _live_children():
     """pid -> state for direct children of this process (via /proc)."""
     me = os.getpid()

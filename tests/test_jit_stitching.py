@@ -282,3 +282,59 @@ class TestMultipleBreaks:
             assert np.isfinite(pmean)
         # distinct calls observed distinct values
         assert seen[0][0] != seen[1][0]
+
+
+class TestEchoPlaceholders:
+    def test_smuggled_tensor_raises_clearly_post_echo(self):
+        """A Tensor appended to a list inside the step and read AFTER the
+        call is an echo-pass placeholder (its buffer is a ShapeDtypeStruct,
+        not data). The host read must raise a pointed error, not an opaque
+        numpy failure (ADVICE r5)."""
+        m, opt = _model_and_opt()
+        kept = []
+
+        def train_step(x, y):
+            loss = ((m(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            float(loss)                       # stitched break: fine
+            kept.append(loss)                 # placeholder smuggled out
+            return loss
+
+        step = paddle.jit.to_static(train_step)
+        data = _data()
+        for x, y in data[:3]:
+            step(x, y)
+        group = next(iter(step._cache.values()))
+        assert not group.eager_only           # the smuggle alone can't pin
+        for fn in (lambda t: float(t), lambda t: t.numpy(),
+                   lambda t: t.item(), lambda t: int(t)):
+            with pytest.raises(RuntimeError, match="placeholder"):
+                fn(kept[-1])
+        # the error points the user at the stitching scheme docs
+        with pytest.raises(RuntimeError, match="to_static"):
+            kept[-1].numpy()
+
+    def test_float_break_keeps_traced_dtype(self):
+        """Break values ride out of the compiled program in their traced
+        dtype — an f32 round-trip would be observable for f64 inputs under
+        jax_enable_x64 and for large int64 counters (ADVICE r5)."""
+        from paddle_tpu.jit.to_static import _ReplayContext
+        import jax
+        import jax.numpy as jnp
+
+        entry = _ReplayContext({}, plan=[("float", 2.0)])
+        t = paddle.to_tensor(np.array(2.0, np.float32))
+
+        def probe(buf):
+            entry.values[id(t)] = buf
+            entry.plan_idx = 0
+            entry.break_outs.clear()
+            entry.on_scalar(t, "float", float)
+            return entry.break_outs[0]
+
+        out = jax.eval_shape(probe, jax.ShapeDtypeStruct((), jnp.int32))
+        assert out.dtype == jnp.int32         # not silently cast to f32
+        out = jax.eval_shape(probe, jax.ShapeDtypeStruct((), jnp.float32))
+        assert out.dtype == jnp.float32

@@ -504,3 +504,31 @@ class TestAutoDecodeBlock:
         eng = LLMEngine(m, max_batch=2, max_len=64, page_size=8,
                         prefill_chunk=8, decode_block=4)
         assert eng.auto_decode_block == 4
+
+    def test_late_samples_correct_the_fit(self):
+        """Least-squares over ALL sampled block sizes (ADVICE r5: the old
+        two-earliest-medians fit froze the model): a large-k sample that
+        contradicts the small-k extrapolation pulls the target back down."""
+        _, eng = self._engine()
+        eng._record_block_sample(1, 0.103)
+        eng._record_block_sample(2, 0.106)
+        assert eng._block_target == 32        # small-k fit: huge RTT
+        # k=32 runs now produce real timings: the per-token cost is much
+        # higher than the k=1->2 delta suggested. The frozen fit would stay
+        # at 32 forever; the full least-squares re-solves to a small block.
+        for _ in range(8):
+            eng._record_block_sample(32, 1.6)
+        assert eng._block_target < 32, eng._block_target
+
+    def test_periodic_small_k_resample(self):
+        """Every 64th sample the target drops to a small k for one dispatch
+        so the RTT intercept keeps getting re-measured."""
+        _, eng = self._engine()
+        eng._record_block_sample(1, 0.103)
+        eng._record_block_sample(2, 0.106)
+        assert eng._block_target == 32
+        eng._block_n = 63
+        eng._record_block_sample(32, 0.196)   # consistent with the fit
+        assert eng._block_target == 2         # forced re-sample at small k
+        eng._record_block_sample(2, 0.106)
+        assert eng._block_target == 32        # model re-solved, back up
