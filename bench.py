@@ -486,6 +486,44 @@ def _serving_bench(paddle, on_tpu):
         except Exception as e:  # noqa: BLE001
             print(f"prefix-cache serving extra failed: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
+        # observability: the timed decode re-run with the metrics registry
+        # on vs off quantifies instrumentation overhead on one serving
+        # config; the enabled run's registry snapshot ships in the artifact
+        try:
+            from paddle_tpu import observability as _obs
+            engm = LLMEngine(m, max_batch=2, max_len=P + NEW + 8,
+                             page_size=16, prefill_chunk=CHUNK,
+                             decode_block="auto")
+            engm.add_request(prompt, max_new_tokens=NEW)
+            engm.run_until_done()                       # warm compile
+            engm.add_request(prompt, max_new_tokens=NEW)
+            engm.run_until_done()           # warm the fitted block size
+
+            def _timed_decode():
+                rid = engm.add_request(prompt, max_new_tokens=NEW)
+                t0 = time.perf_counter()
+                engm.run_until_done()
+                dt = time.perf_counter() - t0 - engm.ttft(rid)
+                return (NEW - 1) / max(dt, 1e-9)
+
+            tps_off = _timed_decode()
+            _obs.enable()
+            try:
+                tps_on = _timed_decode()
+                engm.metrics()      # push gauge refresh into the snapshot
+                snap = _obs.snapshot(prefix="serving_")
+            finally:
+                _obs.disable()
+                _obs.reset()
+            out["observability"] = {
+                "decode_tokens_per_sec_metrics_off": round(tps_off, 1),
+                "decode_tokens_per_sec_metrics_on": round(tps_on, 1),
+                "overhead_pct":
+                    round((tps_off / max(tps_on, 1e-9) - 1.0) * 100, 2),
+                "snapshot": snap}
+        except Exception as e:  # noqa: BLE001
+            print(f"observability serving extra failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
         return out
     except Exception as e:  # noqa: BLE001 — extras must not kill the bench
         print(f"serving bench failed: {type(e).__name__}: {e}",

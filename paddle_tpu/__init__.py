@@ -71,7 +71,7 @@ _LAZY = {
     "device", "profiler", "metric", "vision", "incubate", "sparse",
     "distribution", "hapi", "utils", "models", "parallel", "text", "audio",
     "quantization", "onnx", "inference", "geometric", "signal", "fft",
-    "strings",
+    "strings", "observability",
 }
 
 _LAZY_ATTRS = {

@@ -1,3 +1,4 @@
+# graftlint: disable-file=no-adhoc-telemetry  (CLI front-end: stdout is the UI)
 """graftlint CLI — ``python -m paddle_tpu.analysis`` / the ``graftlint``
 console script.
 

@@ -1,6 +1,6 @@
 """graftlint passes — importing this package registers every built-in pass."""
 from . import (jit_cache_hygiene, namespace_parity,  # noqa: F401
-               registry_parity, trace_safety)
+               no_adhoc_telemetry, registry_parity, trace_safety)
 
-__all__ = ["jit_cache_hygiene", "namespace_parity", "registry_parity",
-           "trace_safety"]
+__all__ = ["jit_cache_hygiene", "namespace_parity", "no_adhoc_telemetry",
+           "registry_parity", "trace_safety"]

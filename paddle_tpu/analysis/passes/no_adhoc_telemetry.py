@@ -1,0 +1,70 @@
+"""no-adhoc-telemetry — keep runtime telemetry on the sanctioned channels.
+
+This PR's observability layer gives library code three blessed outlets:
+``logging`` (diagnostics), the metrics registry (counters/gauges/histograms)
+and ``trace_span`` (timeline).  Ad-hoc instrumentation rots past them:
+
+  * ``print(...)`` in library code is invisible to any collector, cannot be
+    filtered by level, and interleaves with user stdout.  (AT101)
+  * ``time.time()`` is *wall clock* — NTP steps and DST make it jump, so
+    intervals measured with it are occasionally negative or wildly wrong.
+    Durations belong to ``time.perf_counter()``; deadlines shared within a
+    process to ``time.monotonic()``.  Wall-clock reads that genuinely need
+    calendar time (timestamps persisted across processes) carry a line
+    pragma stating so.  (AT102)
+
+Pure CLI front-ends (whose job *is* printing) opt out with
+``# graftlint: disable-file=no-adhoc-telemetry``.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..framework import AnalysisPass, Finding, register_pass
+
+_HINTS = {
+    "AT101": "use logging (module logger) for diagnostics, or the "
+             "observability registry for counters; pragma user-facing "
+             "console output",
+    "AT102": "time.perf_counter() for durations, time.monotonic() for "
+             "deadlines; pragma genuine wall-clock (calendar) reads",
+}
+
+
+@register_pass
+class NoAdhocTelemetryPass(AnalysisPass):
+    name = "no-adhoc-telemetry"
+    version = 1
+    description = ("bare print() and wall-clock time.time() timing in "
+                   "library code (vs logging/registry/perf_counter)")
+
+    def check_file(self, src) -> list[Finding]:
+        findings: list[Finding] = []
+        # `from time import time [as t]` makes bare-name calls wall-clock too
+        time_aliases = set()
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "time":
+                for a in node.names:
+                    if a.name == "time":
+                        time_aliases.add(a.asname or a.name)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name) and f.id == "print":
+                findings.append(Finding(
+                    self.name, "AT101", src.path, node.lineno,
+                    "bare print() in library code — uncollectable, "
+                    "unfilterable telemetry", _HINTS["AT101"]))
+            elif (isinstance(f, ast.Attribute) and f.attr == "time"
+                  and isinstance(f.value, ast.Name) and f.value.id == "time"):
+                findings.append(Finding(
+                    self.name, "AT102", src.path, node.lineno,
+                    "time.time() is wall clock — intervals jump on NTP "
+                    "steps", _HINTS["AT102"]))
+            elif isinstance(f, ast.Name) and f.id in time_aliases:
+                findings.append(Finding(
+                    self.name, "AT102", src.path, node.lineno,
+                    f"{f.id}() (time.time) is wall clock — intervals jump "
+                    "on NTP steps", _HINTS["AT102"]))
+        return findings

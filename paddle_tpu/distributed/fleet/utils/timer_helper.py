@@ -74,7 +74,7 @@ class TimerGroup:
                 ms = 1000.0 * self._timers[n].elapsed(reset) / normalizer
                 parts.append(f"{n}: {ms:.2f}ms")
         msg = "time (ms) | " + " | ".join(parts)
-        print(msg)
+        print(msg)  # graftlint: disable=no-adhoc-telemetry (log() prints by contract)
         return msg
 
     def throughput(self, name, items, reset=True):

@@ -1,3 +1,4 @@
+# graftlint: disable-file=no-adhoc-telemetry  (CLI front-end: stdout is the UI)
 """Multi-process launcher (reference: python/paddle/distributed/launch/main.py:23
 + controllers/collective.py). Spawns one worker process per device/slot, wires
 the rendezvous env (coordinator address + rank/world), tees per-rank logs, and

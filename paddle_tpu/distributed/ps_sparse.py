@@ -359,9 +359,9 @@ def serve(port, data_dir, host="127.0.0.1", ready_file=None, load_dir=None):
                 except _AuthError:
                     # unauthenticated/forged frame: drop the peer without
                     # replying (and without ever having unpickled its bytes)
-                    import sys
-                    print("ps_sparse: rejected unauthenticated frame",
-                          file=sys.stderr)
+                    import logging
+                    logging.getLogger("paddle_tpu.ps_sparse").warning(
+                        "rejected unauthenticated frame")
                     return
                 if msg is None:
                     return
@@ -459,8 +459,8 @@ def start_server_process(port, data_dir, ready_timeout=30.0):
              os.path.dirname(os.path.dirname(os.path.dirname(
                  os.path.abspath(__file__)))), port, data_dir, ready)],
         env=_hermetic_env())
-    deadline = time.time() + ready_timeout
-    while time.time() < deadline:
+    deadline = time.monotonic() + ready_timeout
+    while time.monotonic() < deadline:
         if os.path.exists(ready):
             return p
         if p.poll() is not None:
@@ -483,7 +483,7 @@ class SparsePsClient:
 
     def _sock(self, si):
         if self._socks[si] is None:
-            deadline = time.time() + self.retry
+            deadline = time.monotonic() + self.retry
             while True:
                 try:
                     s = socket.create_connection(self.endpoints[si],
@@ -493,13 +493,13 @@ class SparsePsClient:
                     self._socks[si] = s
                     break
                 except OSError:
-                    if time.time() > deadline:
+                    if time.monotonic() > deadline:
                         raise
                     time.sleep(0.1)
         return self._socks[si]
 
     def _call(self, si, msg):
-        deadline = time.time() + self.retry
+        deadline = time.monotonic() + self.retry
         while True:
             try:
                 s = self._sock(si)
@@ -512,7 +512,7 @@ class SparsePsClient:
                 return rep
             except (ConnectionError, OSError):
                 self._socks[si] = None       # reconnect (restarted server)
-                if time.time() > deadline:
+                if time.monotonic() > deadline:
                     raise
                 time.sleep(0.2)
 

@@ -194,9 +194,9 @@ def shutdown():
         return
     n = _store.add("rpc/shutdown", 1)
     world = len(_infos)
-    deadline = time.time() + 300
+    deadline = time.monotonic() + 300
     while _store.add("rpc/shutdown", 0) < world:
-        if time.time() > deadline:
+        if time.monotonic() > deadline:
             raise TimeoutError("rpc shutdown barrier timed out")
         time.sleep(0.02)
     with _conn_lock:

@@ -92,10 +92,10 @@ class _PyStoreServer(threading.Thread):
                         self._cv.notify_all()
                     self._reply(conn, 0)
                 elif cmd == _GET:
-                    deadline = time.time() + timeout
+                    deadline = time.monotonic() + timeout
                     with self._cv:
                         while key not in self._kv:
-                            left = deadline - time.time()
+                            left = deadline - time.monotonic()
                             if left <= 0:
                                 break
                             self._cv.wait(left)
@@ -116,12 +116,12 @@ class _PyStoreServer(threading.Thread):
                         self._cv.notify_all()
                     self._reply(conn, 0, b"1" if existed else b"0")
                 elif cmd == _WAIT:
-                    deadline = time.time() + timeout
+                    deadline = time.monotonic() + timeout
                     ok = True
                     with self._cv:
                         for k in key.split("\n") if key else []:
                             while k not in self._kv:
-                                left = deadline - time.time()
+                                left = deadline - time.monotonic()
                                 if left <= 0:
                                     ok = False
                                     break
@@ -166,7 +166,7 @@ class TCPStore:
             bind = "0.0.0.0" if host == "127.0.0.1" else host
             port, self.server_kind = _start_server(bind, port)
         self.host, self.port = host, port
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         last = None
         while True:
             try:
@@ -179,7 +179,7 @@ class TCPStore:
                 break
             except OSError as e:
                 last = e
-                if time.time() > deadline:
+                if time.monotonic() > deadline:
                     raise TimeoutError(
                         f"could not reach TCPStore at {host}:{port}") from last
                 time.sleep(0.1)

@@ -115,7 +115,10 @@ class CommTaskManager:
     @staticmethod
     def _default_handler(task: CommTask):
         import sys
-        print(f"[comm-watchdog] collective '{task.name}' (rank {task.rank}) "
+        # graftlint: disable-next-line — deliberate stderr on a probable
+        # hang: must not depend on user logging config
+        print(f"[comm-watchdog] collective "  # graftlint: disable=no-adhoc-telemetry
+              f"'{task.name}' (rank {task.rank}) "
               f"exceeded {task.timeout:.0f}s — probable hang. Issued from:\n"
               + "".join(task.stack), file=sys.stderr, flush=True)
 

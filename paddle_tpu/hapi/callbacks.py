@@ -77,22 +77,22 @@ class ProgBarLogger(Callback):
 
     def on_epoch_begin(self, epoch, logs=None):
         self._epoch = epoch
-        self._t0 = time.time()
+        self._t0 = time.perf_counter()
 
     def on_train_batch_end(self, step, logs=None):
         if self.verbose and step % self.log_freq == 0:
             logs = logs or {}
             items = " ".join(f"{k}: {v:.4f}" if isinstance(v, float) else
                              f"{k}: {v}" for k, v in logs.items())
-            print(f"Epoch {self._epoch + 1} step {step} {items}")
+            print(f"Epoch {self._epoch + 1} step {step} {items}")  # graftlint: disable=no-adhoc-telemetry
 
     def on_epoch_end(self, epoch, logs=None):
         if self.verbose:
             logs = logs or {}
             items = " ".join(f"{k}: {v:.4f}" if isinstance(v, float) else
                              f"{k}: {v}" for k, v in logs.items())
-            print(f"Epoch {epoch + 1} done ({time.time() - self._t0:.1f}s) "
-                  f"{items}")
+            print(f"Epoch {epoch + 1} done "  # graftlint: disable=no-adhoc-telemetry
+                  f"({time.perf_counter() - self._t0:.1f}s) {items}")
 
 
 class ModelCheckpoint(Callback):
@@ -178,5 +178,5 @@ class EarlyStopping(Callback):
             if self.wait > self.patience:
                 self.stop_training = True
                 if self.verbose:
-                    print(f"EarlyStopping: stop at epoch {epoch + 1} "
+                    print(f"EarlyStopping: stop at epoch {epoch + 1} "  # graftlint: disable=no-adhoc-telemetry
                           f"({self.monitor}={cur:.4f} best={self.best:.4f})")

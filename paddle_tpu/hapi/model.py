@@ -84,7 +84,7 @@ class Model:
                 m.reset()
             cbks.on_epoch_begin(epoch)
             epoch_losses = []
-            t0 = time.time()
+            t0 = time.perf_counter()
             for step, batch in enumerate(loader):
                 data, label = (batch[0], batch[1]) if isinstance(batch, (list, tuple)) \
                     and len(batch) >= 2 else (batch, None)
@@ -95,15 +95,15 @@ class Model:
                 it += 1
                 cbks.on_train_batch_end(step, {"loss": float(loss[0])})
                 if verbose and step % log_freq == 0:
-                    print(f"Epoch {epoch + 1}/{epochs} step {step} "
+                    print(f"Epoch {epoch + 1}/{epochs} step {step} "  # graftlint: disable=no-adhoc-telemetry
                           f"loss {loss[0]:.4f}")
                 if num_iters is not None and it >= num_iters:
                     break
             history.append(float(np.mean(epoch_losses)))
             cbks.on_epoch_end(epoch, {"loss": history[-1]})
             if verbose:
-                print(f"Epoch {epoch + 1}: mean loss {history[-1]:.4f} "
-                      f"({time.time() - t0:.1f}s)")
+                print(f"Epoch {epoch + 1}: mean loss {history[-1]:.4f} "  # graftlint: disable=no-adhoc-telemetry
+                      f"({time.perf_counter() - t0:.1f}s)")
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 self.evaluate(eval_data, batch_size=batch_size, verbose=verbose)
             if save_dir and (epoch + 1) % save_freq == 0:
@@ -133,7 +133,7 @@ class Model:
         for m in self._metrics:
             result[m.name() if isinstance(m.name(), str) else m.name()[0]] = m.accumulate()
         if verbose:
-            print("Eval:", result)
+            print("Eval:", result)  # graftlint: disable=no-adhoc-telemetry
         return result
 
     def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
@@ -199,7 +199,7 @@ def summary(net, input_size=None, dtypes=None, input=None):
     lines.append(f"Total params: {total_params:,}")
     lines.append(f"Trainable params: {trainable_params:,}")
     out = "\n".join(lines)
-    print(out)
+    print(out)  # graftlint: disable=no-adhoc-telemetry (summary() prints by contract)
     return {"total_params": total_params, "trainable_params": trainable_params}
 
 
@@ -216,5 +216,5 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
             k = _np.prod(layer._kernel_size)
             total += 2 * layer._in_channels * layer._out_channels * k
     if print_detail:
-        print(f"FLOPs (per spatial position / token): {total:,}")
+        print(f"FLOPs (per spatial position / token): {total:,}")  # graftlint: disable=no-adhoc-telemetry
     return total

@@ -51,9 +51,41 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import observability as _obs
+
 __all__ = ["LLMEngine", "Request"]
 
 _MAXK = 64        # static cap for per-slot dynamic top-k filtering
+
+
+class _EngineMetrics:
+    """Registry children bound once per engine (label ``engine=<seq>``).
+
+    Every mutation is a no-op while observability is disabled, so the engine
+    attributes (cache_hits, preemptions, ...) stay the always-on source of
+    truth and the registry mirrors them 1:1 whenever metrics are on — the
+    parity :meth:`LLMEngine.prefix_cache_stats` keeps by construction."""
+
+    def __init__(self, label):
+        e = {"engine": label}
+        self.label = label
+        self.ttft = _obs.SERVING_TTFT.labels(**e)
+        self.token_latency = _obs.SERVING_TOKEN_LATENCY.labels(**e)
+        self.queue_depth = _obs.SERVING_QUEUE_DEPTH.labels(**e)
+        self.active_slots = _obs.SERVING_ACTIVE_SLOTS.labels(**e)
+        self.occupancy = _obs.SERVING_OCCUPANCY.labels(**e)
+        self.prefill = _obs.SERVING_DISPATCHES.labels(kind="prefill", **e)
+        self.decode = _obs.SERVING_DISPATCHES.labels(kind="decode", **e)
+        self.tokens = _obs.SERVING_TOKENS.labels(**e)
+        self.preempt = _obs.SERVING_PREEMPTIONS.labels(**e)
+        self.hits = _obs.SERVING_CACHE_EVENTS.labels(event="hit", **e)
+        self.misses = _obs.SERVING_CACHE_EVENTS.labels(event="miss", **e)
+        self.evictions = _obs.SERVING_CACHE_EVENTS.labels(event="eviction",
+                                                          **e)
+        self.cow = _obs.SERVING_CACHE_EVENTS.labels(event="cow_copy", **e)
+        self.cached_pages = _obs.SERVING_CACHED_PAGES.labels(**e)
+        self.reclaimable = _obs.SERVING_RECLAIMABLE_PAGES.labels(**e)
+        self.free_pages = _obs.SERVING_FREE_PAGES.labels(**e)
 
 
 class Request:
@@ -136,6 +168,8 @@ def _sample_row(logits, greedy, temp, topp, topk, seed):
 
 class LLMEngine:
     """Continuous-batching paged-KV engine over a LlamaForCausalLM."""
+
+    _engine_seq = 0   # observability label: one series set per engine
 
     def __init__(self, model, mesh=None, mp_axis="mp", pp_axis="pp",
                  max_batch=4, max_len=256, page_size=16, prefill_chunk=32,
@@ -303,6 +337,8 @@ class LLMEngine:
         else:
             self.decode_block = max(1, int(decode_block))
         self._decode_programs: dict = {}
+        self._m = _EngineMetrics(str(LLMEngine._engine_seq))
+        LLMEngine._engine_seq += 1
         self._prefill = self._build_prefill()
 
     # ---------------------------------------------------------------- layers
@@ -508,6 +544,7 @@ class LLMEngine:
             p, _ = self._lru.popitem(last=False)
             self._key_page.pop(self._page_key.pop(p), None)
             self.cache_evictions += 1
+            self._m.evictions.inc()
         else:
             return None
         self._page_ref[p] = 1
@@ -523,6 +560,7 @@ class LLMEngine:
         self.cache = self._copy_page_fn(
             self.cache, jnp.asarray(np.int32(src)), jnp.asarray(np.int32(dst)))
         self.cache_cow_copies += 1
+        self._m.cow.inc()
 
     def _cow_unshare(self, slot, start, n):
         """Copy-on-write before a prefill write into [start, start+n): any
@@ -603,6 +641,8 @@ class LLMEngine:
             skip = min(len(hits) * self.page, len(r.prompt) - 1)
             self.cache_hits += len(hits)
             self.cache_misses += len(keys) - len(hits)
+            self._m.hits.inc(len(hits))
+            self._m.misses.inc(len(keys) - len(hits))
             r.cache_keys = keys
             r.cached_tokens = skip
             r.pos = skip
@@ -641,6 +681,7 @@ class LLMEngine:
         r.slot = None
         self._waiting.appendleft(r)
         self.preemptions += 1
+        self._m.preempt.inc()
         return True
 
     def _ensure_page(self, slot, ahead=1):
@@ -670,8 +711,10 @@ class LLMEngine:
         """Record one generated token; release the slot when finished."""
         r = self._slots[slot]
         r.out.append(int(token))
+        self._m.tokens.inc()
         if r.ttft is None:
             r.ttft = time.perf_counter() - r.t_submit
+            self._m.ttft.observe(r.ttft)
         hit_eos = (r.eos is not None and r.out[-1] == r.eos)
         if (len(r.out) >= r.max_new or hit_eos
                 or int(self._lens[slot]) >= self.max_len):
@@ -691,16 +734,18 @@ class LLMEngine:
         finishes = (start + n) == len(r.prompt)
         r.prefill_dispatches += 1
         self.prefill_dispatches += 1
-        nxt, self.cache = self._prefill(
-            self.W, self.cache, jnp.asarray(toks),
-            jnp.asarray(np.int32(start)),
-            jnp.asarray(self._slot_tables[slot]),
-            jnp.asarray(np.int32(n)),
-            jnp.asarray(np.int32(0 if r.do_sample else 1)),
-            jnp.asarray(np.float32(r.temperature)),
-            jnp.asarray(np.float32(r.top_p)),
-            jnp.asarray(np.int32(r.top_k)),
-            jnp.asarray(np.int32(self._next_seed(r))))
+        self._m.prefill.inc()
+        with _obs.trace_span("serving.prefill"):
+            nxt, self.cache = self._prefill(
+                self.W, self.cache, jnp.asarray(toks),
+                jnp.asarray(np.int32(start)),
+                jnp.asarray(self._slot_tables[slot]),
+                jnp.asarray(np.int32(n)),
+                jnp.asarray(np.int32(0 if r.do_sample else 1)),
+                jnp.asarray(np.float32(r.temperature)),
+                jnp.asarray(np.float32(r.top_p)),
+                jnp.asarray(np.int32(r.top_k)),
+                jnp.asarray(np.int32(self._next_seed(r))))
         r.pos += n
         self._lens[slot] = start + n
         if self.prefix_cache:
@@ -713,6 +758,8 @@ class LLMEngine:
         else one decode token for every active slot. Returns #slots
         served."""
         self._admit()
+        if _obs.enabled():
+            self._refresh_gauges()
         for slot, r in enumerate(self._slots):
             if r is not None and r.pos < len(r.prompt):
                 self._prefill_chunk(slot)
@@ -759,17 +806,25 @@ class LLMEngine:
         compile_call = prog is None
         if compile_call:
             prog = self._decode_programs[k] = self._build_decode(k)
+        self._m.decode.inc()
         t0 = time.perf_counter()
-        toks, self.cache = prog(
-            self.W, self.cache, jnp.asarray(tokens),
-            jnp.asarray(self._lens), jnp.asarray(self._slot_tables),
-            jnp.asarray(active), jnp.asarray(greedy), jnp.asarray(temp),
-            jnp.asarray(topp), jnp.asarray(topk), jnp.asarray(seeds),
-            jnp.asarray(fold))
-        toks = np.asarray(toks)                          # [k, B]
+        with _obs.trace_span("serving.decode"):
+            toks, self.cache = prog(
+                self.W, self.cache, jnp.asarray(tokens),
+                jnp.asarray(self._lens), jnp.asarray(self._slot_tables),
+                jnp.asarray(active), jnp.asarray(greedy), jnp.asarray(temp),
+                jnp.asarray(topp), jnp.asarray(topk), jnp.asarray(seeds),
+                jnp.asarray(fold))
+            toks = np.asarray(toks)                      # [k, B]
+        dt = time.perf_counter() - t0
         if self._auto_block and not compile_call:
             # host sync above makes the wall time a true dispatch sample
-            self._record_block_sample(k, time.perf_counter() - t0)
+            self._record_block_sample(k, dt)
+        if not compile_call and _obs.enabled():
+            # dispatch served k tokens for each live slot; exclude the
+            # compile call so the histogram reflects steady-state latency
+            for _ in live:
+                self._m.token_latency.observe(dt / k)
         for j in range(k):
             for slot, r in live:
                 if self._slots[slot] is not r:           # released mid-block
@@ -824,9 +879,34 @@ class LLMEngine:
             steps += 1
         return steps
 
+    def _refresh_gauges(self):
+        """Mirror instantaneous engine state into the registry gauges."""
+        n_active = sum(1 for s in self._slots if s is not None)
+        self._m.queue_depth.set(len(self._waiting))
+        self._m.active_slots.set(n_active)
+        self._m.occupancy.set(n_active / self.max_batch)
+        self._m.cached_pages.set(len(self._key_page))
+        self._m.reclaimable.set(len(self._lru))
+        self._m.free_pages.set(len(self._free_pages))
+
+    def metrics(self):
+        """This engine's telemetry series from the process-wide registry.
+
+        Values accumulate only while ``paddle_tpu.observability.enable()``
+        is on; :meth:`prefix_cache_stats` stays the always-on plain-dict
+        view of the same counters."""
+        if _obs.enabled():
+            self._refresh_gauges()
+        return _obs.snapshot(prefix="serving_",
+                             labels={"engine": self._m.label})
+
     def prefix_cache_stats(self):
         """Counters for the automatic prefix cache (all zero when the
-        `prefix_cache` knob is off)."""
+        `prefix_cache` knob is off).
+
+        The same counters are exported through the observability registry
+        (``serving_prefix_cache_events_total{engine=...}``); this dict is
+        the always-on thin compatibility view."""
         return {
             "hits": self.cache_hits,
             "misses": self.cache_misses,

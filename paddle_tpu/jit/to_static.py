@@ -79,6 +79,7 @@ import jax
 
 from ..core.tensor import Tensor
 from ..core.dispatch import _state
+from .. import observability as _obs
 
 logger = logging.getLogger("paddle_tpu.jit")
 
@@ -442,6 +443,7 @@ class StaticFunction:
         self._cache: dict[str, _SigGroup] = {}
         self._spy_attempts: dict[str, int] = {}
         self._donate = donate_state
+        self._obs_fn = getattr(function, "__name__", "?")
         try:
             functools.update_wrapper(self, function)
         except AttributeError:
@@ -465,6 +467,7 @@ class StaticFunction:
         if group is None:
             return self._spy(key, leaves, treedef)
         if group.eager_only:
+            _obs.JIT_EVENTS.inc(event="eager_call", fn=self._obs_fn)
             return self._fn(*args, **kwargs)
         entry = group.last if group.last is not None else group.variants[0]
         tried: set[int] = set()
@@ -483,11 +486,13 @@ class StaticFunction:
                     "signature eager-only. Hoist the break-dependent branch "
                     "out of the step (or use bool()/int(), which "
                     "re-specialize).", e)
+                _obs.JIT_EVENTS.inc(event="echo_mismatch", fn=self._obs_fn)
                 group.eager_only = True
                 args, kwargs = jax.tree_util.tree_unflatten(treedef, leaves)
                 return self._fn(*args, **kwargs)
             except MissedCapture:
                 logger.warning("to_static: capture miss; re-tracing")
+                _obs.JIT_EVENTS.inc(event="retrace", fn=self._obs_fn)
                 group.variants = [v for v in group.variants if v is not entry]
                 group.last = None
                 if not group.variants:
@@ -495,6 +500,7 @@ class StaticFunction:
                 return self._spy(key, leaves, treedef)
             if actual is None or actual == entry.guard_ints:
                 group.last = entry
+                _obs.JIT_EVENTS.inc(event="cache_hit", fn=self._obs_fn)
                 return result
             # guard divergence: this step took a different branch. The actual
             # guard values are trustworthy only up to (and including) the
@@ -508,11 +514,14 @@ class StaticFunction:
             if nxt is None:
                 logger.info("to_static: guard divergence at #%d; specializing "
                             "a new variant", k)
+                _obs.JIT_EVENTS.inc(event="guard_divergence",
+                                    fn=self._obs_fn)
                 return self._spy(key, leaves, treedef)
             entry = nxt
 
     # ---- pass 1: eager spy ---------------------------------------------------
     def _spy(self, key, leaves, treedef):
+        _obs.JIT_EVENTS.inc(event="capture", fn=self._obs_fn)
         group = self._cache.get(key)
         if group is None:
             group = self._cache[key] = _SigGroup()
@@ -686,8 +695,9 @@ class StaticFunction:
         # jaxpr (make_jaxpr instead of a second eval_shape pass)
         from . import _code_level_value
         if _code_level_value() > 0:
-            print(jax.make_jaxpr(pure_fn)(arg_arrays, mut_arrays, ro_arrays,
-                                          grad_in_arrays))
+            print(  # graftlint: disable=no-adhoc-telemetry (code_level dump)
+                jax.make_jaxpr(pure_fn)(arg_arrays, mut_arrays, ro_arrays,
+                                        grad_in_arrays))
         else:
             jax.eval_shape(pure_fn, arg_arrays, mut_arrays, ro_arrays,
                            grad_in_arrays)
@@ -800,13 +810,16 @@ class ScanStaticFunction(StaticFunction):
         if group is None:
             return self._spy_scan(key, leaves, treedef, k)
         if group.eager_only:
+            _obs.JIT_EVENTS.inc(event="eager_call", fn=self._obs_fn)
             return self._eager_scan(leaves, treedef, k)
         entry = group.variants[0]
         try:
             result, _ = self._run(entry, leaves)
+            _obs.JIT_EVENTS.inc(event="cache_hit", fn=self._obs_fn)
             return result
         except MissedCapture:
             logger.warning("to_static[scan]: capture miss; re-tracing")
+            _obs.JIT_EVENTS.inc(event="retrace", fn=self._obs_fn)
             group.variants = [v for v in group.variants if v is not entry]
             group.last = None
             if not group.variants:
@@ -975,8 +988,9 @@ class ScanStaticFunction(StaticFunction):
         try:
             from . import _code_level_value
             if _code_level_value() > 0:
-                print(jax.make_jaxpr(scan_fn)(stacked_shapes, state_shapes,
-                                              ro_shapes))
+                print(  # graftlint: disable=no-adhoc-telemetry (code_level dump)
+                    jax.make_jaxpr(scan_fn)(stacked_shapes, state_shapes,
+                                            ro_shapes))
             else:
                 jax.eval_shape(scan_fn, stacked_shapes, state_shapes,
                                ro_shapes)

@@ -1,0 +1,187 @@
+"""paddle_tpu.observability — unified runtime telemetry.
+
+The reference ships a whole observability layer (python/paddle/profiler/
+profiler.py:358: chrome-trace export, operator/memory summaries); this package
+is its serving-era counterpart: ONE process-wide metrics registry plus span
+tracing, threaded through dispatch, jit capture, the serving engine, and the
+collective plane.
+
+Usage::
+
+    from paddle_tpu import observability as obs
+
+    obs.enable()                      # flips the process-wide switch AND
+                                      # installs the dispatch recorder
+    ... run work ...
+    snap = obs.snapshot()             # JSON-able dict
+    text = obs.render_prometheus()    # Prometheus text exposition
+    with obs.trace_span("my.phase"):  # TraceAnnotation + chrome-trace event
+        ...
+    obs.disable()
+
+Cost model: disabled (the default), every instrumented call site pays one
+global-bool check; the op-dispatch hot path pays nothing at all because
+``enable()``/``disable()`` install/remove the recorder in core.dispatch's
+single instrumentation slot (``bench.py``'s serving extra measures the
+enabled-vs-disabled decode throughput to keep this claim honest).
+
+Standard metric families are declared here, in one place, so instrumented
+modules share names and label schemas instead of inventing their own.
+"""
+from __future__ import annotations
+
+from . import registry as _registry
+from .registry import (DEFAULT_BUCKETS, REGISTRY, MetricsRegistry,  # noqa: F401
+                       enabled)
+from .tracing import SPAN_SECONDS, trace_span  # noqa: F401
+
+__all__ = [
+    "MetricsRegistry", "REGISTRY", "DEFAULT_BUCKETS",
+    "enable", "disable", "enabled", "reset",
+    "snapshot", "render_prometheus", "trace_span", "record_collective",
+]
+
+# ---- standard families -------------------------------------------------------
+# dispatch (core/dispatch.py, fed through the op_recorder slot)
+DISPATCH_OPS = REGISTRY.counter(
+    "dispatch_ops_total", "ops dispatched through apply_op", ("op",))
+DISPATCH_AUTOCAST = REGISTRY.counter(
+    "dispatch_autocast_total", "dispatches with AMP autocast active")
+DISPATCH_TAPED = REGISTRY.counter(
+    "dispatch_taped_total", "dispatches that recorded a vjp tape node")
+DISPATCH_LIFTS = REGISTRY.counter(
+    "dispatch_trace_lifted_total",
+    "dispatches under an active trace context (program-capture lifts)")
+DISPATCH_SECONDS = REGISTRY.histogram(
+    "dispatch_host_seconds", "host wall time per op dispatch")
+
+# jit program capture (jit/to_static.py)
+JIT_EVENTS = REGISTRY.counter(
+    "jit_events_total",
+    "to_static lifecycle events (capture/cache_hit/retrace/"
+    "guard_divergence/eager_call/echo_mismatch)", ("event", "fn"))
+
+# serving engine (inference/serving.py); one label per engine instance
+SERVING_TTFT = REGISTRY.histogram(
+    "serving_ttft_seconds", "submit-to-first-token latency", ("engine",))
+SERVING_TOKEN_LATENCY = REGISTRY.histogram(
+    "serving_token_latency_seconds",
+    "per-token decode latency (dispatch wall / block size)", ("engine",))
+SERVING_QUEUE_DEPTH = REGISTRY.gauge(
+    "serving_queue_depth", "requests waiting for admission", ("engine",))
+SERVING_ACTIVE_SLOTS = REGISTRY.gauge(
+    "serving_active_slots", "slots holding an admitted request", ("engine",))
+SERVING_OCCUPANCY = REGISTRY.gauge(
+    "serving_batch_occupancy_ratio", "active slots / max_batch", ("engine",))
+SERVING_DISPATCHES = REGISTRY.counter(
+    "serving_dispatches_total", "engine programs dispatched",
+    ("engine", "kind"))                        # kind: prefill | decode
+SERVING_TOKENS = REGISTRY.counter(
+    "serving_generated_tokens_total", "tokens emitted to requests",
+    ("engine",))
+SERVING_PREEMPTIONS = REGISTRY.counter(
+    "serving_preemptions_total", "slots preempted back to the queue",
+    ("engine",))
+SERVING_CACHE_EVENTS = REGISTRY.counter(
+    "serving_prefix_cache_events_total",
+    "prefix-cache page events (hit/miss/eviction/cow_copy)",
+    ("engine", "event"))
+SERVING_CACHED_PAGES = REGISTRY.gauge(
+    "serving_prefix_cached_pages", "pages registered in the prefix index",
+    ("engine",))
+SERVING_RECLAIMABLE_PAGES = REGISTRY.gauge(
+    "serving_prefix_reclaimable_pages",
+    "cached-but-unreferenced pages parked in the LRU", ("engine",))
+SERVING_FREE_PAGES = REGISTRY.gauge(
+    "serving_free_pages", "pages on the free list", ("engine",))
+
+# collective plane (distributed/collective.py + parallel/ layers)
+COLLECTIVE_CALLS = REGISTRY.counter(
+    "collective_invocations_total",
+    "explicit eager collectives invoked", ("collective",))
+COLLECTIVE_BYTES = REGISTRY.counter(
+    "collective_payload_bytes_total",
+    "payload bytes moved by explicit eager collectives", ("collective",))
+COLLECTIVE_TRACED = REGISTRY.counter(
+    "collective_traced_total",
+    "in-mesh collectives captured at trace time (ticks once per compiled "
+    "program, not per device execution)", ("collective",))
+COLLECTIVE_TRACED_BYTES = REGISTRY.counter(
+    "collective_traced_payload_bytes_total",
+    "per-shard payload bytes of traced in-mesh collectives", ("collective",))
+
+
+# ---- dispatch recorder -------------------------------------------------------
+class _DispatchRecorder:
+    """Lives in core.dispatch's single ``op_recorder`` slot while metrics are
+    on (composed with the profiler's HostOpRecorder when both are active), so
+    apply_op keeps exactly one instrumentation branch."""
+
+    __slots__ = ()
+
+    def record(self, name, dt, amp=False, taped=False, lifted=False):
+        DISPATCH_OPS.inc(op=name)
+        DISPATCH_SECONDS.observe(dt)
+        if amp:
+            DISPATCH_AUTOCAST.inc()
+        if taped:
+            DISPATCH_TAPED.inc()
+        if lifted:
+            DISPATCH_LIFTS.inc()
+
+
+_DISPATCH_RECORDER = _DispatchRecorder()
+
+
+def enable() -> None:
+    """Flip the process-wide telemetry switch on and install the dispatch
+    recorder (threads pick it up on their next dispatch-state access)."""
+    from ..core import dispatch as _dispatch
+    _registry._set_enabled(True)
+    _dispatch.set_metrics_recorder(_DISPATCH_RECORDER)
+
+
+def disable() -> None:
+    """Switch telemetry off; dispatch returns to its zero-cost fast path."""
+    from ..core import dispatch as _dispatch
+    _dispatch.set_metrics_recorder(None)
+    _registry._set_enabled(False)
+
+
+def reset() -> None:
+    """Zero every series in place (bound children stay valid); the
+    enable/disable switch is left untouched."""
+    REGISTRY.reset()
+
+
+def snapshot(prefix=None, labels=None) -> dict:
+    """JSON-able dump of the default registry (see
+    :meth:`MetricsRegistry.snapshot` for the filters)."""
+    return REGISTRY.snapshot(prefix=prefix, labels=labels)
+
+
+def render_prometheus() -> str:
+    """Prometheus text exposition of the default registry."""
+    return REGISTRY.render_prometheus()
+
+
+def record_collective(name, payload=None, traced=True, nbytes=None) -> None:
+    """Count one collective invocation, with payload bytes when derivable.
+
+    traced=True: the call site sits inside a traced program (shard_map body),
+    so the count ticks once per trace and bytes are the per-shard aval size.
+    ``payload`` may be an array/tracer (bytes from size*itemsize) or None;
+    pass ``nbytes`` to override.
+    """
+    if not _registry._ENABLED:
+        return
+    calls, by = ((COLLECTIVE_TRACED, COLLECTIVE_TRACED_BYTES) if traced
+                 else (COLLECTIVE_CALLS, COLLECTIVE_BYTES))
+    calls.inc(collective=name)
+    if nbytes is None and payload is not None:
+        try:
+            nbytes = int(payload.size) * payload.dtype.itemsize
+        except (AttributeError, TypeError):
+            nbytes = None
+    if nbytes:
+        by.inc(int(nbytes), collective=name)

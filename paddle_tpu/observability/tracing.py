@@ -1,0 +1,42 @@
+"""Span-scoped tracing bridging the metrics registry and the profiler.
+
+One ``trace_span(name)`` emits, while observability is enabled:
+
+1. a ``jax.profiler.TraceAnnotation`` — the span shows up in the XPlane /
+   TensorBoard / Perfetto timeline whenever a device trace is recording,
+2. a host-side event in ``paddle_tpu.profiler._host_events`` — the span rides
+   the existing ``Profiler.export()`` chrome-trace path and the
+   ``summary()`` user-event table with no extra plumbing, and
+3. an observation in the ``span_seconds`` histogram (label ``span=<name>``).
+
+Disabled, a span costs one flag check and a no-op context manager.
+"""
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+from . import registry as _registry
+
+SPAN_SECONDS = _registry.REGISTRY.histogram(
+    "span_seconds", "wall time inside trace_span scopes", ("span",))
+
+
+@contextmanager
+def trace_span(name: str):
+    """Time a scope into the registry, the profiler, and the device trace."""
+    if not _registry._ENABLED:
+        yield
+        return
+    import jax
+    from ..profiler import _host_events
+    ann = jax.profiler.TraceAnnotation(name)
+    ann.__enter__()
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        ann.__exit__(None, None, None)
+        _host_events[name].append(dt)
+        SPAN_SECONDS.labels(span=name).observe(dt)

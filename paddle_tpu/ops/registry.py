@@ -1130,7 +1130,7 @@ def coverage_report(verbose=False):
     }
     if verbose:
         import json
-        print(json.dumps(report, indent=2, sort_keys=True))
+        print(json.dumps(report, indent=2, sort_keys=True))  # graftlint: disable=no-adhoc-telemetry
     return report
 
 

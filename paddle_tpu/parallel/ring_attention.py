@@ -24,6 +24,7 @@ except ImportError:                      # older jax: experimental namespace,
 
 from ..core.tensor import Tensor
 from ..core.dispatch import apply_op
+from ..distributed.collective import mesh_ppermute
 from ..distributed.fleet.topology import get_hybrid_communicate_group
 
 
@@ -76,9 +77,9 @@ def _ring_attn_local(q, k, v, axis_name, causal, scale):
     for step in range(n):
         carry = attend(carry, ((kb, vb), src))
         if step < n - 1:
-            kb = jax.lax.ppermute(kb, axis_name, perm)
-            vb = jax.lax.ppermute(vb, axis_name, perm)
-            src = jax.lax.ppermute(src, axis_name, perm)
+            kb = mesh_ppermute(kb, axis_name, perm)
+            vb = mesh_ppermute(vb, axis_name, perm)
+            src = mesh_ppermute(src, axis_name, perm)
     m, l, acc = carry
     out = acc / jnp.maximum(l, 1e-20)[..., None]
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)

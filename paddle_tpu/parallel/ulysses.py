@@ -28,6 +28,7 @@ except ImportError:                      # older jax: experimental namespace,
         return _shard_map_experimental(f, **kw)
 
 from ..core.dispatch import apply_op
+from ..distributed.collective import mesh_all_to_all
 from ..distributed.fleet.topology import get_hybrid_communicate_group
 
 __all__ = ["ulysses_attention"]
@@ -41,13 +42,11 @@ def _ulysses_local(q, k, v, axis_name, causal, scale):
         # [B, s, H, D] -> [B, s*n, H/n, D]: tiled all_to_all splits the head
         # axis into n chunks (chunk i -> rank i) and concatenates received
         # seq chunks in rank order — global sequence order, rank-major heads
-        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
-                                  tiled=True)
+        return mesh_all_to_all(x, axis_name, split_axis=2, concat_axis=1)
 
     def heads_to_seq(x):
         # [B, S, H/n, D] -> [B, S/n, H, D]: exact inverse
-        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
-                                  tiled=True)
+        return mesh_all_to_all(x, axis_name, split_axis=1, concat_axis=2)
 
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     # full-sequence attention on the local head group, BLOCKWISE over K with

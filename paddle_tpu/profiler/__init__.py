@@ -8,12 +8,16 @@ collected in-process for summary() tables.
 from __future__ import annotations
 
 import contextlib
+import glob
+import logging
 import os
 import time
 from collections import defaultdict
 from enum import Enum
 
 import jax
+
+logger = logging.getLogger("paddle_tpu.profiler")
 
 
 class ProfilerTarget(Enum):
@@ -67,7 +71,25 @@ def export_chrome_tracing(dir_name, worker_name=None):
 
 
 def export_protobuf(dir_name, worker_name=None):
-    return export_chrome_tracing(dir_name, worker_name)
+    """on_trace_ready handler selecting the XPlane/protobuf export path.
+
+    jax.profiler natively writes its device trace as an XPlane protobuf
+    (``plugins/profile/<run>/*.xplane.pb`` under the trace dir) while
+    recording, so protobuf export means: resolve the newest ``.xplane.pb``
+    from the trace dir in :meth:`Profiler.export` instead of writing the
+    chrome-trace JSON. Like ``export_chrome_tracing``, ``dir_name`` becomes
+    the trace dir of the NEXT ``start()`` (the current trace already picked
+    its dir at start time).
+
+    Documented fallback (previously this silently aliased
+    ``export_chrome_tracing``): with ``timer_only=True``, or when the
+    backend wrote no xplane dump, there is no protobuf to resolve —
+    ``export()`` logs the downgrade and falls back to chrome-trace JSON.
+    """
+    def handler(prof):
+        prof._export_dir = dir_name
+        prof._export_format = "protobuf"
+    return handler
 
 
 class RecordEvent:
@@ -120,6 +142,7 @@ class Profiler:
         self._step_times = []
         self._last_step_t = None
         self._op_recorder = None
+        self._export_format = "json"
 
     def start(self):
         self._dir = self._export_dir or os.path.join("/tmp", "paddle_tpu_profile")
@@ -127,15 +150,18 @@ class Profiler:
             jax.profiler.start_trace(self._dir)
             self._active = True
         from .statistic import HostOpRecorder
-        from ..core.dispatch import _state
+        from ..core.dispatch import _state, compose_recorders, metrics_recorder
         self._op_recorder = HostOpRecorder()
-        _state.op_recorder = self._op_recorder
+        # stack onto the observability recorder (if metrics are enabled) so
+        # dispatch keeps its single instrumentation branch
+        _state.op_recorder = compose_recorders(self._op_recorder,
+                                               metrics_recorder())
         self._last_step_t = time.perf_counter()
         return self
 
     def stop(self):
-        from ..core.dispatch import _state
-        _state.op_recorder = None
+        from ..core.dispatch import _state, metrics_recorder
+        _state.op_recorder = metrics_recorder()
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
@@ -187,13 +213,35 @@ class Profiler:
                     f"total={arr.sum()*1000:10.3f}ms "
                     f"avg={arr.mean()*1000:8.3f}ms")
         out = "\n".join(lines)
-        print(out)
+        print(out)  # graftlint: disable=no-adhoc-telemetry
         return out
 
-    def export(self, path=None, format="json"):
+    def _latest_xplane(self):
+        """Newest .xplane.pb the jax profiler wrote under the trace dir."""
+        if not self._dir:
+            return None
+        files = sorted(glob.glob(os.path.join(
+            self._dir, "plugins", "profile", "*", "*.xplane.pb")))
+        return files[-1] if files else None
+
+    def export(self, path=None, format=None):
         """Write host events + step times as a chrome-trace JSON; the XLA
         XPlane dump (TensorBoard/Perfetto) lives in self._dir. Returns the
-        written path (reference: profiler.py export)."""
+        written path (reference: profiler.py export).
+
+        format="protobuf" (or an ``export_protobuf`` on_trace_ready handler)
+        resolves the XPlane protobuf jax wrote instead; when none exists
+        (timer_only, or the backend produced no dump) the documented
+        fallback is this chrome-trace JSON path."""
+        fmt = format or self._export_format
+        if fmt == "protobuf":
+            pb = self._latest_xplane()
+            if pb is not None:
+                return pb
+            logger.warning(
+                "export_protobuf: no .xplane.pb under %r (timer_only run, "
+                "or the backend wrote no device trace); falling back to "
+                "chrome-trace JSON", self._dir)
         if path is None:
             return self._dir
         import json
@@ -246,7 +294,7 @@ class ProfilerResult:
             lines.append(f"{name:40s} calls={s['calls']:6d} "
                          f"total={s['total_s']*1000:10.3f}ms")
         out = "\n".join(lines)
-        print(out)
+        print(out)  # graftlint: disable=no-adhoc-telemetry
         return out
 
 

@@ -81,7 +81,9 @@ class HostOpRecorder:
     def __init__(self):
         self.ops: dict[str, list] = defaultdict(lambda: [0, 0.0, 0.0, 1e30])
 
-    def record(self, name, dt):
+    def record(self, name, dt, **_):
+        # extra dispatch facts (amp/taped/lifted) belong to the metrics
+        # recorder; this table only aggregates host wall time
         e = self.ops[name]
         e[0] += 1
         e[1] += dt

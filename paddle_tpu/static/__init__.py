@@ -426,7 +426,8 @@ def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
     import numpy as _np
     arr = _np.asarray(input._data) if hasattr(input, "_data") else _np.asarray(input)
     prefix = (message + " ") if message else ""
-    print(f"{prefix}{'Tensor' if print_tensor_name else ''} "
+    print(  # graftlint: disable=no-adhoc-telemetry (static.Print IS a print op)
+        f"{prefix}{'Tensor' if print_tensor_name else ''} "
           f"shape={list(arr.shape) if print_tensor_shape else '...'} "
           f"values={arr.reshape(-1)[:summarize]}")
     return input

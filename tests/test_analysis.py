@@ -264,6 +264,62 @@ def test_jit_cache_hygiene_clean(tmp_path):
     assert res.findings == []
 
 
+# ---------------------------------------------------------- no-adhoc-telemetry
+
+AT_BAD = """
+    import time
+    from time import time as walltime
+
+
+    def work():
+        t0 = time.time()
+        print("starting work")
+        elapsed = time.time() - t0
+        return elapsed + walltime()
+"""
+
+AT_CLEAN = """
+    import logging
+    import time
+
+    logger = logging.getLogger(__name__)
+
+
+    def work(timer=None):
+        t0 = time.perf_counter()
+        logger.info("starting work")
+        deadline = time.monotonic() + 5.0
+        timer.time()          # method named `time` on another object: fine
+        return time.perf_counter() - t0, deadline
+"""
+
+
+def test_no_adhoc_telemetry_catches_seeded_violations(tmp_path):
+    res = _lint(tmp_path, AT_BAD, select=["no-adhoc-telemetry"])
+    assert _codes(res) == {"AT101", "AT102"}
+    # three wall-clock reads: two time.time() plus the renamed from-import
+    assert sum(f.code == "AT102" for f in res.findings) == 3
+    assert sum(f.code == "AT101" for f in res.findings) == 1
+
+
+def test_no_adhoc_telemetry_clean_idioms_not_flagged(tmp_path):
+    res = _lint(tmp_path, AT_CLEAN, select=["no-adhoc-telemetry"])
+    assert res.findings == []
+
+
+def test_no_adhoc_telemetry_line_pragma(tmp_path):
+    src = """
+        import time
+
+
+        def show():
+            print("hi")  # graftlint: disable=no-adhoc-telemetry
+            return time.time()  # graftlint: disable=no-adhoc-telemetry
+    """
+    res = _lint(tmp_path, src, select=["no-adhoc-telemetry"])
+    assert res.findings == [] and res.suppressed == 2
+
+
 # ----------------------------------------------------- framework: pragmas etc.
 
 def test_line_pragma_suppresses(tmp_path):
@@ -323,9 +379,9 @@ def test_finding_dict_round_trip():
     assert Finding.from_dict(f.to_dict()) == f
 
 
-def test_all_four_passes_registered():
+def test_builtin_passes_registered():
     assert {"trace-safety", "registry-parity", "namespace-parity",
-            "jit-cache-hygiene"} <= set(PASSES)
+            "jit-cache-hygiene", "no-adhoc-telemetry"} <= set(PASSES)
 
 
 def test_unknown_pass_rejected(tmp_path):

@@ -1,0 +1,456 @@
+"""Unified runtime telemetry (ISSUE 4): metrics registry semantics, span
+tracing, profiler scheduler/export edge cases, and the serving-engine
+instrumentation — including parity between ``prefix_cache_stats()`` and the
+registry after a real cached-serve run.
+
+The registry is process-global; every test that flips the switch uses the
+``metrics`` fixture so the suite always leaves telemetry disabled and the
+series zeroed (reset keeps bound children valid by design).
+"""
+import os
+import re
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu.observability.registry import REGISTRY
+
+
+@pytest.fixture
+def metrics():
+    obs.reset()
+    obs.enable()
+    yield obs
+    obs.disable()
+    obs.reset()
+
+
+# ------------------------------------------------------------------- registry
+
+class TestRegistry:
+    def test_disabled_mutations_are_noops(self):
+        c = REGISTRY.counter("test_noop_total", "t")
+        obs.disable()
+        c.inc()
+        c.labels().inc(5)
+        assert c.labels().value == 0.0
+
+    def test_counter_accumulates_and_rejects_negative(self, metrics):
+        c = REGISTRY.counter("test_counter_total", "t")
+        c.inc()
+        c.inc(2)
+        assert c.labels().value == 3.0
+        with pytest.raises(ValueError):
+            c.labels().inc(-1)
+
+    def test_label_set_isolation(self, metrics):
+        c = REGISTRY.counter("test_labels_total", "t", ("op", "kind"))
+        c.inc(op="add", kind="a")
+        c.inc(3, op="add", kind="b")
+        c.inc(op="mul", kind="a")
+        assert c.labels(op="add", kind="a").value == 1.0
+        assert c.labels(op="add", kind="b").value == 3.0
+        assert c.labels(op="mul", kind="a").value == 1.0
+        # children are memoized: same label values -> same object
+        assert c.labels(op="add", kind="a") is c.labels(op="add", kind="a")
+        with pytest.raises(ValueError):
+            c.labels(op="add")                      # missing label
+        with pytest.raises(ValueError):
+            REGISTRY.gauge("test_labels_total")     # re-register as gauge
+
+    def test_gauge_set_inc_dec(self, metrics):
+        g = REGISTRY.gauge("test_gauge", "t")
+        g.set(7)
+        g.labels().inc(2)
+        g.labels().dec()
+        assert g.labels().value == 8.0
+
+    def test_histogram_bucket_boundaries_le_inclusive(self, metrics):
+        h = REGISTRY.histogram("test_hist_seconds", "t", buckets=(1.0, 2.0, 5.0))
+        child = h.labels()
+        for v in (0.5, 1.0, 1.5, 2.0, 2.5, 100.0):
+            child.observe(v)
+        d = child._data()
+        # exact bound values land in their own bucket (le is inclusive)
+        assert d["buckets"] == {"1": 2, "2": 2, "5": 1, "+Inf": 1}
+        assert d["count"] == 6
+        assert d["sum"] == pytest.approx(107.5)
+
+    def test_histogram_rejects_bad_buckets(self):
+        with pytest.raises(ValueError):
+            REGISTRY.histogram("test_bad_hist", "t", buckets=(1.0, 1.0))
+        with pytest.raises(ValueError):
+            REGISTRY.histogram("test_empty_hist", "t", buckets=())
+
+    def test_concurrent_increments_from_threads(self, metrics):
+        c = REGISTRY.counter("test_threads_total", "t")
+        child = c.labels()
+        N, M = 8, 2000
+
+        def work():
+            for _ in range(M):
+                child.inc()
+
+        threads = [threading.Thread(target=work) for _ in range(N)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert child.value == N * M
+
+    def test_reset_keeps_bound_children_valid(self, metrics):
+        c = REGISTRY.counter("test_reset_total", "t")
+        child = c.labels()
+        child.inc(4)
+        obs.reset()
+        assert child.value == 0.0
+        child.inc()                      # the same handle still feeds the family
+        assert c.labels().value == 1.0
+
+    def test_snapshot_filters(self, metrics):
+        c = REGISTRY.counter("test_snap_total", "t", ("engine",))
+        c.inc(engine="0")
+        c.inc(engine="1")
+        snap = obs.snapshot(prefix="test_snap", labels={"engine": "1"})
+        assert list(snap) == ["test_snap_total"]
+        assert snap["test_snap_total"]["series"] == [
+            {"labels": {"engine": "1"}, "value": 1.0}]
+        assert "test_snap_total" not in obs.snapshot(prefix="serving_")
+
+
+# ------------------------------------------------- Prometheus text exposition
+
+_LABEL_VAL = r'"(?:[^"\\]|\\.)*"'                      # allows \" and \\ escapes
+_METRIC_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"                       # metric name
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=" + _LABEL_VAL +       # first label
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=" + _LABEL_VAL + r")*\})?"  # more labels
+    r" (\+Inf|-?[0-9]+(\.[0-9]+)?(e[+-]?[0-9]+)?)$")
+
+
+def _assert_valid_exposition(text):
+    """Minimal 0.0.4 exposition validator: every line is a HELP/TYPE comment
+    or a sample; TYPE precedes its samples; histograms are cumulative and end
+    at +Inf == _count."""
+    typed = {}
+    samples = []
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert kind in ("counter", "gauge", "histogram"), line
+            typed[name] = kind
+            continue
+        assert _METRIC_LINE.match(line), f"bad exposition line: {line!r}"
+        samples.append(line)
+    for line in samples:
+        name = re.split(r"[{ ]", line, 1)[0]
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        assert name in typed or base in typed, f"sample without TYPE: {line!r}"
+    return typed, samples
+
+
+class TestPrometheus:
+    def test_render_parses_as_valid_exposition(self, metrics):
+        c = REGISTRY.counter("test_expo_total", "with label", ("op",))
+        c.inc(op='weird"val\\ue')        # label escaping exercised
+        h = REGISTRY.histogram("test_expo_seconds", "hist", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(3.0)
+        text = obs.render_prometheus()
+        typed, samples = _assert_valid_exposition(text)
+        assert typed["test_expo_total"] == "counter"
+        assert typed["test_expo_seconds"] == "histogram"
+        # histogram buckets are CUMULATIVE and close at +Inf == _count
+        buckets = [l for l in samples if l.startswith("test_expo_seconds_bucket")]
+        counts = [float(l.rsplit(" ", 1)[1]) for l in buckets]
+        assert counts == sorted(counts) and counts[-1] == 3
+        assert 'le="+Inf"' in buckets[-1]
+        assert any(l.startswith("test_expo_seconds_count") and
+                   l.endswith(" 3") for l in samples)
+
+    def test_snapshot_prometheus_round_trip(self, metrics):
+        c = REGISTRY.counter("test_round_total", "t", ("k",))
+        c.inc(41, k="x")
+        c.inc(k="x")
+        snap = obs.snapshot(prefix="test_round_total")
+        assert snap["test_round_total"]["series"][0]["value"] == 42.0
+        assert 'test_round_total{k="x"} 42' in obs.render_prometheus()
+
+
+# ---------------------------------------------------------- dispatch recorder
+
+class TestDispatch:
+    def test_disabled_leaves_hot_path_bare(self):
+        from paddle_tpu.core import dispatch
+        obs.disable()
+        assert dispatch.metrics_recorder() is None
+        assert dispatch._state.op_recorder is None
+
+    def test_dispatch_counts_and_seconds(self, metrics):
+        x = pt.tensor([1.0, 2.0])
+        (x * 3).sum()
+        snap = obs.snapshot(prefix="dispatch_ops_total")
+        ops = {s["labels"]["op"]: s["value"]
+               for s in snap["dispatch_ops_total"]["series"]}
+        assert ops.get("multiply", 0) >= 1 and ops.get("sum", 0) >= 1
+        hist = obs.snapshot(prefix="dispatch_host_seconds")
+        assert hist["dispatch_host_seconds"]["series"][0]["count"] >= 2
+
+    def test_taped_dispatches_counted(self, metrics):
+        x = pt.tensor([1.0, 2.0], stop_gradient=False)
+        (x * x).sum()
+        snap = obs.snapshot(prefix="dispatch_taped_total")
+        assert snap["dispatch_taped_total"]["series"][0]["value"] >= 2
+
+    def test_profiler_and_metrics_recorders_compose(self, metrics):
+        from paddle_tpu.core import dispatch
+        from paddle_tpu import profiler
+        p = profiler.Profiler(timer_only=True)
+        p.start()
+        try:
+            assert isinstance(dispatch._state.op_recorder,
+                              dispatch._FanoutRecorder)
+            pt.tensor([1.0]) + 1.0
+        finally:
+            p.stop()
+        # profiler saw the op AND the registry counted it
+        assert p._op_recorder.ops
+        snap = obs.snapshot(prefix="dispatch_ops_total")
+        assert snap["dispatch_ops_total"]["series"]
+        # stop() restored the bare metrics recorder, not None
+        assert dispatch._state.op_recorder is dispatch.metrics_recorder()
+
+
+# ----------------------------------------------------------------- trace_span
+
+class TestTraceSpan:
+    def test_span_records_host_event_and_histogram(self, metrics):
+        from paddle_tpu.profiler import _host_events
+        _host_events.pop("test.span", None)
+        with obs.trace_span("test.span"):
+            pass
+        assert len(_host_events["test.span"]) == 1
+        snap = obs.snapshot(prefix="span_seconds",
+                            labels={"span": "test.span"})
+        assert snap["span_seconds"]["series"][0]["count"] == 1
+
+    def test_span_disabled_is_passthrough(self):
+        from paddle_tpu.profiler import _host_events
+        obs.disable()
+        _host_events.pop("test.span.off", None)
+        with obs.trace_span("test.span.off"):
+            pass
+        assert "test.span.off" not in _host_events
+
+
+# ------------------------------------------------- profiler scheduler/export
+
+class TestScheduler:
+    def test_zero_cycle_never_divides(self):
+        from paddle_tpu.profiler import ProfilerState, make_scheduler
+        s = make_scheduler(closed=0, ready=0, record=0)
+        for step in range(4):           # cycle == 0: no ZeroDivisionError
+            assert s(step) in (ProfilerState.CLOSED, ProfilerState.RECORD)
+
+    def test_repeat_boundary_exactly_at_cycle_times_repeat(self):
+        from paddle_tpu.profiler import ProfilerState, make_scheduler
+        s = make_scheduler(closed=1, ready=1, record=2, repeat=2)
+        cycle = 4
+        assert s(cycle * 2 - 1) == ProfilerState.RECORD_AND_RETURN
+        assert s(cycle * 2) == ProfilerState.CLOSED       # exact boundary
+        assert s(cycle * 2 + 5) == ProfilerState.CLOSED   # stays closed
+
+    def test_skip_first_shifts_the_whole_schedule(self):
+        from paddle_tpu.profiler import ProfilerState, make_scheduler
+        s = make_scheduler(closed=1, ready=1, record=1, skip_first=3)
+        assert [s(i) for i in range(3)] == [ProfilerState.CLOSED] * 3
+        assert s(3) == ProfilerState.CLOSED     # pos 0 of the first cycle
+        assert s(4) == ProfilerState.READY
+        assert s(5) == ProfilerState.RECORD_AND_RETURN
+        # skip_first + repeat: the repeat window starts after the skip
+        s2 = make_scheduler(closed=0, ready=0, record=2, repeat=1,
+                            skip_first=2)
+        assert s2(1) == ProfilerState.CLOSED
+        assert s2(2) == ProfilerState.RECORD
+        assert s2(3) == ProfilerState.RECORD_AND_RETURN
+        assert s2(4) == ProfilerState.CLOSED
+
+    def test_record_and_return_only_on_last_record_step(self):
+        from paddle_tpu.profiler import ProfilerState, make_scheduler
+        s = make_scheduler(closed=1, ready=1, record=3)
+        got = [s(i) for i in range(5)]
+        assert got == [ProfilerState.CLOSED, ProfilerState.READY,
+                       ProfilerState.RECORD, ProfilerState.RECORD,
+                       ProfilerState.RECORD_AND_RETURN]
+        assert got.count(ProfilerState.RECORD_AND_RETURN) == 1
+
+
+class TestExportProtobuf:
+    def _fake_xplane(self, root, run, name):
+        d = root / "plugins" / "profile" / run
+        d.mkdir(parents=True)
+        p = d / name
+        p.write_bytes(b"\x00fake-xplane")
+        return str(p)
+
+    def test_handler_selects_protobuf_format(self):
+        from paddle_tpu import profiler
+        prof = profiler.Profiler(timer_only=True)
+        profiler.export_protobuf("/tmp/ptb")(prof)
+        assert prof._export_dir == "/tmp/ptb"
+        assert prof._export_format == "protobuf"
+
+    def test_export_resolves_newest_xplane(self, tmp_path):
+        from paddle_tpu import profiler
+        prof = profiler.Profiler(timer_only=True)
+        prof._dir = str(tmp_path)
+        self._fake_xplane(tmp_path, "run_a", "host.xplane.pb")
+        newest = self._fake_xplane(tmp_path, "run_b", "host.xplane.pb")
+        assert prof.export(format="protobuf") == newest
+
+    def test_export_falls_back_to_json_with_warning(self, tmp_path, caplog):
+        from paddle_tpu import profiler
+        prof = profiler.Profiler(
+            timer_only=True,
+            on_trace_ready=profiler.export_protobuf(str(tmp_path)))
+        prof.start()
+        prof.step()
+        prof.stop()                       # handler arms protobuf format
+        out = str(tmp_path / "trace.json")
+        with caplog.at_level("WARNING", logger="paddle_tpu.profiler"):
+            path = prof.export(out)
+        assert path == out and os.path.exists(out)
+        assert any("falling back" in r.message for r in caplog.records)
+
+
+# --------------------------------------------------------- jit capture events
+
+class TestJitEvents:
+    def _events(self, fn_name):
+        snap = obs.snapshot(prefix="jit_events_total",
+                            labels={"fn": fn_name})
+        return {s["labels"]["event"]: s["value"]
+                for s in snap.get("jit_events_total", {}).get("series", [])}
+
+    def test_capture_then_cache_hit(self, metrics):
+        from paddle_tpu.jit import to_static
+
+        @to_static
+        def double_it(x):
+            return x * 2.0
+
+        x = pt.tensor([1.0, 2.0])
+        double_it(x)
+        assert self._events("double_it").get("capture") == 1
+        double_it(x)
+        ev = self._events("double_it")
+        assert ev.get("capture") == 1 and ev.get("cache_hit") == 1
+
+
+# -------------------------------------------------- serving engine telemetry
+
+@pytest.fixture(scope="module")
+def model():
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=176,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=128)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _engine(model, **kw):
+    from paddle_tpu.inference.serving import LLMEngine
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("prefill_chunk", 8)
+    return LLMEngine(model, **kw)
+
+
+def _serve(eng, prompts, **req_kw):
+    req_kw.setdefault("max_new_tokens", 6)
+    outs = []
+    for p in prompts:
+        rid = eng.add_request(p, **req_kw)
+        eng.run_until_done()
+        outs.append(eng.result(rid))
+    return outs
+
+
+def _prompts(seed=0, n=2, shared=16, tail=5):
+    rng = np.random.RandomState(seed)
+    prefix = rng.randint(1, 128, (shared,)).astype(np.int32)
+    return [np.concatenate([prefix,
+                            rng.randint(1, 128, (tail,)).astype(np.int32)])
+            for _ in range(n)]
+
+
+class TestEngineMetrics:
+    def test_metrics_view_after_real_served_batch(self, metrics, model):
+        eng = _engine(model, prefix_cache=True)
+        _serve(eng, _prompts(seed=3))
+        m = eng.metrics()
+        ttft = m["serving_ttft_seconds"]["series"]
+        assert len(ttft) == 1 and ttft[0]["count"] == 2      # one per request
+        assert m["serving_token_latency_seconds"]["series"][0]["count"] > 0
+        kinds = {s["labels"]["kind"]: s["value"]
+                 for s in m["serving_dispatches_total"]["series"]}
+        assert kinds["prefill"] >= 2 and kinds["decode"] >= 1
+        assert m["serving_generated_tokens_total"]["series"][0]["value"] == 12
+        # gauges reflect the drained engine
+        assert m["serving_queue_depth"]["series"][0]["value"] == 0
+        assert m["serving_active_slots"]["series"][0]["value"] == 0
+        assert m["serving_batch_occupancy_ratio"]["series"][0]["value"] == 0
+        assert m["serving_free_pages"]["series"][0]["value"] > 0
+        # every series carries this engine's label only
+        for fam in m.values():
+            for s in fam["series"]:
+                assert s["labels"]["engine"] == eng._m.label
+
+    def test_prefix_cache_stats_registry_parity(self, metrics, model):
+        eng = _engine(model, prefix_cache=True)
+        _serve(eng, _prompts(seed=4))
+        st = eng.prefix_cache_stats()
+        assert st["hits"] >= 2                   # the shared prefix was reused
+        events = {s["labels"]["event"]: s["value"]
+                  for s in eng.metrics()
+                  ["serving_prefix_cache_events_total"]["series"]}
+        assert events.get("hit", 0) == st["hits"]
+        assert events.get("miss", 0) == st["misses"]
+        assert events.get("eviction", 0) == st["evictions"]
+        assert events.get("cow_copy", 0) == st["cow_copies"]
+        m = eng.metrics()
+        assert m["serving_prefix_cached_pages"]["series"][0]["value"] \
+            == st["cached_pages"]
+        assert m["serving_prefix_reclaimable_pages"]["series"][0]["value"] \
+            == st["reclaimable_pages"]
+
+    def test_stats_unchanged_with_metrics_disabled(self, model):
+        obs.disable()
+        obs.reset()
+        eng = _engine(model, prefix_cache=True)
+        _serve(eng, _prompts(seed=5))
+        st = eng.prefix_cache_stats()
+        assert st["hits"] >= 2 and st["prefill_dispatches"] > 0
+        # the registry saw nothing: plain-int attrs are the always-on path
+        snap = obs.snapshot(prefix="serving_",
+                            labels={"engine": eng._m.label})
+        for fam in snap.values():
+            for s in fam["series"]:
+                assert s.get("value", s.get("count", 0)) == 0
+
+    def test_engine_render_prometheus_is_valid(self, metrics, model):
+        eng = _engine(model, prefix_cache=True)
+        _serve(eng, _prompts(seed=6, n=1))
+        typed, samples = _assert_valid_exposition(obs.render_prometheus())
+        assert typed["serving_ttft_seconds"] == "histogram"
+        assert any(l.startswith("serving_dispatches_total{") for l in samples)
