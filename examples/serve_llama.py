@@ -5,6 +5,10 @@ on-demand paging with preemption, RTT-adaptive decode blocks, and int8
 KV-cache pages (~2x slots at the same HBM budget).
 
 Run:  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python examples/serve_llama.py
+
+Set METRICS_PORT to also expose engine telemetry on a Prometheus pull
+endpoint for the duration of the run (e.g. METRICS_PORT=9400 -> scrape
+http://127.0.0.1:9400/metrics; 0 lets the OS pick a port).
 """
 import os
 import sys
@@ -16,12 +20,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 import numpy as np
 
 import paddle_tpu as paddle
+from paddle_tpu import observability as obs
 from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 from paddle_tpu.inference.serving import LLMEngine
 
 
 def main():
     paddle.seed(0)
+    metrics = None
+    if os.environ.get("METRICS_PORT") is not None:
+        obs.enable()
+        metrics = obs.start_metrics_server(
+            port=int(os.environ["METRICS_PORT"]))
+        print(f"metrics endpoint: {metrics.url}")
     model = LlamaForCausalLM(LlamaConfig.tiny())
     model.eval()
     eng = LLMEngine(model, max_batch=2, max_len=96, page_size=8,
@@ -40,6 +51,12 @@ def main():
     print(f"engine dispatches: {steps}, "
           f"auto decode block: {eng.auto_decode_block}, "
           f"KV bytes/page: {eng.kv_bytes_per_page()}")
+    if metrics is not None:
+        ttft = [ln for ln in obs.render_prometheus().splitlines()
+                if ln.startswith("serving_ttft_seconds_count")]
+        print("scraped:", *ttft, sep="\n  ")
+        metrics.close()
+        obs.disable()
 
 
 if __name__ == "__main__":

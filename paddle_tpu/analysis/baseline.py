@@ -1,0 +1,80 @@
+"""Committed-baseline support for incremental adoption of new passes.
+
+A baseline file records the fingerprints of findings that existed when a
+pass was introduced; runs loaded with it report only NEW findings, so a
+stricter pass can land without first fixing (or pragma-ing) every historical
+hit.  The file is JSON, human-reviewable, and meant to be committed:
+
+    {
+      "graftlint-baseline": 1,
+      "findings": [
+        {"fingerprint": "…", "pass": "dtype-rules", "code": "DT102",
+         "path": "paddle_tpu/ops/registry.py", "message": "…"}
+      ]
+    }
+
+Workflow::
+
+    python -m paddle_tpu.analysis paddle_tpu/ --write-baseline .graftlint-baseline.json
+    python -m paddle_tpu.analysis paddle_tpu/ --baseline .graftlint-baseline.json
+
+Matching is by :meth:`Finding.fingerprint` (pass, code, repo-relative path,
+message — no line number), so edits elsewhere in a file don't resurrect a
+baselined finding, while any change to the finding's own message re-surfaces
+it for a fresh look.
+"""
+from __future__ import annotations
+
+import json
+
+from .framework import Finding, norm_path
+
+_SCHEMA = 1
+
+
+class Baseline:
+    """Set of accepted finding fingerprints; ``finding in baseline`` tests
+    membership."""
+
+    def __init__(self, fingerprints=()):
+        self.fingerprints = set(fingerprints)
+
+    def __contains__(self, finding: Finding) -> bool:
+        return finding.fingerprint() in self.fingerprints
+
+    def __len__(self) -> int:
+        return len(self.fingerprints)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        """Read a baseline file; missing or corrupt files yield an empty
+        baseline (the lint still runs, just without forgiveness)."""
+        try:
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+            if data.get("graftlint-baseline") != _SCHEMA:
+                return cls()
+            return cls(e["fingerprint"] for e in data.get("findings", ())
+                       if "fingerprint" in e)
+        except (OSError, ValueError, TypeError):
+            return cls()
+
+    @staticmethod
+    def write(path: str, findings: list[Finding]) -> int:
+        """Write ``findings`` as the new baseline; returns the entry count.
+        Entries carry the human-readable context next to the fingerprint so
+        reviewers can audit what was accepted."""
+        entries = [{"fingerprint": f.fingerprint(), "pass": f.pass_name,
+                    "code": f.code, "path": norm_path(f.path),
+                    "severity": f.severity, "message": f.message}
+                   for f in findings]
+        # one entry per fingerprint, sorted for a stable committed diff
+        uniq = {e["fingerprint"]: e for e in entries}
+        out = {"graftlint-baseline": _SCHEMA,
+               "findings": sorted(uniq.values(),
+                                  key=lambda e: (e["path"], e["pass"],
+                                                 e["code"], e["fingerprint"]))}
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(out, f, indent=2, sort_keys=True)
+            f.write("\n")
+        return len(uniq)

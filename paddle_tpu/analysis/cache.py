@@ -16,7 +16,7 @@ import os
 
 from .framework import Finding
 
-_SCHEMA = 1
+_SCHEMA = 2    # v2: Finding records carry a severity field
 
 
 def default_cache_path():

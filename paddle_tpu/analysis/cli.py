@@ -2,7 +2,8 @@
 """graftlint CLI — ``python -m paddle_tpu.analysis`` / the ``graftlint``
 console script.
 
-Exit codes: 0 clean, 1 findings, 2 usage/internal error.
+Exit codes: 0 clean, 1 findings at or above ``--fail-on`` (default: error),
+2 usage/internal error.
 
 JSON report schema (``--format json``)::
 
@@ -11,13 +12,18 @@ JSON report schema (``--format json``)::
       "passes": ["jit-cache-hygiene", ...],
       "files": 182,
       "suppressed": 3,                # pragma-suppressed findings
+      "baselined": 2,                 # findings absorbed by --baseline
       "cache_hits": 170,
       "findings": [
         {"pass": "trace-safety", "code": "TS101",
          "path": "paddle_tpu/x.py", "line": 42,
-         "message": "...", "hint": "..."}
+         "message": "...", "hint": "...", "severity": "error"}
       ]
     }
+
+``--format sarif`` emits SARIF 2.1.0 for CI annotation (GitHub code
+scanning et al.); ``--baseline FILE`` suppresses previously accepted
+findings and ``--write-baseline FILE`` records the current ones.
 """
 from __future__ import annotations
 
@@ -29,15 +35,24 @@ import sys
 def _parser():
     p = argparse.ArgumentParser(
         prog="graftlint",
-        description="trace-safety and registry-parity static analysis for "
-                    "the paddle_tpu tree")
+        description="trace-safety, registry-parity, sharding and dtype "
+                    "static analysis for the paddle_tpu tree")
     p.add_argument("paths", nargs="*", default=["."],
                    help="files or directories to lint (default: .)")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text")
     p.add_argument("--select", metavar="PASS[,PASS]",
                    help="run only these passes")
     p.add_argument("--disable", metavar="PASS[,PASS]",
                    help="skip these passes")
+    p.add_argument("--fail-on", choices=("error", "warning"), default="error",
+                   help="lowest severity that fails the run (default: error; "
+                        "'warning' makes any finding fatal)")
+    p.add_argument("--baseline", metavar="FILE",
+                   help="skip findings recorded in this baseline file")
+    p.add_argument("--write-baseline", metavar="FILE",
+                   help="write the surviving findings to FILE as the new "
+                        "baseline and exit 0")
     p.add_argument("--no-cache", action="store_true",
                    help="ignore and don't write the per-file result cache")
     p.add_argument("--cache", metavar="FILE",
@@ -59,34 +74,49 @@ def main(argv=None) -> int:
         for name in sorted(PASSES):
             p = PASSES[name]
             scope = "project" if p.project_scope else "file"
-            print(f"{name:20s} v{p.version} [{scope}]  {p.description}")
+            print(f"{name:24s} v{p.version} [{scope}]  {p.description}")
         return 0
     cache = None
     if not args.no_cache:
         from .cache import FileCache
         cache = FileCache(args.cache)
+    baseline = None
+    if args.baseline:
+        from .baseline import Baseline
+        baseline = Baseline.load(args.baseline)
     try:
         result = run(args.paths, select=_split(args.select),
-                     disable=_split(args.disable), cache=cache)
+                     disable=_split(args.disable), cache=cache,
+                     baseline=baseline)
     except KeyError as e:
         print(f"graftlint: {e.args[0]}", file=sys.stderr)
         return 2
+    if args.write_baseline:
+        from .baseline import Baseline
+        n = Baseline.write(args.write_baseline, result.findings)
+        print(f"graftlint: wrote {n} finding(s) to {args.write_baseline}")
+        return 0
     if args.format == "json":
-        print(json.dumps({
-            "graftlint": 1,
-            "passes": result.passes,
-            "files": result.files,
-            "suppressed": result.suppressed,
-            "cache_hits": result.cache_hits,
-            "findings": [f.to_dict() for f in result.findings],
-        }, indent=2))
+        from .report import to_json
+        print(json.dumps(to_json(result), indent=2))
+    elif args.format == "sarif":
+        from .report import to_sarif
+        print(json.dumps(to_sarif(result), indent=2))
     else:
         for f in result.findings:
             print(f.render())
-        tail = (f"{len(result.findings)} finding(s) in {result.files} "
-                f"file(s); {result.suppressed} suppressed by pragma")
-        print(("FAILED: " if result.findings else "OK: ") + tail)
-    return 1 if result.findings else 0
+        n_err = len(result.errors())
+        n_warn = len(result.findings) - n_err
+        tail = (f"{n_err} error(s), {n_warn} warning(s) in {result.files} "
+                f"file(s); {result.suppressed} suppressed by pragma"
+                + (f", {result.baselined} baselined" if result.baselined
+                   else ""))
+        failing = result.findings if args.fail_on == "warning" \
+            else result.errors()
+        print(("FAILED: " if failing else "OK: ") + tail)
+    failing = result.findings if args.fail_on == "warning" \
+        else result.errors()
+    return 1 if failing else 0
 
 
 if __name__ == "__main__":

@@ -22,35 +22,65 @@ Suppression pragmas (the clang-tidy ``NOLINT`` analog):
 from __future__ import annotations
 
 import ast
+import hashlib
 import os
 import re
 import sys
 from dataclasses import dataclass, field
 
 
+SEVERITIES = ("error", "warning")
+
+
 @dataclass(frozen=True)
 class Finding:
-    """One diagnostic: a pass, a location, a short code, and a fix hint."""
+    """One diagnostic: a pass, a location, a short code, and a fix hint.
+
+    ``severity`` is ``"error"`` (breaks CI / exit code 1) or ``"warning"``
+    (reported, baselineable, non-fatal under the default ``--fail-on error``).
+    """
     pass_name: str
     code: str
     path: str
     line: int
     message: str
     hint: str = ""
+    severity: str = "error"
 
     def to_dict(self):
         return {"pass": self.pass_name, "code": self.code, "path": self.path,
-                "line": self.line, "message": self.message, "hint": self.hint}
+                "line": self.line, "message": self.message, "hint": self.hint,
+                "severity": self.severity}
 
     @classmethod
     def from_dict(cls, d):
         return cls(d["pass"], d["code"], d["path"], d["line"], d["message"],
-                   d.get("hint", ""))
+                   d.get("hint", ""), d.get("severity", "error"))
 
     def render(self):
+        sev = "" if self.severity == "error" else f" {self.severity}:"
         tail = f"  [fix: {self.hint}]" if self.hint else ""
-        return (f"{self.path}:{self.line}: {self.code} "
+        return (f"{self.path}:{self.line}:{sev} {self.code} "
                 f"[{self.pass_name}] {self.message}{tail}")
+
+    def fingerprint(self) -> str:
+        """Stable identity for baselining: pass, code, repo-relative path and
+        message — deliberately NOT the line number, so unrelated edits above
+        a baselined finding don't resurrect it."""
+        key = "|".join((self.pass_name, self.code, norm_path(self.path),
+                        self.message))
+        return hashlib.sha1(key.encode("utf-8")).hexdigest()[:16]
+
+
+def norm_path(path: str) -> str:
+    """Machine-independent spelling of ``path`` for fingerprints: the
+    project-relative tail starting at the first package component
+    (``paddle_tpu``/``tests``/``examples``), else the basename."""
+    parts = path.replace(os.sep, "/").split("/")
+    for marker in ("paddle_tpu", "tests", "examples"):
+        if marker in parts:
+            return "/".join(parts[parts.index(marker):])
+    return parts[-1]
 
 
 _PRAGMA_RE = re.compile(
@@ -166,11 +196,18 @@ class RunResult:
     passes: list[str] = field(default_factory=list)
     suppressed: int = 0
     cache_hits: int = 0
+    baselined: int = 0
+
+    def errors(self) -> list[Finding]:
+        return [f for f in self.findings if f.severity == "error"]
 
 
-def run(paths, select=None, disable=None, cache=None) -> RunResult:
+def run(paths, select=None, disable=None, cache=None,
+        baseline=None) -> RunResult:
     """Run the selected passes over ``paths``; returns findings with
-    pragma-suppressed ones dropped (counted in ``suppressed``)."""
+    pragma-suppressed ones dropped (counted in ``suppressed``).  ``baseline``
+    is an optional :class:`~paddle_tpu.analysis.baseline.Baseline`: findings
+    it already records are dropped too (counted in ``baselined``)."""
     # load pass modules lazily so `import paddle_tpu` never pays for them
     from . import passes as _passes  # noqa: F401  (registration side effect)
     names = sorted(PASSES) if not select else list(select)
@@ -207,6 +244,8 @@ def run(paths, select=None, disable=None, cache=None) -> RunResult:
         src = project.by_path.get(fd.path)
         if src is not None and src.suppressed(fd):
             result.suppressed += 1
+        elif baseline is not None and fd in baseline:
+            result.baselined += 1
         else:
             result.findings.append(fd)
     result.findings.sort(key=lambda x: (x.path, x.line, x.code))

@@ -1,6 +1,8 @@
 """graftlint passes — importing this package registers every built-in pass."""
-from . import (jit_cache_hygiene, namespace_parity,  # noqa: F401
-               no_adhoc_telemetry, registry_parity, trace_safety)
+from . import (dtype_rules, jit_cache_hygiene, namespace_parity,  # noqa: F401
+               no_adhoc_telemetry, registry_parity, sharding_spec,
+               trace_safety)
 
-__all__ = ["jit_cache_hygiene", "namespace_parity", "no_adhoc_telemetry",
-           "registry_parity", "trace_safety"]
+__all__ = ["dtype_rules", "jit_cache_hygiene", "namespace_parity",
+           "no_adhoc_telemetry", "registry_parity", "sharding_spec",
+           "trace_safety"]

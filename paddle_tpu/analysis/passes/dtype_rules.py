@@ -1,0 +1,180 @@
+"""dtype-rules — keep the op table's dtypes honest against ``core/dtype.py``.
+
+The promotion contract: ``convert_dtype`` silently narrows 64-bit requests
+(int64 -> int32, float64 -> float32, uint64 -> uint32, complex128 ->
+complex64) whenever JAX x64 is off — the TPU default.  That means a sample
+builder that hands the suite an int64 index array is lying: the numpy golden
+computes with 64-bit inputs while the op under test sees the narrowed 32-bit
+tensor, and the comparison only passes until a value crosses the narrower
+range.  Same story for a float64 golden output silently down-cast before the
+assert.
+
+Like registry-parity, this pass has a static half (map registrations to
+lines) and a runtime half (import the live registry, build each sample, run
+the numpy reference) — so it is project-scoped and never cached.
+
+Checks (codes):
+
+  * DT101 sample/kwargs array dtype that ``convert_dtype`` would narrow
+          (the op computes on different bits than the golden)
+  * DT102 numpy reference returns float64/complex128 from <=32-bit floating
+          inputs — the comparison down-casts and hides precision drift
+          [warning]
+  * DT103 ``grad=True`` with no floating-point sample input: the
+          finite-difference grad check cannot perturb integers
+"""
+from __future__ import annotations
+
+import ast
+import importlib
+
+import numpy as np
+
+from ..framework import AnalysisPass, Finding, Project, register_pass
+
+_HELPERS = {"u", "b", "g", "smoke"}
+
+_HINTS = {
+    "DT101": "build the sample in the narrowed dtype directly (e.g. "
+             "np.int32 index arrays) so golden and op see the same bits",
+    "DT102": "cast the reference output (.astype) to the widest input "
+             "dtype, or accept the masked precision via the baseline",
+    "DT103": "give the op a floating sample input, or register it with "
+             "grad=False",
+}
+
+# float dtypes at or below 32 bits (includes the ml_dtypes small floats)
+_NARROW_FLOAT_BITS = 32
+
+
+def _convert_dtype():
+    from ...core.dtype import convert_dtype
+    return convert_dtype
+
+
+def _is_floating(dt) -> bool:
+    from ...core.dtype import is_floating_point
+    try:
+        return is_floating_point(dt)
+    except TypeError:
+        return False
+
+
+def _arrays(obj):
+    """Flatten ndarray leaves out of samples/kwargs values."""
+    if isinstance(obj, np.ndarray):
+        yield obj
+    elif isinstance(obj, (list, tuple)):
+        for x in obj:
+            yield from _arrays(x)
+    elif isinstance(obj, dict):
+        for x in obj.values():
+            yield from _arrays(x)
+
+
+@register_pass
+class DtypeRulesPass(AnalysisPass):
+    name = "dtype-rules"
+    version = 1
+    description = ("op-table dtype checks against core.dtype promotion: "
+                   "64-bit samples that narrow, float64 goldens, "
+                   "non-differentiable grad samples")
+    project_scope = True    # runtime half imports the live registry
+
+    def check_project(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for src in project.files:
+            lines = self._registration_lines(src)
+            if not lines:
+                continue
+            mod = Project.module_name(src.path)
+            if mod is None:
+                continue
+            try:
+                live = importlib.import_module(mod)
+            except Exception:
+                continue    # registry-parity already reports RP006
+            if not hasattr(live, "REGISTRY"):
+                continue
+            findings.extend(self._check_registry(src, live, lines))
+        return findings
+
+    # ---- static half: op name -> registration line -----------------------
+    @staticmethod
+    def _registration_lines(src):
+        mentions = {n.id for n in ast.walk(src.tree)
+                    if isinstance(n, ast.Name)}
+        if not {"REGISTRY", "OpSpec"} & mentions:
+            return {}
+        lines = {}
+        for node in ast.walk(src.tree):
+            if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                    and node.func.id in _HELPERS and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                lines.setdefault(node.args[0].value, node.lineno)
+        return lines
+
+    # ---- runtime half ----------------------------------------------------
+    def _check_registry(self, src, live, lines):
+        findings = []
+        convert = _convert_dtype()
+
+        def emit(name, code, msg, severity="error"):
+            findings.append(Finding(self.name, code, src.path,
+                                    lines.get(name, 1), msg, _HINTS[code],
+                                    severity))
+
+        for name, spec in live.REGISTRY.items():
+            if getattr(spec, "kind", None) in ("alias", "inplace"):
+                continue
+            if spec.sample is None:
+                continue
+            try:
+                sample = spec.sample()
+            except Exception:
+                continue    # registry-parity already reports RP008
+            arrays = list(_arrays(sample))
+            kw_arrays = list(_arrays(getattr(spec, "kwargs", {}) or {}))
+
+            # DT101: inputs the tensor layer would silently narrow
+            for where, arrs in (("sample", arrays), ("kwargs", kw_arrays)):
+                flagged = set()
+                for a in arrs:
+                    narrowed = convert(a.dtype)
+                    if narrowed != a.dtype and a.dtype not in flagged:
+                        flagged.add(a.dtype)
+                        emit(name, "DT101",
+                             f"op '{name}' {where} array is {a.dtype} but "
+                             f"convert_dtype narrows it to {narrowed} — "
+                             "the golden and the op compute on different "
+                             "dtypes")
+
+            # DT103: grad check needs something to perturb
+            if getattr(spec, "grad", False) and arrays \
+                    and not any(_is_floating(a.dtype) for a in arrays):
+                emit(name, "DT103",
+                     f"op '{name}' has grad=True but no floating-point "
+                     "sample input — finite differences cannot perturb "
+                     f"{'/'.join(sorted({str(a.dtype) for a in arrays}))}")
+
+            # DT102: float64 golden from narrow floating inputs
+            if spec.np_ref is None or not arrays:
+                continue
+            floats = [a for a in arrays if _is_floating(a.dtype)]
+            if not floats or any(a.dtype.itemsize * 8 > _NARROW_FLOAT_BITS
+                                 for a in floats):
+                continue
+            try:
+                out = spec.np_ref(*sample)
+            except Exception:
+                continue    # suite-level failure, not a dtype finding
+            for o in _arrays(out if isinstance(out, (list, tuple)) else [out]):
+                if o.dtype in (np.dtype(np.float64), np.dtype(np.complex128)):
+                    emit(name, "DT102",
+                         f"op '{name}' numpy reference returns {o.dtype} "
+                         "from <=32-bit floating inputs — the comparison "
+                         "down-casts and can mask drift",
+                         severity="warning")
+                    break
+        return findings

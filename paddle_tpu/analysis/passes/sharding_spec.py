@@ -1,0 +1,332 @@
+"""sharding-spec-coverage — SPMD contract checks at every ``shard_map`` site.
+
+The bug class: ``shard_map`` takes the sharding contract (mesh, in_specs,
+out_specs) as *data*, so nothing checks it until the traced function runs on
+a real multi-device mesh — which unit tests on one chip never do.  A spec
+tuple one entry short, an axis name that isn't in the mesh, or a collective
+whose ``axis_name`` the surrounding shard_map never binds all surface as
+cryptic runtime errors (or, worst, as a deadlock: a collective under a
+data-dependent branch runs on some shards and not others, and the program
+hangs at the next synchronization point).
+
+Checks (codes):
+
+  * SS101 in_specs arity != the wrapped function's free positional arity
+          (body resolved through local defs, lambdas, ``functools.partial``
+          and cross-file imports via :mod:`..resolve`)
+  * SS102 literal PartitionSpec axis name not among the mesh's axis names
+          (only when the mesh constructor's axis names are literal)
+  * SS103 collective called inside the body with a literal ``axis_name``
+          the surrounding shard_map's mesh does not bind
+  * SS104 collective under data-dependent control flow (an ``if``/``while``
+          whose test depends on a traced body parameter): SPMD divergence —
+          shards that skip the collective deadlock the ones that don't
+          [warning]
+  * SS105 out_specs tuple arity != the body's returned tuple arity
+
+Everything literal-or-resolvable is checked; dynamic specs/meshes/axis names
+are skipped, never guessed — a lint finding here should always be real.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..framework import AnalysisPass, Finding, Project, register_pass
+from ..resolve import (Imports, collective_axis_arg, is_partition_spec,
+                       is_shard_map, mesh_axis_names, _literal_axis_names)
+from .trace_safety import _is_tainted, _scan, _target_names
+
+_HINTS = {
+    "SS101": "make in_specs one spec per body parameter (bind extras with "
+             "functools.partial, or pass a single spec for a pytree arg)",
+    "SS102": "use an axis name the mesh declares, or add the axis to the "
+             "mesh constructor",
+    "SS103": "collectives inside shard_map may only name mesh axes the "
+             "shard_map binds; fix the axis_name or the mesh",
+    "SS104": "hoist the collective out of the branch, or rewrite with "
+             "jnp.where/lax.cond so every shard executes it",
+    "SS105": "return one value per out_specs entry (or collapse out_specs "
+             "to a single spec for a pytree result)",
+}
+
+_PARTIAL = ("functools.partial", "partial")
+
+
+def _kwarg(call, name):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _positional_params(fn):
+    a = fn.args
+    return [p.arg for p in list(a.posonlyargs) + list(a.args)]
+
+
+class _Body:
+    """A resolved shard_map body: the def/lambda node, the file it lives in,
+    and how many leading positionals / which keywords ``partial`` bound."""
+
+    def __init__(self, fn, src):
+        self.fn = fn
+        self.src = src
+        self.bound_pos = 0
+        self.bound_kw: set[str] = set()
+
+    def free_positional(self):
+        names = _positional_params(self.fn)
+        if names and names[0] in ("self", "cls"):
+            names = names[1:]
+        names = names[self.bound_pos:]
+        return [n for n in names if n not in self.bound_kw]
+
+    def has_var_positional(self):
+        return self.fn.args.vararg is not None
+
+
+def _spec_axes(node, imports):
+    """[(axis_name, line)] for every literal axis string inside a
+    PartitionSpec call anywhere under ``node``."""
+    out = []
+    if node is None:
+        return out
+    for n in ast.walk(node):
+        if not (isinstance(n, ast.Call)
+                and is_partition_spec(imports.canonical(n.func))):
+            continue
+        for a in list(n.args) + [kw.value for kw in n.keywords]:
+            names = _literal_axis_names(a)
+            for name in names or ():
+                out.append((name, n.lineno))
+    return out
+
+
+@register_pass
+class ShardingSpecPass(AnalysisPass):
+    name = "sharding-spec-coverage"
+    version = 1
+    description = ("shard_map contract checks: in/out_specs arity, spec and "
+                   "collective axis names vs the mesh, collectives under "
+                   "data-dependent control flow")
+    project_scope = True    # resolves bodies across files
+
+    def check_project(self, project: Project) -> list[Finding]:
+        # cross-file function index: every file's top-level defs, keyed by
+        # dotted module name when importable, always by basename stem
+        self._funcs: dict[str, dict] = {}
+        self._imports: dict[str, Imports] = {}
+        for src in project.files:
+            defs = {n.name: (n, src) for n in src.tree.body
+                    if isinstance(n, ast.FunctionDef)}
+            if not defs:
+                continue
+            mod = Project.module_name(src.path)
+            if mod:
+                self._funcs[mod] = defs
+            stem = src.path.replace("\\", "/").rsplit("/", 1)[-1][:-3]
+            self._funcs.setdefault(stem, {}).update(defs)
+        findings: list[Finding] = []
+        for src in project.files:
+            imports = self._file_imports(src)
+            self._walk(src.tree, [], src, imports, findings)
+        return findings
+
+    def _file_imports(self, src) -> Imports:
+        if src.path not in self._imports:
+            self._imports[src.path] = Imports(
+                src.tree, Project.module_name(src.path))
+        return self._imports[src.path]
+
+    # ---- traversal -------------------------------------------------------
+    def _walk(self, node, scopes, src, imports, findings):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.Call) \
+                    and is_shard_map(imports.canonical(child.func)):
+                self._check_site(child, scopes, src, imports, findings)
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk(child, [child] + scopes, src, imports, findings)
+            else:
+                self._walk(child, scopes, src, imports, findings)
+
+    # ---- body / mesh resolution ------------------------------------------
+    def _lookup_name(self, name, scopes, src):
+        """Resolve ``name`` at a call site: nested defs and assignments in
+        enclosing scopes (innermost first), then module level."""
+        spaces = [fn.body for fn in scopes] + [src.tree.body]
+        for body in spaces:
+            for stmt in body:
+                if isinstance(stmt, ast.FunctionDef) and stmt.name == name:
+                    return stmt
+                if isinstance(stmt, ast.Assign) \
+                        and name in _target_names(stmt.targets[0]) \
+                        and len(stmt.targets) == 1:
+                    return stmt.value
+        return None
+
+    def _resolve_body(self, node, scopes, src, depth=0):
+        if node is None or depth > 8:
+            return None
+        if isinstance(node, ast.Lambda):
+            return _Body(node, src)
+        imports = self._file_imports(src)
+        if isinstance(node, ast.Call):
+            canon = imports.canonical(node.func)
+            if canon in _PARTIAL or (canon and canon.endswith(".partial")):
+                inner = self._resolve_body(
+                    node.args[0] if node.args else None, scopes, src,
+                    depth + 1)
+                if inner is None:
+                    return None
+                inner.bound_pos += len(node.args) - 1
+                inner.bound_kw |= {kw.arg for kw in node.keywords if kw.arg}
+                return inner
+            return None
+        if isinstance(node, ast.Name):
+            local = self._lookup_name(node.id, scopes, src)
+            if isinstance(local, ast.FunctionDef):
+                return _Body(local, src)
+            if local is not None:
+                return self._resolve_body(local, scopes, src, depth + 1)
+        # fall through to cross-file: canonical path -> another file's def
+        canon = imports.canonical(node)
+        if canon and "." in canon:
+            mod, fname = canon.rsplit(".", 1)
+            for key, defs in self._funcs.items():
+                if (key == mod or key.endswith("." + mod)) and fname in defs:
+                    fn, fsrc = defs[fname]
+                    return _Body(fn, fsrc)
+        return None
+
+    def _mesh_axes(self, node, scopes, src):
+        """Mesh axis names when statically known, else None."""
+        imports = self._file_imports(src)
+        for _ in range(4):                    # chase simple assignments
+            if isinstance(node, ast.Call):
+                return mesh_axis_names(node, imports)
+            if isinstance(node, ast.Name):
+                node = self._lookup_name(node.id, scopes, src)
+                if isinstance(node, ast.FunctionDef):
+                    return None
+                continue
+            return None
+        return None
+
+    # ---- per-site checks -------------------------------------------------
+    def _check_site(self, call, scopes, src, imports, findings):
+        def arg(i, kw):
+            node = _kwarg(call, kw)
+            return node if node is not None else (
+                call.args[i] if len(call.args) > i else None)
+
+        f_node = call.args[0] if call.args else _kwarg(call, "f")
+        mesh_node = arg(1, "mesh")
+        in_node = arg(2, "in_specs")
+        out_node = arg(3, "out_specs")
+
+        def emit(code, line, msg, severity="error"):
+            findings.append(Finding(self.name, code, src.path, line, msg,
+                                    _HINTS[code], severity))
+
+        mesh_axes = self._mesh_axes(mesh_node, scopes, src)
+        body = self._resolve_body(f_node, scopes, src)
+
+        # SS101: in_specs tuple arity vs the body's free positional params
+        if body is not None and isinstance(in_node, (ast.Tuple, ast.List)) \
+                and not body.has_var_positional():
+            free = body.free_positional()
+            if len(free) != len(in_node.elts):
+                emit("SS101", call.lineno,
+                     f"in_specs has {len(in_node.elts)} spec(s) but the "
+                     f"shard_map body takes {len(free)} positional "
+                     f"argument(s) ({', '.join(free) or 'none'})")
+
+        # SS102: literal spec axis names must exist on the (literal) mesh
+        if mesh_axes is not None:
+            for name, line in (_spec_axes(in_node, imports)
+                               + _spec_axes(out_node, imports)):
+                if name not in mesh_axes:
+                    emit("SS102", line,
+                         f"PartitionSpec names axis '{name}' but the mesh "
+                         f"only defines ({', '.join(mesh_axes)})")
+
+        # SS105: out_specs tuple arity vs literal tuple returns
+        if body is not None and isinstance(out_node, (ast.Tuple, ast.List)) \
+                and isinstance(body.fn, ast.FunctionDef):
+            arity = self._return_tuple_arity(body.fn)
+            if arity is not None and arity != len(out_node.elts):
+                emit("SS105", call.lineno,
+                     f"out_specs has {len(out_node.elts)} spec(s) but the "
+                     f"body returns a {arity}-tuple")
+
+        if body is not None:
+            self._sweep_body(body, mesh_axes, emit)
+
+    @staticmethod
+    def _return_tuple_arity(fn):
+        """Common tuple arity of the body's own return statements when every
+        one returns a tuple literal; None otherwise (pytrees, vars, ...)."""
+        arities = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                continue
+            if isinstance(node, ast.Return) and node.value is not None:
+                if not isinstance(node.value, ast.Tuple):
+                    return None
+                arities.add(len(node.value.elts))
+        return arities.pop() if len(arities) == 1 else None
+
+    # ---- body interior: SS103 + SS104 ------------------------------------
+    def _sweep_body(self, body, mesh_axes, emit):
+        imports = self._file_imports(body.src)
+        # taint: the traced (spec-covered) params, propagated through simple
+        # assignments; shape/dtype metadata reads stay static (see _scan)
+        tainted = set(body.free_positional())
+        for _ in range(2):
+            before = len(tainted)
+            for node in ast.walk(body.fn):
+                if isinstance(node, ast.Assign) \
+                        and _is_tainted(node.value, tainted):
+                    for t in node.targets:
+                        tainted.update(_target_names(t))
+            if len(tainted) == before:
+                break
+
+        divergent_lines = set()
+        for node in ast.walk(body.fn):
+            if isinstance(node, (ast.If, ast.While)):
+                uses: list = []
+                _scan(node.test, tainted, uses, taint_mode=False)
+                if not uses:
+                    continue
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call) and collective_axis_arg(
+                            imports.canonical(sub.func)) is not None:
+                        if sub.lineno not in divergent_lines:
+                            divergent_lines.add(sub.lineno)
+                            kind = ("while" if isinstance(node, ast.While)
+                                    else "if")
+                            emit("SS104", sub.lineno,
+                                 f"collective under a data-dependent `{kind}`"
+                                 f" on traced value '{uses[0].id}' — shards "
+                                 "that skip it deadlock the ones that don't",
+                                 severity="warning")
+
+        for node in ast.walk(body.fn):
+            if not isinstance(node, ast.Call):
+                continue
+            idx = collective_axis_arg(imports.canonical(node.func))
+            if idx is None:
+                continue
+            axis_node = (node.args[idx] if len(node.args) > idx
+                         else _kwarg(node, "axis_name"))
+            names = _literal_axis_names(axis_node)
+            if names is None or mesh_axes is None:
+                continue
+            for name in names:
+                if name not in mesh_axes:
+                    emit("SS103", node.lineno,
+                         f"collective names axis '{name}' but the enclosing "
+                         f"shard_map mesh only binds "
+                         f"({', '.join(mesh_axes)})")

@@ -1,0 +1,85 @@
+"""Report serializers for CI annotation: the graftlint JSON schema and
+SARIF 2.1.0 (the format GitHub code scanning, VS Code SARIF viewers, and
+most CI annotators ingest).
+
+SARIF mapping: each pass's finding codes become ``rules`` on the single
+``graftlint`` driver; ``severity`` maps to SARIF ``level`` (error/warning);
+locations carry the path as a relative URI plus the 1-based start line.
+"""
+from __future__ import annotations
+
+import os
+
+from .framework import RunResult
+
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                 "master/Schemata/sarif-schema-2.1.0.json")
+_SARIF_VERSION = "2.1.0"
+
+
+def to_json(result: RunResult) -> dict:
+    """The ``--format json`` schema (see cli.py docstring)."""
+    return {
+        "graftlint": 1,
+        "passes": result.passes,
+        "files": result.files,
+        "suppressed": result.suppressed,
+        "baselined": result.baselined,
+        "cache_hits": result.cache_hits,
+        "findings": [f.to_dict() for f in result.findings],
+    }
+
+
+def _uri(path: str) -> str:
+    """Forward-slash relative URI for SARIF artifactLocation."""
+    rel = os.path.relpath(path) if os.path.isabs(path) else path
+    if rel.startswith(".."):            # outside cwd: keep it absolute
+        rel = os.path.abspath(path)
+    return rel.replace(os.sep, "/")
+
+
+def to_sarif(result: RunResult) -> dict:
+    """SARIF 2.1.0 log with one run and one rule per finding code."""
+    rules = {}
+    for f in result.findings:
+        if f.code not in rules:
+            rules[f.code] = {
+                "id": f.code,
+                "name": f.pass_name,
+                "shortDescription": {"text": f"[{f.pass_name}] {f.code}"},
+                "defaultConfiguration": {"level": f.severity},
+            }
+            if f.hint:
+                rules[f.code]["help"] = {"text": f.hint}
+    rule_ids = sorted(rules)
+    rule_index = {rid: i for i, rid in enumerate(rule_ids)}
+    results = []
+    for f in result.findings:
+        text = f.message + (f"  [fix: {f.hint}]" if f.hint else "")
+        results.append({
+            "ruleId": f.code,
+            "ruleIndex": rule_index[f.code],
+            "level": f.severity,
+            "message": {"text": text},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": _uri(f.path)},
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+            "fingerprints": {"graftlint/v1": f.fingerprint()},
+        })
+    return {
+        "$schema": _SARIF_SCHEMA,
+        "version": _SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri":
+                    "https://github.com/paddle-tpu/paddle-tpu",
+                "rules": [rules[rid] for rid in rule_ids],
+            }},
+            "results": results,
+            "columnKind": "utf16CodeUnits",
+        }],
+    }

@@ -39,7 +39,16 @@ __all__ = [
     "MetricsRegistry", "REGISTRY", "DEFAULT_BUCKETS",
     "enable", "disable", "enabled", "reset",
     "snapshot", "render_prometheus", "trace_span", "record_collective",
+    "start_metrics_server",
 ]
+
+
+def start_metrics_server(port: int = 0, addr: str = "127.0.0.1"):
+    """Serve :func:`render_prometheus` at ``http://addr:port/metrics`` (the
+    standard scrape interface); see :mod:`.exporter`.  Lazy so importing the
+    package never pays for http.server."""
+    from .exporter import start_metrics_server as _start
+    return _start(port=port, addr=addr)
 
 # ---- standard families -------------------------------------------------------
 # dispatch (core/dispatch.py, fed through the op_recorder slot)

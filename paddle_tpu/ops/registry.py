@@ -643,11 +643,11 @@ g("unstack", lambda x: [x[i] for i in range(x.shape[0])], lambda: [U(3, 4)],
 g("unflatten", lambda x: x.reshape(3, 2, 2), lambda: [U(3, 4)], "manip",
   kwargs={"axis": 1, "shape": [2, 2]})
 g("gather", lambda x: x[[0, 2]], lambda: [U(4, 3)], "manip",
-  kwargs={"index": np.array([0, 2])})
+  kwargs={"index": np.array([0, 2], np.int32)})
 g("gather_nd", lambda x: x[[0, 2], [1, 2]], lambda: [U(3, 4)], "manip",
-  kwargs={"index": np.array([[0, 1], [2, 2]])})
+  kwargs={"index": np.array([[0, 1], [2, 2]], np.int32)})
 g("take", lambda x: x.reshape(-1)[[1, 5, 7]], lambda: [U(3, 4)], "manip",
-  kwargs={"index": np.array([1, 5, 7])})
+  kwargs={"index": np.array([1, 5, 7], np.int32)})
 g("take_along_axis",
   lambda x: np.take_along_axis(x, np.zeros((3, 1), np.int64), 1),
   lambda: [U(3, 4)], "manip",
@@ -663,7 +663,7 @@ def _put_along_axis_ref(x):
 g("put_along_axis", _put_along_axis_ref, lambda: [U(3, 4)], "manip",
   kwargs={"indices": np.zeros((3, 1), np.int32), "values": 9.0, "axis": 1})
 g("index_select", lambda x: x[[0, 2]], lambda: [U(4, 3)], "manip",
-  kwargs={"index": np.array([0, 2])})
+  kwargs={"index": np.array([0, 2], np.int32)})
 g("index_sample",
   lambda x: np.take_along_axis(x, np.zeros((3, 2), np.int64), 1),
   lambda: [U(3, 4)], "manip",
@@ -688,13 +688,15 @@ def _with_rows_set(x, rows, value):
 
 g("index_put", lambda x: _with_rows_set(x, [0, 1], np.ones((2, 3))),
   lambda: [U(4, 3)], "manip",
-  kwargs={"indices": (np.array([0, 1]),), "value": np.ones((2, 3), np.float32)})
+  kwargs={"indices": (np.array([0, 1], np.int32),),
+          "value": np.ones((2, 3), np.float32)})
 g("index_fill", lambda x: _with_rows_set(x, [0, 2], 7.0),
   lambda: [U(4, 3)], "manip",
-  kwargs={"index": np.array([0, 2]), "axis": 0, "value": 7.0})
+  kwargs={"index": np.array([0, 2], np.int32), "axis": 0, "value": 7.0})
 g("scatter", lambda x: _with_rows_set(x, [1, 0], np.ones((2, 3))),
   lambda: [U(4, 3)], "manip",
-  kwargs={"index": np.array([1, 0]), "updates": np.ones((2, 3), np.float32)})
+  kwargs={"index": np.array([1, 0], np.int32),
+          "updates": np.ones((2, 3), np.float32)})
 
 
 def _scatter_nd_ref():
@@ -714,8 +716,8 @@ def _scatter_nd_add_ref(x):
 
 
 g("scatter_nd_add", _scatter_nd_add_ref, lambda: [U(4, 3)], "manip",
-  kwargs={"index": np.array([[0], [2]]), "updates": np.ones((2, 3),
-                                                            np.float32)})
+  kwargs={"index": np.array([[0], [2]], np.int32),
+          "updates": np.ones((2, 3), np.float32)})
 def _slice_scatter_ref(x, src):
     out = np.asarray(x).copy()
     out[:, 2:4] = src
@@ -767,7 +769,7 @@ g("repeat_interleave", lambda x: np.repeat(x, 2, 1), lambda: [U(3, 4)],
 g("unique", None, lambda: [I(10, hi=4)], "manip", check=_chk_unique)
 g("unique_consecutive",
   lambda x: x[np.concatenate([[True], np.diff(x) != 0])],
-  lambda: [np.array([1, 1, 2, 2, 3, 1])], "manip")
+  lambda: [np.array([1, 1, 2, 2, 3, 1], np.int32)], "manip")
 g("pad", lambda x: np.pad(x, ((1, 1), (2, 2))), lambda: [U(3, 4)], "manip",
   kwargs={"pad": [1, 1, 2, 2]})
 g("unfold", lambda x: np.stack([x[0:4], x[2:6], x[4:8]]), lambda: [U(8)],

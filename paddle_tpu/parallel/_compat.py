@@ -1,0 +1,26 @@
+"""Version-portable ``shard_map`` import — the ONE place the jax version
+split lives (previously copy-pasted into every parallel layer).
+
+jax >= 0.4.35 exposes ``jax.shard_map`` with a ``check_vma`` kwarg; older
+releases only have ``jax.experimental.shard_map.shard_map``, which spells the
+same knob ``check_rep``.  Callers always write the new spelling
+(``check_vma=...``); the shim translates when running on the experimental
+namespace.
+
+This module is also the canonical symbol the graftlint
+``sharding-spec-coverage`` pass resolves: importing ``shard_map`` from here
+(rather than re-declaring the fallback) is what lets the analyzer see every
+call site.
+"""
+from __future__ import annotations
+
+try:                                     # jax >= 0.4.35 top-level home
+    from jax import shard_map
+except ImportError:                      # older jax: experimental namespace,
+    from jax.experimental.shard_map import shard_map as _shard_map_experimental
+
+    def shard_map(f, **kw):              # ...which spells check_vma check_rep
+        kw["check_rep"] = kw.pop("check_vma", True)
+        return _shard_map_experimental(f, **kw)
+
+__all__ = ["shard_map"]

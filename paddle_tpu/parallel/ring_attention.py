@@ -13,15 +13,8 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-try:                                     # jax >= 0.4.35 top-level home
-    from jax import shard_map
-except ImportError:                      # older jax: experimental namespace,
-    from jax.experimental.shard_map import shard_map as _shard_map_experimental
 
-    def shard_map(f, **kw):              # ...which spells check_vma check_rep
-        kw["check_rep"] = kw.pop("check_vma", True)
-        return _shard_map_experimental(f, **kw)
-
+from ._compat import shard_map
 from ..core.tensor import Tensor
 from ..core.dispatch import apply_op
 from ..distributed.collective import mesh_ppermute

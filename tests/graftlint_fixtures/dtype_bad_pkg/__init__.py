@@ -1,0 +1,51 @@
+# graftlint: disable-file=registry-parity  (mini-OpSpec, not a real registry)
+"""Lint fixture package: a miniature op registry with dtype-rule violations.
+
+Importable (the dtype-rules runtime half imports it via syspath), but
+self-contained — it mimics the real registry's shape (``REGISTRY`` of
+``OpSpec``-like entries built by a ``g`` helper) without touching the real
+``paddle_tpu.ops.REGISTRY``.
+"""
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class OpSpec:
+    name: str
+    category: str
+    np_ref: object = None
+    sample: object = None
+    kwargs: dict = field(default_factory=dict)
+    grad: bool = False
+    kind: str = "golden"
+
+
+REGISTRY: dict[str, OpSpec] = {}
+
+
+def g(name, ref, sample, cat, grad=False, **kw):
+    REGISTRY[name] = OpSpec(name, cat, np_ref=ref, sample=sample, grad=grad,
+                            **kw)
+    return REGISTRY[name]
+
+
+# DT101: int64 kwargs index array — the tensor layer narrows it to int32
+g("bad_index", lambda x: x[[0, 1]], lambda: [np.ones((3, 2), np.float32)],
+  "manip", kwargs={"index": np.array([0, 1], np.int64)})
+
+# DT101: float64 sample input
+g("bad_sample", lambda x: x * 2, lambda: [np.ones(3, np.float64)], "math")
+
+# DT103: grad=True with integer-only inputs
+g("bad_grad", lambda x: x + 1, lambda: [np.arange(4, dtype=np.int32)],
+  "math", grad=True)
+
+# DT102 (warning): float64 golden from float32 inputs
+g("f64_golden", lambda x: np.vander(x), lambda: [np.ones(3, np.float32)],
+  "math")
+
+# clean entry: no findings
+g("clean_op", lambda x: x + 1.0, lambda: [np.ones(3, np.float32)], "math",
+  grad=True)

@@ -1,0 +1,66 @@
+# graftlint: disable-file=trace-safety
+"""Lint fixture: shard_map contract violations, one per SS code.
+
+Never imported or executed — the sharding-spec-coverage pass reads it as
+source.  Each site below is intentionally wrong; tests assert the exact
+finding codes.
+"""
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+mesh = Mesh(jax.devices(), ("dp", "mp"))
+
+
+def body2(a, b):
+    return a + b
+
+
+def bad_in_arity(x):
+    # SS101: one spec for a two-argument body
+    f = shard_map(body2, mesh=mesh, in_specs=(P("dp"),), out_specs=P("dp"))
+    return f(x)
+
+
+def bad_spec_axis(x, y):
+    # SS102: 'ep' is not a mesh axis
+    f = shard_map(body2, mesh=mesh, in_specs=(P("dp"), P("ep")),
+                  out_specs=P("dp"))
+    return f(x, y)
+
+
+def body_unbound_collective(a):
+    # SS103: 'sep' is not bound by the surrounding shard_map's mesh
+    return jax.lax.psum(a, "sep")
+
+
+def bad_collective_axis(x):
+    f = shard_map(body_unbound_collective, mesh=mesh, in_specs=(P("dp"),),
+                  out_specs=P("dp"))
+    return f(x)
+
+
+def body_divergent(a):
+    s = a.sum()
+    if s > 0:
+        # SS104: collective under a branch on traced data — shards that skip
+        # the psum deadlock the ones that reach it
+        a = jax.lax.psum(a, "dp")
+    return a
+
+
+def bad_divergence(x):
+    f = shard_map(body_divergent, mesh=mesh, in_specs=(P("dp"),),
+                  out_specs=P("dp"))
+    return f(x)
+
+
+def body_triple(a, b):
+    return a, b, a
+
+
+def bad_out_arity(x, y):
+    # SS105: two out_specs for a three-tuple return
+    f = shard_map(body_triple, mesh=mesh, in_specs=(P("dp"), P("dp")),
+                  out_specs=(P("dp"), P("dp")))
+    return f(x, y)

@@ -1,0 +1,25 @@
+# graftlint: disable-file=trace-safety
+"""Lint fixture: contract-clean shard_map usage (partial-bound body, axes
+that exist, collective on a bound axis, static branch).  Must produce zero
+sharding-spec-coverage findings."""
+import functools
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+mesh = Mesh(jax.devices(), ("dp", "mp"))
+
+
+def _inner(a, b, scale, causal):
+    if causal:                       # static flag bound via partial — fine
+        a = a * 2
+    s = jax.lax.psum(a * scale, "dp")
+    return s + b
+
+
+def clean(x, y):
+    body = functools.partial(_inner, scale=2.0, causal=True)
+    f = shard_map(body, mesh=mesh, in_specs=(P("dp"), P("mp")),
+                  out_specs=P("dp"))
+    return f(x, y)

@@ -1,0 +1,7 @@
+# graftlint: disable-file=trace-safety
+"""Lint fixture: the shard_map body lives here; the (broken) call site is in
+sharding_xfile_use.py — exercises cross-file body resolution."""
+
+
+def xbody(a, b, c):
+    return a + b + c

@@ -1,0 +1,18 @@
+# graftlint: disable-file=trace-safety
+"""Lint fixture: shard_map over a body imported from another file.  The
+in_specs arity is wrong (2 specs, 3 params) — only detectable by resolving
+``xbody`` across files."""
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from sharding_xfile_def import xbody
+
+mesh = Mesh(jax.devices(), ("dp",))
+
+
+def bad_xfile_arity(x, y):
+    # SS101, cross-file: xbody takes three arrays
+    f = shard_map(xbody, mesh=mesh, in_specs=(P("dp"), P("dp")),
+                  out_specs=P("dp"))
+    return f(x, y)

@@ -13,10 +13,12 @@ import pytest
 
 from paddle_tpu.analysis import PASSES, run
 from paddle_tpu.analysis import cli
+from paddle_tpu.analysis.baseline import Baseline
 from paddle_tpu.analysis.cache import FileCache
 from paddle_tpu.analysis.framework import Finding, SourceFile
 
 REPO = pathlib.Path(__file__).resolve().parents[1]
+FIXTURES = pathlib.Path(__file__).resolve().parent / "graftlint_fixtures"
 
 
 def _lint(tmp_path, source, select=None, name="fixture.py"):
@@ -320,6 +322,159 @@ def test_no_adhoc_telemetry_line_pragma(tmp_path):
     assert res.findings == [] and res.suppressed == 2
 
 
+# ----------------------------------------------- sharding-spec-coverage
+
+def _sharding(paths):
+    return run([str(p) for p in paths], select=["sharding-spec-coverage"])
+
+
+def test_sharding_spec_catches_seeded_violations():
+    res = _sharding([FIXTURES / "sharding_bad.py"])
+    assert _codes(res) == {"SS101", "SS102", "SS103", "SS104", "SS105"}
+    by_code = {f.code: f for f in res.findings}
+    assert "2 positional argument(s)" in by_code["SS101"].message
+    assert "'ep'" in by_code["SS102"].message
+    assert "'sep'" in by_code["SS103"].message
+    assert by_code["SS104"].severity == "warning"       # divergence risk
+    assert "3-tuple" in by_code["SS105"].message
+    assert all(f.severity == "error" for f in res.findings
+               if f.code != "SS104")
+    assert all(f.hint for f in res.findings)
+
+
+def test_sharding_spec_clean_fixture_not_flagged():
+    res = _sharding([FIXTURES / "sharding_clean.py"])
+    assert res.findings == []
+
+
+def test_sharding_spec_resolves_body_across_files():
+    res = _sharding([FIXTURES / "sharding_xfile_def.py",
+                     FIXTURES / "sharding_xfile_use.py"])
+    assert _codes(res) == {"SS101"}
+    (f,) = res.findings
+    assert f.path.endswith("sharding_xfile_use.py")
+    assert "3 positional argument(s)" in f.message
+
+
+def test_sharding_spec_repo_parallel_tree_is_clean():
+    res = _sharding([REPO / "paddle_tpu" / "parallel",
+                     REPO / "paddle_tpu" / "distributed"])
+    assert res.findings == [], "\n" + "\n".join(
+        f.render() for f in res.findings)
+
+
+def test_sharding_spec_skips_dynamic_specs(tmp_path):
+    # non-literal specs / meshes must be skipped, never guessed
+    src = """
+        from jax.experimental.shard_map import shard_map
+
+        def apply(fn, mesh, in_specs, out_specs, x):
+            f = shard_map(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs)
+            return f(x)
+    """
+    res = _lint(tmp_path, src, select=["sharding-spec-coverage"])
+    assert res.findings == []
+
+
+# --------------------------------------------------------------- dtype-rules
+
+def test_dtype_rules_catches_seeded_violations(monkeypatch):
+    monkeypatch.syspath_prepend(str(FIXTURES))
+    importlib.invalidate_caches()
+    res = run([str(FIXTURES / "dtype_bad_pkg")], select=["dtype-rules"])
+    codes = _codes(res)
+    assert codes == {"DT101", "DT102", "DT103"}
+    flagged = {f.message.split("'")[1] for f in res.findings}
+    assert flagged == {"bad_index", "bad_sample", "bad_grad", "f64_golden"}
+    by_op = {f.message.split("'")[1]: f for f in res.findings}
+    assert by_op["bad_index"].severity == "error"
+    assert by_op["f64_golden"].severity == "warning"
+    # findings land on the registration line of the offending op
+    assert by_op["bad_index"].line != by_op["bad_grad"].line
+
+
+def test_dtype_rules_warning_not_in_errors(monkeypatch):
+    monkeypatch.syspath_prepend(str(FIXTURES))
+    importlib.invalidate_caches()
+    res = run([str(FIXTURES / "dtype_bad_pkg")], select=["dtype-rules"])
+    assert all(f.code != "DT102" for f in res.errors())
+    assert any(f.code == "DT102" for f in res.findings)
+
+
+def test_dtype_rules_skips_non_registry_files(tmp_path):
+    res = _lint(tmp_path, "import numpy as np\nx = np.array([1])\n",
+                select=["dtype-rules"])
+    assert res.findings == []
+
+
+# ------------------------------------------------------- baseline workflow
+
+def test_baseline_absorbs_recorded_findings(tmp_path):
+    res = _sharding([FIXTURES / "sharding_bad.py"])
+    assert res.findings
+    bpath = str(tmp_path / "base.json")
+    assert Baseline.write(bpath, res.findings) == len(res.findings)
+    res2 = run([str(FIXTURES / "sharding_bad.py")],
+               select=["sharding-spec-coverage"],
+               baseline=Baseline.load(bpath))
+    assert res2.findings == [] and res2.baselined == len(res.findings)
+
+
+def test_baseline_missing_file_is_empty():
+    assert len(Baseline.load("/nonexistent/base.json")) == 0
+
+
+def test_fingerprint_is_path_and_line_independent():
+    a = Finding("p", "C1", "/abs/elsewhere/paddle_tpu/ops/x.py", 3, "m")
+    b = Finding("p", "C1", "paddle_tpu/ops/x.py", 99, "m")
+    c = Finding("p", "C1", "paddle_tpu/ops/x.py", 99, "other message")
+    assert a.fingerprint() == b.fingerprint() != c.fingerprint()
+
+
+def test_cli_baseline_workflow(tmp_path, capsys, monkeypatch):
+    monkeypatch.syspath_prepend(str(FIXTURES))
+    importlib.invalidate_caches()
+    bpath = str(tmp_path / "base.json")
+    assert cli.main([str(FIXTURES), "--no-cache",
+                     "--write-baseline", bpath]) == 0
+    capsys.readouterr()
+    assert cli.main([str(FIXTURES), "--no-cache", "--baseline", bpath]) == 0
+    assert "baselined" in capsys.readouterr().out
+
+
+def test_cli_fail_on_warning(tmp_path, capsys, monkeypatch):
+    # a registry whose only finding is the DT102 warning
+    pkg = tmp_path / "warnonly_pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text(textwrap.dedent("""
+        # graftlint: disable-file=registry-parity
+        import numpy as np
+
+        class OpSpec:
+            def __init__(self, name, np_ref, sample):
+                self.name, self.np_ref, self.sample = name, np_ref, sample
+                self.kwargs, self.grad, self.kind = {}, False, "golden"
+                self.category, self.check, self.alias_of = "math", None, None
+
+            def resolve(self):
+                return self.np_ref
+
+        REGISTRY = {}
+
+        def g(name, ref, sample, cat):
+            REGISTRY[name] = OpSpec(name, ref, sample)
+
+        g("wide", lambda x: np.vander(x), lambda: [np.ones(3, np.float32)],
+          "math")
+    """))
+    monkeypatch.syspath_prepend(str(tmp_path))
+    importlib.invalidate_caches()
+    assert cli.main([str(pkg), "--no-cache"]) == 0
+    capsys.readouterr()
+    assert cli.main([str(pkg), "--no-cache", "--fail-on", "warning"]) == 1
+
+
 # ----------------------------------------------------- framework: pragmas etc.
 
 def test_line_pragma_suppresses(tmp_path):
@@ -374,14 +529,34 @@ def test_cache_replay_matches_fresh_run(tmp_path):
     assert r3.cache_hits == 0
 
 
+def test_cache_invalidated_on_pass_version_bump(tmp_path, monkeypatch):
+    p = tmp_path / "bad.py"
+    p.write_text(textwrap.dedent(TS_BAD))
+    cpath = str(tmp_path / "cache.json")
+    run([str(p)], select=["trace-safety"], cache=FileCache(cpath))
+    r2 = run([str(p)], select=["trace-safety"], cache=FileCache(cpath))
+    assert r2.cache_hits == 1
+    ts = PASSES["trace-safety"]
+    monkeypatch.setattr(ts, "version", ts.version + 1)
+    r3 = run([str(p)], select=["trace-safety"], cache=FileCache(cpath))
+    assert r3.cache_hits == 0
+    assert [f.to_dict() for f in r3.findings] == \
+           [f.to_dict() for f in r2.findings]
+
+
 def test_finding_dict_round_trip():
-    f = Finding("trace-safety", "TS101", "a.py", 3, "msg", "hint")
+    f = Finding("trace-safety", "TS101", "a.py", 3, "msg", "hint", "warning")
     assert Finding.from_dict(f.to_dict()) == f
+    # pre-severity cache records default to error
+    d = f.to_dict()
+    del d["severity"]
+    assert Finding.from_dict(d).severity == "error"
 
 
 def test_builtin_passes_registered():
     assert {"trace-safety", "registry-parity", "namespace-parity",
-            "jit-cache-hygiene", "no-adhoc-telemetry"} <= set(PASSES)
+            "jit-cache-hygiene", "no-adhoc-telemetry",
+            "sharding-spec-coverage", "dtype-rules"} <= set(PASSES)
 
 
 def test_unknown_pass_rejected(tmp_path):
@@ -421,14 +596,56 @@ def test_cli_list_passes(capsys):
     assert cli.main(["--list-passes"]) == 0
     out = capsys.readouterr().out
     assert "trace-safety" in out and "registry-parity" in out
+    assert "sharding-spec-coverage" in out and "dtype-rules" in out
+
+
+def test_cli_sarif_output_valid(capsys, monkeypatch):
+    monkeypatch.syspath_prepend(str(FIXTURES))
+    importlib.invalidate_caches()
+    rc = cli.main([str(FIXTURES), "--no-cache", "--format", "sarif"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert data["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in data["$schema"]
+    (sarif_run,) = data["runs"]
+    driver = sarif_run["tool"]["driver"]
+    assert driver["name"] == "graftlint"
+    rule_ids = [r["id"] for r in driver["rules"]]
+    assert rule_ids == sorted(rule_ids)
+    # findings from BOTH new passes are present
+    assert {"SS101", "SS104", "DT101", "DT102"} <= set(rule_ids)
+    levels = set()
+    for r in sarif_run["results"]:
+        assert r["ruleId"] == rule_ids[r["ruleIndex"]]
+        levels.add(r["level"])
+        (loc,) = r["locations"]
+        phys = loc["physicalLocation"]
+        assert phys["artifactLocation"]["uri"]
+        assert phys["region"]["startLine"] >= 1
+        assert r["fingerprints"]["graftlint/v1"]
+    assert {"error", "warning"} <= levels
+
+
+def test_cli_json_reports_severity_and_baseline(capsys):
+    rc = cli.main([str(FIXTURES / "sharding_bad.py"), "--no-cache",
+                   "--format", "json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert "baselined" in data
+    severities = {f["severity"] for f in data["findings"]}
+    assert severities == {"error", "warning"}
 
 
 # ------------------------------------------------------- repo self-check gate
 
 def test_repo_tree_is_clean(tmp_path):
-    """The tier-1 CI gate: graftlint must exit clean on paddle_tpu/."""
+    """The tier-1 CI gate: every pass (the sharding/dtype ones included) must
+    exit clean on paddle_tpu/ at error severity; the accepted warnings live
+    in the committed baseline."""
     res = run([str(REPO / "paddle_tpu")],
-              cache=FileCache(str(tmp_path / "cache.json")))
+              cache=FileCache(str(tmp_path / "cache.json")),
+              baseline=Baseline.load(str(REPO / ".graftlint-baseline.json")))
     assert res.files > 100
+    assert {"sharding-spec-coverage", "dtype-rules"} <= set(res.passes)
     assert not res.findings, "\n" + "\n".join(
         f.render() for f in res.findings)

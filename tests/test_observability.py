@@ -183,6 +183,40 @@ class TestPrometheus:
         assert 'test_round_total{k="x"} 42' in obs.render_prometheus()
 
 
+# ------------------------------------------------------ pull endpoint (HTTP)
+
+class TestMetricsServer:
+    def test_scrape_returns_current_exposition(self, metrics):
+        import urllib.request
+        c = REGISTRY.counter("test_scrape_total", "t")
+        c.inc(5)
+        with obs.start_metrics_server(port=0) as server:
+            assert server.url.endswith(f":{server.port}/metrics")
+            with urllib.request.urlopen(server.url, timeout=5) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith("text/plain")
+                body = resp.read().decode("utf-8")
+            typed, _ = _assert_valid_exposition(body)
+            assert typed["test_scrape_total"] == "counter"
+            assert "test_scrape_total 5" in body
+            # scrapes render live state, not a startup snapshot
+            c.inc(2)
+            with urllib.request.urlopen(server.url, timeout=5) as resp:
+                assert "test_scrape_total 7" in resp.read().decode("utf-8")
+
+    def test_unknown_path_is_404_and_close_releases_port(self, metrics):
+        import urllib.error
+        import urllib.request
+        server = obs.start_metrics_server(port=0)
+        url = f"http://{server.addr}:{server.port}/nope"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(url, timeout=5)
+        assert e.value.code == 404
+        server.close()
+        with pytest.raises(OSError):
+            urllib.request.urlopen(server.url, timeout=1)
+
+
 # ---------------------------------------------------------- dispatch recorder
 
 class TestDispatch:
