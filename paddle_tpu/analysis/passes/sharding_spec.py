@@ -23,6 +23,10 @@ Checks (codes):
           shards that skip the collective deadlock the ones that don't
           [warning]
   * SS105 out_specs tuple arity != the body's returned tuple arity
+  * SS106 ``NamedSharding(mesh, spec)`` (any site — direct, inside
+          ``with_sharding_constraint``, ``jax.device_put``, ...) whose
+          literal PartitionSpec names an axis the (literal) mesh does not
+          define
 
 Everything literal-or-resolvable is checked; dynamic specs/meshes/axis names
 are skipped, never guessed — a lint finding here should always be real.
@@ -32,8 +36,9 @@ from __future__ import annotations
 import ast
 
 from ..framework import AnalysisPass, Finding, Project, register_pass
-from ..resolve import (Imports, collective_axis_arg, is_partition_spec,
-                       is_shard_map, mesh_axis_names, _literal_axis_names)
+from ..resolve import (Imports, collective_axis_arg, is_named_sharding,
+                       is_partition_spec, is_shard_map, mesh_axis_names,
+                       _literal_axis_names)
 from .trace_safety import _is_tainted, _scan, _target_names
 
 _HINTS = {
@@ -47,6 +52,8 @@ _HINTS = {
              "jnp.where/lax.cond so every shard executes it",
     "SS105": "return one value per out_specs entry (or collapse out_specs "
              "to a single spec for a pytree result)",
+    "SS106": "NamedSharding specs may only name axes its mesh defines; fix "
+             "the PartitionSpec axis or add the axis to the mesh",
 }
 
 _PARTIAL = ("functools.partial", "partial")
@@ -105,10 +112,11 @@ def _spec_axes(node, imports):
 @register_pass
 class ShardingSpecPass(AnalysisPass):
     name = "sharding-spec-coverage"
-    version = 1
+    version = 2
     description = ("shard_map contract checks: in/out_specs arity, spec and "
                    "collective axis names vs the mesh, collectives under "
-                   "data-dependent control flow")
+                   "data-dependent control flow, NamedSharding/"
+                   "with_sharding_constraint spec-vs-mesh axis validity")
     project_scope = True    # resolves bodies across files
 
     def check_project(self, project: Project) -> list[Finding]:
@@ -141,9 +149,15 @@ class ShardingSpecPass(AnalysisPass):
     # ---- traversal -------------------------------------------------------
     def _walk(self, node, scopes, src, imports, findings):
         for child in ast.iter_child_nodes(node):
-            if isinstance(child, ast.Call) \
-                    and is_shard_map(imports.canonical(child.func)):
-                self._check_site(child, scopes, src, imports, findings)
+            if isinstance(child, ast.Call):
+                canon = imports.canonical(child.func)
+                if is_shard_map(canon):
+                    self._check_site(child, scopes, src, imports, findings)
+                elif is_named_sharding(canon):
+                    # covers every construction site: with_sharding_constraint
+                    # / device_put arguments are visited by this same walk
+                    self._check_named_sharding(child, scopes, src, imports,
+                                               findings)
             if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._walk(child, [child] + scopes, src, imports, findings)
             else:
@@ -261,6 +275,24 @@ class ShardingSpecPass(AnalysisPass):
 
         if body is not None:
             self._sweep_body(body, mesh_axes, emit)
+
+    def _check_named_sharding(self, call, scopes, src, imports, findings):
+        """SS106: NamedSharding(mesh, spec) whose literal spec names an axis
+        the (literal) mesh does not define.  Same skip-don't-guess policy as
+        the shard_map checks: either side dynamic -> no finding."""
+        mesh_node = call.args[0] if call.args else _kwarg(call, "mesh")
+        spec_node = (call.args[1] if len(call.args) > 1
+                     else _kwarg(call, "spec"))
+        mesh_axes = self._mesh_axes(mesh_node, scopes, src)
+        if mesh_axes is None or spec_node is None:
+            return
+        for name, line in _spec_axes(spec_node, imports):
+            if name not in mesh_axes:
+                findings.append(Finding(
+                    self.name, "SS106", src.path, line,
+                    f"NamedSharding spec names axis '{name}' but its mesh "
+                    f"only defines ({', '.join(mesh_axes)})",
+                    _HINTS["SS106"], "error"))
 
     @staticmethod
     def _return_tuple_arity(fn):

@@ -79,6 +79,7 @@ _SHARD_MAP = ("jax.shard_map", "jax.experimental.shard_map.shard_map",
               "parallel._compat.shard_map", "_compat.shard_map", "shard_map")
 _PARTITION_SPEC = ("jax.sharding.PartitionSpec",
                    "jax.experimental.pjit.PartitionSpec", "PartitionSpec")
+_NAMED_SHARDING = ("jax.sharding.NamedSharding", "NamedSharding")
 # canonical-path suffix -> positional index of the axis-name argument
 _COLLECTIVES = {
     "lax.psum": 1, "lax.pmean": 1, "lax.pmax": 1, "lax.pmin": 1,
@@ -98,6 +99,10 @@ def is_shard_map(canon: str | None) -> bool:
 
 def is_partition_spec(canon: str | None) -> bool:
     return _match(canon, _PARTITION_SPEC)
+
+
+def is_named_sharding(canon: str | None) -> bool:
+    return _match(canon, _NAMED_SHARDING)
 
 
 def collective_axis_arg(canon: str | None):

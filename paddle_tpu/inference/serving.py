@@ -53,7 +53,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import observability as _obs
 
-__all__ = ["LLMEngine", "Request"]
+__all__ = ["LLMEngine", "Request", "SpecConfig"]
 
 _MAXK = 64        # static cap for per-slot dynamic top-k filtering
 
@@ -86,6 +86,10 @@ class _EngineMetrics:
         self.cached_pages = _obs.SERVING_CACHED_PAGES.labels(**e)
         self.reclaimable = _obs.SERVING_RECLAIMABLE_PAGES.labels(**e)
         self.free_pages = _obs.SERVING_FREE_PAGES.labels(**e)
+        self.verify = _obs.SERVING_DISPATCHES.labels(kind="verify", **e)
+        self.spec_proposed = _obs.SERVING_SPEC_PROPOSED.labels(**e)
+        self.spec_accepted = _obs.SERVING_SPEC_ACCEPTED.labels(**e)
+        self.spec_acceptance = _obs.SERVING_SPEC_ACCEPTANCE.labels(**e)
 
 
 class Request:
@@ -166,6 +170,79 @@ def _sample_row(logits, greedy, temp, topp, topk, seed):
     return jnp.where(greedy > 0, amax, tok).astype(jnp.int32)
 
 
+def _ceil_pow2(n):
+    return 1 << max(0, int(n) - 1).bit_length() if n > 1 else 1
+
+
+class SpecConfig:
+    """Speculative-decoding knob (``LLMEngine(spec_decode=SpecConfig())``).
+
+    max_draft: most draft tokens proposed per request per verify step.
+    ngram_max / ngram_min: window bounds for the self-drafting n-gram
+        proposer — the request's current n-token suffix (longest n first)
+        is matched against its own earlier prompt+generated tokens, and
+        the tokens that followed the most recent match become the draft.
+        Free (no extra weights); wins on repetitive structure (code,
+        retrieved context, templated text).
+    draft_model: optional small LlamaForCausalLM replacing the n-gram
+        proposer — greedy continuation of the request's token history.
+    adaptive: learn the verify dispatch's cost curve t(rows) = RTT+rows*c
+        (separately from the decode-block auto-fit: a verify step consumes
+        a VARIABLE number of tokens) and pick the draft length maximizing
+        expected accepted tokens per second under the observed acceptance
+        rate; False always proposes max_draft."""
+
+    def __init__(self, max_draft=4, ngram_max=3, ngram_min=1,
+                 draft_model=None, adaptive=True):
+        if int(max_draft) < 1:
+            raise ValueError("max_draft must be >= 1")
+        if int(ngram_min) < 1 or int(ngram_max) < int(ngram_min):
+            raise ValueError("need 1 <= ngram_min <= ngram_max")
+        self.max_draft = int(max_draft)
+        self.ngram_max = int(ngram_max)
+        self.ngram_min = int(ngram_min)
+        self.draft_model = draft_model
+        self.adaptive = bool(adaptive)
+
+
+class _NgramProposer:
+    """Self-drafting proposer: find the most recent earlier occurrence of
+    the sequence's current suffix (longest n in [ngram_min, ngram_max]
+    wins) and propose the tokens that followed that occurrence."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+
+    def propose(self, tokens, k):
+        n_tok = len(tokens)
+        hi = min(self.cfg.ngram_max, n_tok - 1)
+        for n in range(hi, self.cfg.ngram_min - 1, -1):
+            suffix = tokens[n_tok - n:]
+            for i in range(n_tok - n - 1, -1, -1):
+                if tokens[i:i + n] == suffix:
+                    cont = tokens[i + n:i + n + k]
+                    if cont:
+                        return list(cont)
+        return []
+
+
+class _DraftModelProposer:
+    """Draft-model proposer: greedy continuation from a small model. The
+    draft recomputes from the full token history each call (no persistent
+    draft KV) — drafts are short and the draft model is small, so clarity
+    beats cache bookkeeping here."""
+
+    def __init__(self, model):
+        self.model = model
+
+    def propose(self, tokens, k):
+        from .. import to_tensor
+        ids = to_tensor(np.asarray([tokens], np.int64))
+        out = self.model.generate(ids, max_new_tokens=k, do_sample=False)
+        seq = np.asarray(out._data).reshape(-1)
+        return [int(t) for t in seq[len(tokens):]]
+
+
 class LLMEngine:
     """Continuous-batching paged-KV engine over a LlamaForCausalLM."""
 
@@ -175,7 +252,7 @@ class LLMEngine:
                  max_batch=4, max_len=256, page_size=16, prefill_chunk=32,
                  page_pool=None, decode_block=1, use_kernel=None, seed=0,
                  kv_cache_dtype="auto", decode_block_max=32,
-                 prefix_cache=False):
+                 prefix_cache=False, spec_decode=None):
         """page_pool: usable KV pages (the HBM budget). Defaults to the
         worst case (max_batch * ceil(max_len/page)); set it SMALLER to
         oversubscribe — on-demand growth means slots only claim what they
@@ -215,7 +292,24 @@ class LLMEngine:
         incubate block_multihead_attention cache_*_quant_scales, dynamic
         mode) — pages cost (D + 4)/(2*D) of bf16 bytes (~0.52 at
         head_dim=128), so the same HBM budget holds ~2x the tokens /
-        concurrent slots."""
+        concurrent slots.
+
+        spec_decode: a :class:`SpecConfig` enables speculative decoding —
+        each step a proposer drafts up to max_draft continuation tokens per
+        request (self-drafting n-gram suffix match by default, or a small
+        draft model) and ONE target-model forward scores the pending token
+        plus every draft at consecutive positions (multi-query paged
+        attention). Acceptance is the standard token-match rule — the
+        longest draft prefix that equals what the target would have
+        sampled — which for the deterministic proposers here is exact
+        rejection sampling, so greedy and fixed-seed sampled outputs are
+        token-identical to a spec-off engine. Accepted tokens all land in
+        one dispatch (up to max_draft+1 tokens/step); rejected drafts roll
+        their provisional KV pages back through the page-pool refcounts
+        (a partially-filled page is truncated, never shared). Steps where
+        no request has a draft fall through to the normal decode-block
+        path. Counters: :meth:`spec_stats`, plus ``spec_proposed_total`` /
+        ``spec_accepted_total`` / acceptance histogram in the registry."""
         cfg = model.config
         self.cfg = cfg
         self.max_batch = max_batch
@@ -337,14 +431,32 @@ class LLMEngine:
         else:
             self.decode_block = max(1, int(decode_block))
         self._decode_programs: dict = {}
+        # speculative decoding (off unless spec_decode is a SpecConfig)
+        self._spec = spec_decode
+        if self._spec is not None:
+            self._proposer = (
+                _DraftModelProposer(self._spec.draft_model)
+                if self._spec.draft_model is not None
+                else _NgramProposer(self._spec))
+        self._verify_programs: dict = {}
+        self._spec_samples: dict = {}   # verify rows -> recent wall dts
+        self._spec_accept_ema = None    # EMA of per-step acceptance ratio
+        self.spec_proposed = 0          # draft tokens sent to verification
+        self.spec_accepted = 0          # draft tokens that matched
+        self.spec_emitted = 0           # tokens emitted by verify steps
+        self.spec_dispatches = 0        # verify programs dispatched
         self._m = _EngineMetrics(str(LLMEngine._engine_seq))
         LLMEngine._engine_seq += 1
         self._prefill = self._build_prefill()
 
     # ---------------------------------------------------------------- layers
-    def _layer_fn(self, page_idx, within, tables, ctx, pos):
-        """Shared per-layer body for decode and prefill (they differ only in
-        how many rows ride the batch dim and where those rows' pages are)."""
+    def _layer_fn(self, page_idx, within, tables, ctx, pos, mq=None):
+        """Shared per-layer body for decode, prefill, and speculative
+        verification (they differ only in how many rows ride the batch dim
+        and where those rows' pages are). With ``mq=(B, Q)`` the flat rows
+        are B sequences x Q consecutive query positions and attention goes
+        through the multi-query kernel (tables [B, S]; ctx [B] is row 0's
+        context length, row j sees ctx+j); KV writes stay per-flat-row."""
         nh, kvh, D = self.nh, self.kvh, self.D
         eps = self.cfg.rms_norm_eps
         theta = self.cfg.rope_theta
@@ -353,9 +465,10 @@ class LLMEngine:
         quant = self.kv_quant
 
         def layer(carry, wl):
-            from ..ops.pallas.paged_attention import (paged_attention,
-                                                      paged_attention_ref,
-                                                      quantize_kv)
+            from ..ops.pallas.paged_attention import (
+                paged_attention, paged_attention_multiquery,
+                paged_attention_multiquery_ref, paged_attention_ref,
+                quantize_kv)
             x, = carry
             h = _rms(x, wl["ln1"], eps)
             q = (h @ wl["wq"]).reshape(-1, nh, D)
@@ -363,7 +476,17 @@ class LLMEngine:
             v = (h @ wl["wv"]).reshape(-1, kvh, D)
             q = _rope(q, pos, theta)
             k = _rope(k, pos, theta)
-            attn = paged_attention if use_kernel else paged_attention_ref
+            if mq is None:
+                attn = paged_attention if use_kernel else paged_attention_ref
+            else:
+                Bq, Q = mq
+                base = (paged_attention_multiquery if use_kernel
+                        else paged_attention_multiquery_ref)
+
+                def attn(qx, kp, vp, tb, cl, **kw):
+                    out = base(qx.reshape(Bq, Q, nh, D), kp, vp, tb, cl,
+                               **kw)
+                    return out.reshape(Bq * Q, nh, D)
             if quant:
                 kq, ksc = quantize_kv(k)
                 vq, vsc = quantize_kv(v)
@@ -479,6 +602,59 @@ class LLMEngine:
             return nxt, cache2
 
         return jax.jit(prefill, donate_argnums=(1,))
+
+    def _build_verify(self, Kv):
+        """ONE forward scoring Kv consecutive positions per request — the
+        speculative-decoding verifier. Row 0 carries the pending token
+        (what plain decode would feed), rows 1..n the proposed drafts;
+        sampling row j yields the target model's token AFTER draft j, so
+        the host accepts the longest draft prefix matching the sampled
+        tokens and emits accepted+1 tokens from a single dispatch. All Kv
+        KV writes land in-graph; the host rolls back pages past the
+        accepted point afterwards (attention masks by context length, so
+        stale writes beyond a slot's length are never attended)."""
+        cfg = self.cfg
+        page = self.page
+        eps = cfg.rms_norm_eps
+        trash = self.trash_page
+        B = self.max_batch
+
+        def verify(W, cache, tokens, lens, tables, n_rows,
+                   greedy, temp, topp, topk, seeds, fold):
+            # tokens [B, Kv] int32 (row 0 = pending, 1.. = drafts, rest
+            # padding); lens [B] tokens already cached; n_rows [B] valid
+            # rows (0 = inactive slot); sampling params [B] as in decode.
+            row_j = jnp.tile(jnp.arange(Kv, dtype=jnp.int32), B)  # [B*Kv]
+
+            def rep(a):
+                return jnp.repeat(a, Kv)
+
+            pos = rep(lens.astype(jnp.int32)) + row_j
+            valid = row_j < rep(n_rows)
+            page_idx = jnp.take_along_axis(
+                tables, (pos // page).reshape(B, Kv), axis=1).reshape(-1)
+            page_idx = jnp.where(valid, page_idx, trash)
+            within = pos % page
+            # row 0 of an active request sees lens+1 tokens (its own write
+            # included); the multi-query kernel extends by +j per row
+            cl = jnp.where(n_rows > 0, lens + 1, 1).astype(jnp.int32)
+            x = W["embed"][tokens.reshape(-1)]            # [B*Kv, H]
+            layer = self._layer_fn(page_idx, within, tables, cl, pos,
+                                   mq=(B, Kv))
+            x, cache2 = self._scan_layers(W, cache, x, layer)
+            h = _rms(x, W["norm"], eps)
+            logits = h.astype(jnp.float32) @ W["head"].astype(jnp.float32)
+            # seed schedule mirrors the decode block's `seeds + i*fold`:
+            # emitted token #j of this step draws the key step #j of a
+            # non-speculative block would have drawn, so fixed-seed
+            # (fold=0) and greedy requests stay token-exact vs spec-off
+            seeds_rep = rep(seeds) + row_j * rep(fold)
+            toks = jax.vmap(_sample_row)(
+                logits, rep(greedy), rep(temp), rep(topp), rep(topk),
+                seeds_rep)
+            return toks.reshape(B, Kv), cache2
+
+        return jax.jit(verify, donate_argnums=(1,))
 
     # ------------------------------------------------------------- scheduling
     def add_request(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
@@ -767,6 +943,12 @@ class LLMEngine:
         live = [(s, r) for s, r in enumerate(self._slots) if r is not None]
         if not live:
             return 0
+        if self._spec is not None:
+            props = self._propose_drafts(live)
+            if any(props.values()):
+                return self._spec_step(live, props)
+            # no slot has a draft this step: the plain decode block below
+            # amortizes dispatch cost better than a 1-row verify would
         # block size: largest power of two <= every slot's remaining budget,
         # capped by decode_block (or the RTT-adapted target in auto mode);
         # any eos request needs per-token host inspection -> 1
@@ -832,6 +1014,181 @@ class LLMEngine:
                 self._lens[slot] += 1
                 self._emit(slot, int(toks[j, slot]))
         return len(live)
+
+    # ---------------------------------------------------- speculative decode
+    def _propose_drafts(self, live):
+        """Draft continuation tokens per live slot, capped so that drafts+1
+        emitted tokens can neither exceed the request's remaining budget nor
+        run past max_len."""
+        props = {}
+        target = self._spec_draft_target()
+        for slot, r in live:
+            cap = min(target, r.max_new - len(r.out) - 1,
+                      self.max_len - int(self._lens[slot]) - 1)
+            if cap < 1:
+                props[slot] = []
+                continue
+            # full token history (prompt0+out survives preemption re-folds)
+            props[slot] = self._proposer.propose(r.prompt0 + r.out, cap)[:cap]
+        return props
+
+    def _spec_step(self, live, props):
+        """One speculative step: verify every live slot's pending token plus
+        its drafts in a single multi-query dispatch, emit the accepted run,
+        roll rejected pages back. Slots without a proposal ride along with
+        one row (their pending token advances normally)."""
+        for slot, r in live:
+            if self._slots[slot] is not r:
+                continue        # preempted by an earlier slot's growth
+            self._ensure_page(slot, ahead=len(props.get(slot, ())) + 1)
+        live = [(s, r) for s, r in live if self._slots[s] is r]
+        if not live:
+            return 0
+        Kv = _ceil_pow2(max(len(props.get(s, ())) + 1 for s, _ in live))
+        tokens = np.zeros((self.max_batch, Kv), np.int32)
+        n_rows = np.zeros((self.max_batch,), np.int32)
+        greedy = np.ones((self.max_batch,), np.int32)
+        temp = np.ones((self.max_batch,), np.float32)
+        topp = np.ones((self.max_batch,), np.float32)
+        topk = np.zeros((self.max_batch,), np.int32)
+        seeds = np.zeros((self.max_batch,), np.int32)
+        fold = np.zeros((self.max_batch,), np.int32)
+        for slot, r in live:
+            drafts = props.get(slot, [])
+            n_rows[slot] = 1 + len(drafts)
+            tokens[slot, 0] = r.out[-1]
+            tokens[slot, 1:1 + len(drafts)] = drafts
+            greedy[slot] = 0 if r.do_sample else 1
+            temp[slot] = r.temperature
+            topp[slot] = r.top_p
+            topk[slot] = r.top_k
+            seeds[slot] = self._next_seed(r)
+            fold[slot] = 1 if r.seed is None else 0
+        prog = self._verify_programs.get(Kv)
+        compile_call = prog is None
+        if compile_call:
+            prog = self._verify_programs[Kv] = self._build_verify(Kv)
+        self.spec_dispatches += 1
+        self._m.verify.inc()
+        t0 = time.perf_counter()
+        with _obs.trace_span("serving.verify"):
+            toks, self.cache = prog(
+                self.W, self.cache, jnp.asarray(tokens),
+                jnp.asarray(self._lens), jnp.asarray(self._slot_tables),
+                jnp.asarray(n_rows), jnp.asarray(greedy), jnp.asarray(temp),
+                jnp.asarray(topp), jnp.asarray(topk), jnp.asarray(seeds),
+                jnp.asarray(fold))
+            toks = np.asarray(toks)                      # [B, Kv]
+        dt = time.perf_counter() - t0
+        if self._spec.adaptive and not compile_call:
+            self._record_verify_sample(Kv, dt)
+        proposed = accepted = 0
+        for slot, r in live:
+            drafts = props.get(slot, [])
+            n = len(drafts)
+            t = toks[slot]
+            # accept the longest draft prefix the target would have sampled
+            # itself: draft j+1 (fed at row j+1) survives iff it equals the
+            # token sampled from row j's logits
+            a = 0
+            while a < n and drafts[a] == int(t[a]):
+                a += 1
+            proposed += n
+            accepted += a
+            m = a + 1                                    # tokens to emit
+            for j in range(m):
+                if self._slots[slot] is not r:
+                    break        # eos / max_new released the slot mid-run
+                self._lens[slot] += 1
+                self._emit(slot, int(t[j]))
+                self.spec_emitted += 1
+            if self._slots[slot] is r:
+                # roll back KV pages provisioned for rejected drafts
+                self._truncate_pages(slot)
+            if not compile_call and _obs.enabled():
+                self._m.token_latency.observe(dt / m)
+        self.spec_proposed += proposed
+        self.spec_accepted += accepted
+        self._m.spec_proposed.inc(proposed)
+        self._m.spec_accepted.inc(accepted)
+        if proposed:
+            ratio = accepted / proposed
+            self._m.spec_acceptance.observe(ratio)
+            self._spec_accept_ema = (
+                ratio if self._spec_accept_ema is None
+                else 0.9 * self._spec_accept_ema + 0.1 * ratio)
+        return len(live)
+
+    def _truncate_pages(self, slot):
+        """Free pages past ceil(lens/page) back to the pool — the rollback
+        half of speculative decoding. Safe by construction: pages past the
+        prompt are always privately allocated (refcount 1) and never
+        registered in the prefix index, so a partially-filled page is
+        truncated, never shared; the stale KV beyond lens is unreachable
+        because attention masks by context length."""
+        lens = int(self._lens[slot])
+        needed = max(1, (lens + self.page - 1) // self.page)
+        na = int(self._n_alloc[slot])
+        if na <= needed:
+            return
+        for j in range(needed, na):
+            self._unref_page(int(self._slot_tables[slot, j]))
+        self._slot_tables[slot, needed:] = self._slot_tables[slot, needed - 1]
+        self._n_alloc[slot] = needed
+
+    def _record_verify_sample(self, rows, wall_dt):
+        samples = self._spec_samples.setdefault(rows, [])
+        samples.append(wall_dt)
+        del samples[:-8]
+
+    def _spec_draft_target(self):
+        """Draft length maximizing expected emitted tokens per second,
+        E(k) / t(rows(k)), from the verify step's OWN cost fit (decode
+        blocks consume exactly k tokens; a verify step consumes a variable
+        1..k+1, so it gets a separate t(rows) = RTT + rows*c model) and the
+        acceptance-rate EMA: E(k) = 1 + a + a^2 + ... + a^k."""
+        cfg = self._spec
+        if not cfg.adaptive:
+            return cfg.max_draft
+        sampled = {kk: sorted(v)[len(v) // 2]
+                   for kk, v in self._spec_samples.items() if v}
+        if len(sampled) < 2:
+            return cfg.max_draft      # not solvable yet: be optimistic
+        ks = sorted(sampled)
+        c, rtt = np.polyfit(np.asarray(ks, np.float64),
+                            np.asarray([sampled[kk] for kk in ks],
+                                       np.float64), 1)
+        if c <= 0 or rtt < 0:
+            return cfg.max_draft
+        alpha = min(0.99, max(0.0, self._spec_accept_ema
+                              if self._spec_accept_ema is not None else 0.5))
+        best_k, best_rate = 1, -1.0
+        for k in range(1, cfg.max_draft + 1):
+            e = (k + 1 if alpha == 1.0
+                 else (1 - alpha ** (k + 1)) / (1 - alpha))
+            rate = e / (rtt + _ceil_pow2(k + 1) * c)
+            if rate > best_rate:
+                best_rate, best_k = rate, k
+        return best_k
+
+    def spec_stats(self):
+        """Always-on speculative-decoding counters (zero when the
+        ``spec_decode`` knob is off). ``tokens_per_step`` is tokens emitted
+        per VERIFY dispatch — the speculative speedup factor (> 1.0 means
+        drafts are being accepted); the registry mirrors proposed/accepted
+        as ``serving_spec_*_total`` plus the acceptance histogram."""
+        return {
+            "proposed": self.spec_proposed,
+            "accepted": self.spec_accepted,
+            "emitted": self.spec_emitted,
+            "verify_dispatches": self.spec_dispatches,
+            "acceptance_rate": (self.spec_accepted / self.spec_proposed
+                                if self.spec_proposed else 0.0),
+            "tokens_per_step": (self.spec_emitted / self.spec_dispatches
+                                if self.spec_dispatches else 0.0),
+            "draft_target": (self._spec_draft_target()
+                             if self._spec is not None else 0),
+        }
 
     def _record_block_sample(self, k, wall_dt):
         """Auto decode-block: least-squares fit of t(k) = RTT + k*c over

@@ -84,7 +84,7 @@ SERVING_OCCUPANCY = REGISTRY.gauge(
     "serving_batch_occupancy_ratio", "active slots / max_batch", ("engine",))
 SERVING_DISPATCHES = REGISTRY.counter(
     "serving_dispatches_total", "engine programs dispatched",
-    ("engine", "kind"))                        # kind: prefill | decode
+    ("engine", "kind"))                        # kind: prefill | decode | verify
 SERVING_TOKENS = REGISTRY.counter(
     "serving_generated_tokens_total", "tokens emitted to requests",
     ("engine",))
@@ -103,6 +103,16 @@ SERVING_RECLAIMABLE_PAGES = REGISTRY.gauge(
     "cached-but-unreferenced pages parked in the LRU", ("engine",))
 SERVING_FREE_PAGES = REGISTRY.gauge(
     "serving_free_pages", "pages on the free list", ("engine",))
+SERVING_SPEC_PROPOSED = REGISTRY.counter(
+    "serving_spec_proposed_total",
+    "draft tokens proposed by speculative decoding", ("engine",))
+SERVING_SPEC_ACCEPTED = REGISTRY.counter(
+    "serving_spec_accepted_total",
+    "draft tokens accepted by in-graph verification", ("engine",))
+SERVING_SPEC_ACCEPTANCE = REGISTRY.histogram(
+    "serving_spec_acceptance_ratio",
+    "per-verify-step accepted/proposed draft ratio", ("engine",),
+    buckets=(0.0, 0.25, 0.5, 0.75, 0.9, 1.0))
 
 # collective plane (distributed/collective.py + parallel/ layers)
 COLLECTIVE_CALLS = REGISTRY.counter(

@@ -38,6 +38,15 @@ os.environ["PADDLE_TPU_AUTOTUNE_CACHE"] = os.path.join(
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+# XLA executable cache, keyed by HLO hash: serving/spec tests build many
+# LLMEngine instances whose per-instance jit closures lower to identical
+# programs — the on-disk cache dedups those compiles within a run (and
+# across runs / subprocess children, which inherit the env var).  Unlike
+# the autotune cache this never changes behavior, only compile latency.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(tempfile.gettempdir(), "paddle_tpu_xla_cache"))
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 os.environ["JAX_PLATFORMS"] = "cpu"
 

@@ -64,3 +64,11 @@ def bad_out_arity(x, y):
     f = shard_map(body_triple, mesh=mesh, in_specs=(P("dp"), P("dp")),
                   out_specs=(P("dp"), P("dp")))
     return f(x, y)
+
+
+def bad_named_sharding(x):
+    # SS106: 'tp' is not a mesh axis — caught at the NamedSharding site
+    # inside with_sharding_constraint, the usual spelling of the bug
+    from jax.sharding import NamedSharding
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P("tp", None)))

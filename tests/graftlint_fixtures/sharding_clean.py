@@ -23,3 +23,16 @@ def clean(x, y):
     f = shard_map(body, mesh=mesh, in_specs=(P("dp"), P("mp")),
                   out_specs=P("dp"))
     return f(x, y)
+
+
+def clean_constraint(x):
+    # NamedSharding on axes the mesh defines — no SS106
+    from jax.sharding import NamedSharding
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P("dp", "mp")))
+
+
+def clean_dynamic_sharding(x, mesh2, spec):
+    # dynamic mesh/spec: skipped, never guessed
+    from jax.sharding import NamedSharding
+    return jax.device_put(x, NamedSharding(mesh2, spec))

@@ -330,13 +330,15 @@ def _sharding(paths):
 
 def test_sharding_spec_catches_seeded_violations():
     res = _sharding([FIXTURES / "sharding_bad.py"])
-    assert _codes(res) == {"SS101", "SS102", "SS103", "SS104", "SS105"}
+    assert _codes(res) == {"SS101", "SS102", "SS103", "SS104", "SS105",
+                           "SS106"}
     by_code = {f.code: f for f in res.findings}
     assert "2 positional argument(s)" in by_code["SS101"].message
     assert "'ep'" in by_code["SS102"].message
     assert "'sep'" in by_code["SS103"].message
     assert by_code["SS104"].severity == "warning"       # divergence risk
     assert "3-tuple" in by_code["SS105"].message
+    assert "'tp'" in by_code["SS106"].message
     assert all(f.severity == "error" for f in res.findings
                if f.code != "SS104")
     assert all(f.hint for f in res.findings)
@@ -375,6 +377,24 @@ def test_sharding_spec_skips_dynamic_specs(tmp_path):
     """
     res = _lint(tmp_path, src, select=["sharding-spec-coverage"])
     assert res.findings == []
+
+
+def test_named_sharding_axis_checked_outside_shard_map(tmp_path):
+    # SS106 fires at bare NamedSharding construction sites too (device_put,
+    # jit sharding args, ...), not only under with_sharding_constraint
+    src = """
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(jax.devices(), ("dp",))
+
+        def place(x):
+            return jax.device_put(x, NamedSharding(mesh, P("model")))
+    """
+    res = _lint(tmp_path, src, select=["sharding-spec-coverage"])
+    assert _codes(res) == {"SS106"}
+    (f,) = res.findings
+    assert "'model'" in f.message and "(dp)" in f.message
 
 
 # --------------------------------------------------------------- dtype-rules

@@ -5,8 +5,10 @@ fresh children and, on final failure, still emits the one-line JSON with an
 ``error`` field and exits 0."""
 import json
 import os
+import signal
 import subprocess
 import sys
+import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(ROOT, "bench.py")
@@ -43,7 +45,8 @@ class TestBenchSupervisor:
         pointing at an unreachable address) still produces JSON output."""
         env = {**os.environ, "JAX_PLATFORMS": "axon",
                "PALLAS_AXON_POOL_IPS": "10.255.255.1",
-               "BENCH_MAX_ATTEMPTS": "2", "BENCH_ATTEMPT_TIMEOUT": "45"}
+               "BENCH_MAX_ATTEMPTS": "2", "BENCH_ATTEMPT_TIMEOUT": "45",
+               "BENCH_PROBE_TIMEOUT": "10"}
         r = subprocess.run([sys.executable, BENCH], env=env,
                            capture_output=True, text=True, timeout=300)
         assert r.returncode == 0, r.stderr[-2000:]
@@ -51,6 +54,9 @@ class TestBenchSupervisor:
         assert obj is not None, r.stdout[-2000:]
         assert obj["value"] is None
         assert "error" in obj
+        # the fail-fast probe turns the attempt-long hang into a quick rc=2
+        # with the probe's diagnosis in the child stderr tail
+        assert "probe" in obj["error"]
         assert len(obj["extra"]["attempts"]) == 2
 
     def test_crashing_child_yields_structured_error(self):
@@ -65,3 +71,32 @@ class TestBenchSupervisor:
         obj = _last_metric_line(r.stdout)
         assert obj is not None and obj["value"] is None
         assert "rc=" in obj["error"]
+
+    def test_sigterm_mid_run_emits_partial_artifact(self, tmp_path):
+        """ISSUE acceptance criterion: an EXTERNAL wall timeout (SIGTERM to
+        the supervisor) arriving mid-run must still leave a parseable JSON
+        artifact — the newest PARTIAL section line the child flushed,
+        annotated as truncated — and exit 0."""
+        ready = tmp_path / "ready"
+        env = {**os.environ, "BENCH_SMOKE": "1",
+               "BENCH_SMOKE_READY": str(ready),
+               "BENCH_MAX_ATTEMPTS": "1"}
+        proc = subprocess.Popen([sys.executable, BENCH], env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
+        try:
+            deadline = time.time() + 60
+            while not ready.exists() and time.time() < deadline:
+                time.sleep(0.1)
+            assert ready.exists(), "smoke child never signalled readiness"
+            proc.send_signal(signal.SIGTERM)
+            out, err = proc.communicate(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        assert proc.returncode == 0, err[-2000:]
+        obj = _last_metric_line(out)
+        assert obj is not None, out[-2000:]
+        assert obj.get("partial") is True
+        assert "truncated" in obj["extra"]
+        assert obj["extra"]["attempts"], "attempt log missing"

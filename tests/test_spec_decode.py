@@ -1,0 +1,335 @@
+"""Speculative decoding (ISSUE 6 tentpole): self-drafting n-gram / draft-model
+proposals verified by ONE multi-query target forward, with paged-KV rollback
+of rejected drafts. Correctness bar everywhere: token-identical output vs a
+spec-off engine for greedy and fixed-seed sampled requests.
+
+The tiny 2-layer model is module-shared (engine builds compile programs);
+tests needing special page geometry build their own engines."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.inference.serving import (LLMEngine, SpecConfig,
+                                          _NgramProposer)
+
+
+@pytest.fixture(scope="module")
+def model():
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=176,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=128)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _engine(model, spec, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_len", 96)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("prefill_chunk", 8)
+    return LLMEngine(model, spec_decode=spec, **kw)
+
+
+_RNG = np.random.default_rng(1)
+_PAT = _RNG.integers(5, 120, size=6).tolist()
+# mixed lengths: repeated structure (n-gram hits), short random, mixed tail
+_PROMPTS = [_PAT * 4,
+            _RNG.integers(5, 120, size=11).tolist(),
+            _PAT * 2 + [7, 9],
+            _RNG.integers(5, 120, size=3).tolist()]
+
+
+def _serve(eng, prompts, **req_kw):
+    req_kw.setdefault("max_new_tokens", 20)
+    rids = [eng.add_request(p, **req_kw) for p in prompts]
+    eng.run_until_done()
+    return [eng.result(rid) for rid in rids]
+
+
+def _check_page_accounting(eng):
+    """Pool conservation + per-slot allocation exactly covers each length."""
+    alloc = sum(int(eng._n_alloc[s]) for s in range(eng.max_batch))
+    assert alloc + len(eng._free_pages) + len(eng._lru) == eng.n_pages - 1
+    for s, r in enumerate(eng._slots):
+        if r is None:
+            continue
+        lens = int(eng._lens[s])
+        assert int(eng._n_alloc[s]) >= max(1, -(-lens // eng.page))
+
+
+# ---------------------------------------------------------------- the kernel
+
+class TestMultiQueryKernel:
+    def _setup(self, seed=0, B=2, P=9, page=8, KVH=2, H=4, D=16, S=4, Q=3,
+               ctx=(13, 22)):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(seed)
+        k_pages = jnp.asarray(rng.standard_normal((P, page, KVH, D)),
+                              jnp.float32)
+        v_pages = jnp.asarray(rng.standard_normal((P, page, KVH, D)),
+                              jnp.float32)
+        bt = jnp.asarray(rng.permutation(P - 1)[:B * S].reshape(B, S),
+                         jnp.int32)
+        cl = jnp.asarray(list(ctx), jnp.int32)
+        q = jnp.asarray(rng.standard_normal((B, Q, H, D)), jnp.float32)
+        return q, k_pages, v_pages, bt, cl
+
+    def test_kernel_matches_ref(self):
+        from paddle_tpu.ops.pallas.paged_attention import (
+            paged_attention_multiquery, paged_attention_multiquery_ref)
+        args = self._setup()
+        out = np.asarray(paged_attention_multiquery(*args))
+        ref = np.asarray(paged_attention_multiquery_ref(*args))
+        np.testing.assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
+
+    def test_rows_match_single_query_ref(self):
+        """Row j of the multi-query ref == the single-query ref at ctx+j —
+        the causal-horizon contract verification relies on."""
+        from paddle_tpu.ops.pallas.paged_attention import (
+            paged_attention_multiquery_ref, paged_attention_ref)
+        q, kp, vp, bt, cl = self._setup()
+        out = np.asarray(paged_attention_multiquery_ref(q, kp, vp, bt, cl))
+        for j in range(q.shape[1]):
+            single = np.asarray(
+                paged_attention_ref(q[:, j], kp, vp, bt, cl + j))
+            np.testing.assert_allclose(out[:, j], single, atol=1e-5,
+                                       rtol=1e-5)
+
+    def test_int8_path(self):
+        from paddle_tpu.ops.pallas.paged_attention import (
+            paged_attention_multiquery, paged_attention_multiquery_ref,
+            quantize_kv)
+        q, kp, vp, bt, cl = self._setup()
+        kq, ks = quantize_kv(kp)
+        vq, vs = quantize_kv(vp)
+        out = np.asarray(paged_attention_multiquery(
+            q, kq, vq, bt, cl, k_scales=ks, v_scales=vs))
+        ref = np.asarray(paged_attention_multiquery_ref(q, kp, vp, bt, cl))
+        assert np.max(np.abs(out - ref)) < 0.05
+
+
+# -------------------------------------------------------------- the proposer
+
+class TestNgramProposer:
+    def test_suffix_match_proposes_continuation(self):
+        p = _NgramProposer(SpecConfig(max_draft=4, ngram_max=3))
+        #          match <1,2> at idx 1 -> propose what followed: 3, 4, 5
+        toks = [9, 1, 2, 3, 4, 5, 1, 2]
+        assert p.propose(toks, 3) == [3, 4, 5]
+
+    def test_longest_ngram_wins(self):
+        p = _NgramProposer(SpecConfig(max_draft=4, ngram_max=3))
+        # suffix <1,2,3> matches at 0 (-> 7), suffix <3> alone also at 5
+        toks = [1, 2, 3, 7, 8, 3, 9, 1, 2, 3]
+        assert p.propose(toks, 2) == [7, 8]
+
+    def test_no_match_returns_empty(self):
+        p = _NgramProposer(SpecConfig())
+        assert p.propose([1, 2, 3, 4], 4) == []
+        assert p.propose([5], 4) == []
+
+
+# ----------------------------------------------------------------- parity
+
+class TestSpecParity:
+    def test_greedy_parity_mixed_prompts(self, model):
+        base = _serve(_engine(model, None), _PROMPTS)
+        eng = _engine(model, SpecConfig(max_draft=4))
+        out = _serve(eng, _PROMPTS)
+        assert out == base
+        # the repeated-structure workload must actually speculate
+        st = eng.spec_stats()
+        assert st["proposed"] > 0 and st["accepted"] > 0
+        assert st["tokens_per_step"] > 1.0
+        assert st["verify_dispatches"] > 0
+        _check_page_accounting(eng)
+
+    def test_greedy_parity_one_by_one(self, model):
+        for p in _PROMPTS[:2]:
+            base = _serve(_engine(model, None, max_batch=1), [p])
+            out = _serve(_engine(model, SpecConfig(max_draft=3),
+                                 max_batch=1), [p])
+            assert out == base
+
+    def test_fixed_seed_sampling_parity(self, model):
+        kw = dict(do_sample=True, temperature=0.9, top_p=0.8, seed=17,
+                  max_new_tokens=16)
+        base = _serve(_engine(model, None), _PROMPTS[:3], **kw)
+        out = _serve(_engine(model, SpecConfig(max_draft=4)), _PROMPTS[:3],
+                     **kw)
+        assert out == base
+
+    def test_seedless_sampling_smoke(self, model):
+        """Seedless draws consume the global seed counter per dispatch, so
+        exact parity is impossible by construction (same caveat as prefix
+        caching) — assert the distribution machinery stays sound: correct
+        lengths, in-vocab tokens, and drafts actually verified."""
+        eng = _engine(model, SpecConfig(max_draft=4))
+        out = _serve(eng, _PROMPTS[:2], do_sample=True, temperature=0.8,
+                     max_new_tokens=18)
+        for o in out:
+            assert len(o) == 18
+            assert all(0 <= t < model.config.vocab_size for t in o)
+        assert eng.spec_stats()["verify_dispatches"] > 0
+
+    def test_eos_mid_verify(self, model):
+        """eos landing inside an accepted run stops the request exactly
+        where the spec-off engine stops it (later accepted tokens are
+        discarded on release)."""
+        base = _serve(_engine(model, None, max_batch=1), [_PROMPTS[0]])[0]
+        # an eos whose FIRST occurrence is deep enough to sit inside a
+        # multi-token accepted run
+        eos = next(t for i, t in enumerate(base) if base.index(t) == i >= 4)
+        stop = base.index(eos) + 1
+        a = _serve(_engine(model, None, max_batch=1), [_PROMPTS[0]],
+                   eos_token_id=eos)
+        b = _serve(_engine(model, SpecConfig(max_draft=4), max_batch=1),
+                   [_PROMPTS[0]], eos_token_id=eos)
+        assert a == b
+        assert a[0][-1] == eos and len(a[0]) == stop
+
+
+# ----------------------------------------------------------------- rollback
+
+class TestRollback:
+    def test_rollback_across_page_boundaries(self, model):
+        """max_draft > page_size forces verify steps whose provisional rows
+        span page boundaries; every rejection must hand those pages back."""
+        eng = _engine(model, SpecConfig(max_draft=6), page_size=4,
+                      max_len=64, max_batch=2)
+        rids = [eng.add_request(p[:12], max_new_tokens=24)
+                for p in _PROMPTS[:2]]
+        while eng._waiting or any(s is not None for s in eng._slots):
+            eng.step()
+            # after every step: allocation exactly covers the committed
+            # length (truncation freed everything past it) and the pool sums
+            for s, r in enumerate(eng._slots):
+                # mid-prefill slots hold the whole prompt's reservation;
+                # the tight bound applies once decode/verify is running
+                if r is None or r.pos < len(r.prompt):
+                    continue
+                lens = int(eng._lens[s])
+                assert int(eng._n_alloc[s]) == max(1, -(-lens // 4))
+            _check_page_accounting(eng)
+        base = _serve(_engine(model, None, page_size=4, max_len=64,
+                              max_batch=2),
+                      [p[:12] for p in _PROMPTS[:2]], max_new_tokens=24)
+        assert [eng.result(r) for r in rids] == base
+        assert eng.spec_stats()["proposed"] > 0
+
+    def test_pool_drains_clean_after_spec_serve(self, model):
+        eng = _engine(model, SpecConfig(max_draft=4))
+        _serve(eng, _PROMPTS)
+        assert sum(int(eng._n_alloc[s]) for s in range(eng.max_batch)) == 0
+        assert len(eng._free_pages) + len(eng._lru) == eng.n_pages - 1
+
+
+# ------------------------------------------------------------- prefix cache
+
+class TestSpecWithPrefixCache:
+    def test_parity_and_shared_pages_survive_drafts(self, model):
+        """Rejected drafts write provisional KV beyond a slot's length; with
+        the prefix cache on, those writes must never land in a SHARED page.
+        If one did, the third request's cached-prefix serve would return
+        corrupted tokens — so exact parity here is the mutation check."""
+        prompts = [_PAT * 4, _PAT * 4, (_PAT * 4)[:20]]
+
+        def serve_fresh(spec):
+            eng = _engine(model, spec, prefix_cache=True, max_batch=2)
+            outs = []
+            for p in prompts:      # sequential: later ones hit the cache
+                rid = eng.add_request(p, max_new_tokens=16)
+                eng.run_until_done()
+                outs.append(eng.result(rid))
+            return outs, eng
+
+        base, _ = serve_fresh(None)
+        out, eng = serve_fresh(SpecConfig(max_draft=4))
+        assert out == base
+        assert eng.prefix_cache_stats()["hits"] > 0
+        assert eng.spec_stats()["accepted"] > 0
+        _check_page_accounting(eng)
+
+
+# -------------------------------------------------------------- draft model
+
+class TestDraftModel:
+    def test_self_draft_is_always_accepted(self, model):
+        """Using the TARGET model as its own draft model makes every
+        proposal the greedy continuation — acceptance must be 100% and the
+        output identical to spec-off (generate()/engine parity)."""
+        eng = _engine(model, SpecConfig(max_draft=3, draft_model=model),
+                      max_batch=1)
+        out = _serve(eng, [_PROMPTS[1]], max_new_tokens=12)
+        base = _serve(_engine(model, None, max_batch=1), [_PROMPTS[1]],
+                      max_new_tokens=12)
+        assert out == base
+        st = eng.spec_stats()
+        assert st["acceptance_rate"] == 1.0
+        assert st["proposed"] > 0
+        # every verify step lands its full draft+1 run
+        assert st["tokens_per_step"] > 2.0
+
+
+# ------------------------------------------------------------ config/metrics
+
+class TestSpecConfigAndMetrics:
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            SpecConfig(max_draft=0)
+        with pytest.raises(ValueError):
+            SpecConfig(ngram_min=0)
+        with pytest.raises(ValueError):
+            SpecConfig(ngram_max=1, ngram_min=2)
+
+    def test_spec_off_stats_are_zero(self, model):
+        eng = _engine(model, None)
+        _serve(eng, _PROMPTS[:1])
+        st = eng.spec_stats()
+        assert st["proposed"] == st["accepted"] == st["emitted"] == 0
+        assert st["verify_dispatches"] == 0 and st["draft_target"] == 0
+
+    def test_registry_mirrors_spec_counters(self, model):
+        from paddle_tpu import observability as obs
+        obs.reset()
+        obs.enable()
+        try:
+            eng = _engine(model, SpecConfig(max_draft=4))
+            _serve(eng, _PROMPTS[:2])
+            st = eng.spec_stats()
+            m = eng.metrics()
+            assert (m["serving_spec_proposed_total"]["series"][0]["value"]
+                    == st["proposed"])
+            assert (m["serving_spec_accepted_total"]["series"][0]["value"]
+                    == st["accepted"])
+            hist = m["serving_spec_acceptance_ratio"]["series"][0]
+            assert hist["count"] == st["verify_dispatches"]
+            kinds = {s["labels"]["kind"]: s["value"]
+                     for s in m["serving_dispatches_total"]["series"]}
+            assert kinds.get("verify", 0) == st["verify_dispatches"]
+        finally:
+            obs.disable()
+            obs.reset()
+
+    def test_adaptive_cost_model_separate_from_decode_fit(self, model):
+        """The verify cost curve must be learned in _spec_samples, never
+        leaking into the decode-block auto-fit's samples."""
+        eng = _engine(model, SpecConfig(max_draft=4), decode_block="auto")
+        n_decode_dispatch = 0
+        rids = [eng.add_request(p, max_new_tokens=20) for p in _PROMPTS]
+        while eng._waiting or any(s is not None for s in eng._slots):
+            before = eng.spec_dispatches
+            eng.step()
+            if eng.spec_dispatches == before:
+                n_decode_dispatch += 1   # prefill or plain decode step
+        assert eng._spec_samples            # verify steps were sampled
+        # decode-block fit only ever saw plain decode dispatches: with every
+        # decode step recorded at most once, sample counts can't exceed them
+        assert sum(len(v) for v in eng._block_samples.values()) \
+            <= n_decode_dispatch
+        # spec stats expose the adapted target
+        assert 1 <= eng.spec_stats()["draft_target"] <= 4
+        assert all(eng.result(r) for r in rids)
