@@ -645,6 +645,54 @@ def _serving_bench(paddle, on_tpu, budget_left_s=None):
         except Exception as e:  # noqa: BLE001
             print(f"spec-decode serving extra failed: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
+        # degradation under injected faults: the same timed serve with
+        # seeded page-allocation failures plus one transient step error —
+        # graceful degradation means the run completes token-exact (greedy)
+        # with only a throughput cost, which this sub-extra quantifies
+        # alongside the engine's recovery counters
+        try:
+            if not _room(1.5, "degradation"):
+                raise _SkipExtra
+            from paddle_tpu.testing import FAULTS, FailNth, FailProb
+            engf = LLMEngine(m, max_batch=2, max_len=P + NEW + 8,
+                             page_size=16, prefill_chunk=CHUNK,
+                             decode_block="auto")
+            engf.add_request(prompt, max_new_tokens=NEW)
+            engf.run_until_done()                       # warm compile
+            engf.add_request(prompt, max_new_tokens=NEW)
+            engf.run_until_done()           # warm the fitted block size
+            rid = engf.add_request(prompt, max_new_tokens=NEW)
+            t0 = time.perf_counter()
+            engf.run_until_done()
+            clean_dt = time.perf_counter() - t0 - engf.ttft(rid)
+            toks_clean = list(engf.result(rid))
+            FAULTS.install("serving.page_alloc", FailProb(0.2, seed=5))
+            FAULTS.install("serving.step", FailNth(3), transient=True)
+            try:
+                rid = engf.add_request(prompt, max_new_tokens=NEW)
+                t0 = time.perf_counter()
+                engf.run_until_done()
+                fault_dt = time.perf_counter() - t0 - engf.ttft(rid)
+                toks_fault = list(engf.result(rid))
+            finally:
+                FAULTS.reset()
+            tps_clean = (NEW - 1) / max(clean_dt, 1e-9)
+            tps_fault = (NEW - 1) / max(fault_dt, 1e-9)
+            out["degradation"] = {
+                "parity": toks_fault == toks_clean,
+                "decode_tokens_per_sec_clean": round(tps_clean, 1),
+                "decode_tokens_per_sec_faulted": round(tps_fault, 1),
+                "slowdown_pct":
+                    round((tps_clean / max(tps_fault, 1e-9) - 1.0) * 100, 1),
+                "step_failures": engf.step_failures,
+                "step_retries": engf.step_retries,
+                "preemptions": engf.preemptions,
+                "quarantined": engf.quarantined}
+        except _SkipExtra:
+            pass
+        except Exception as e:  # noqa: BLE001
+            print(f"degradation serving extra failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
         return out
     except Exception as e:  # noqa: BLE001 — extras must not kill the bench
         print(f"serving bench failed: {type(e).__name__}: {e}",

@@ -1,0 +1,70 @@
+"""robustness — swallowed-exception hygiene.
+
+A broad handler whose whole body is ``pass`` discards every failure — the
+archetypal fault-tolerance anti-pattern this PR's serving work is built to
+avoid (quarantine records the error on the request; the watchdog counts its
+expiries; the retry helper re-raises after backoff).  Flagged:
+
+  * ``except: pass`` / ``except Exception: pass`` /
+    ``except BaseException: pass`` (``...`` counts as ``pass``).  (RB101)
+
+Narrow handlers (``except KeyError: pass``) are idiomatic dict-probing and
+stay silent.  Deliberate broad swallows — shutdown paths where any cleanup
+error is acceptable — carry a line pragma or a baseline entry stating so.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..framework import AnalysisPass, Finding, register_pass
+
+_HINT = ("handle the error, re-raise, or log it (module logger / "
+         "observability registry); a deliberate swallow names the narrow "
+         "exception it expects or carries a pragma")
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _is_broad(handler):
+    t = handler.type
+    if t is None:                                        # bare except:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD
+                   for e in t.elts)
+    return False
+
+
+def _swallows(handler):
+    if len(handler.body) != 1:
+        return False
+    stmt = handler.body[0]
+    if isinstance(stmt, ast.Pass):
+        return True
+    return (isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is Ellipsis)
+
+
+@register_pass
+class RobustnessPass(AnalysisPass):
+    name = "robustness"
+    version = 1
+    description = ("swallowed exceptions: broad except handlers whose "
+                   "whole body is pass")
+
+    def check_file(self, src) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _is_broad(node) and _swallows(node):
+                what = ("bare except" if node.type is None
+                        else f"except {ast.unparse(node.type)}")
+                findings.append(Finding(
+                    self.name, "RB101", src.path, node.lineno,
+                    f"{what}: pass — swallows every failure silently",
+                    _HINT, severity="warning"))
+        return findings

@@ -26,7 +26,10 @@ Checks (codes):
   * SS106 ``NamedSharding(mesh, spec)`` (any site — direct, inside
           ``with_sharding_constraint``, ``jax.device_put``, ...) whose
           literal PartitionSpec names an axis the (literal) mesh does not
-          define
+          define; also bare PartitionSpec values passed through
+          ``jax.jit(..., in_shardings=/out_shardings=)`` keywords, resolved
+          against the mesh of the lexically enclosing ``with <mesh>:`` /
+          ``with use_mesh(mesh):`` block
 
 Everything literal-or-resolvable is checked; dynamic specs/meshes/axis names
 are skipped, never guessed — a lint finding here should always be real.
@@ -36,9 +39,9 @@ from __future__ import annotations
 import ast
 
 from ..framework import AnalysisPass, Finding, Project, register_pass
-from ..resolve import (Imports, collective_axis_arg, is_named_sharding,
-                       is_partition_spec, is_shard_map, mesh_axis_names,
-                       _literal_axis_names)
+from ..resolve import (Imports, collective_axis_arg, is_jit,
+                       is_named_sharding, is_partition_spec, is_shard_map,
+                       mesh_axis_names, _literal_axis_names)
 from .trace_safety import _is_tainted, _scan, _target_names
 
 _HINTS = {
@@ -112,11 +115,12 @@ def _spec_axes(node, imports):
 @register_pass
 class ShardingSpecPass(AnalysisPass):
     name = "sharding-spec-coverage"
-    version = 2
+    version = 3
     description = ("shard_map contract checks: in/out_specs arity, spec and "
                    "collective axis names vs the mesh, collectives under "
                    "data-dependent control flow, NamedSharding/"
-                   "with_sharding_constraint spec-vs-mesh axis validity")
+                   "with_sharding_constraint/jit-shardings spec-vs-mesh "
+                   "axis validity")
     project_scope = True    # resolves bodies across files
 
     def check_project(self, project: Project) -> list[Finding]:
@@ -147,7 +151,7 @@ class ShardingSpecPass(AnalysisPass):
         return self._imports[src.path]
 
     # ---- traversal -------------------------------------------------------
-    def _walk(self, node, scopes, src, imports, findings):
+    def _walk(self, node, scopes, src, imports, findings, mesh_ctx=None):
         for child in ast.iter_child_nodes(node):
             if isinstance(child, ast.Call):
                 canon = imports.canonical(child.func)
@@ -158,10 +162,37 @@ class ShardingSpecPass(AnalysisPass):
                     # / device_put arguments are visited by this same walk
                     self._check_named_sharding(child, scopes, src, imports,
                                                findings)
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                self._walk(child, [child] + scopes, src, imports, findings)
+                elif is_jit(canon):
+                    self._check_jit_shardings(child, src, imports, findings,
+                                              mesh_ctx)
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                ctx = mesh_ctx
+                for item in child.items:
+                    axes = self._with_mesh_axes(item.context_expr, scopes,
+                                                src)
+                    if axes is not None:
+                        ctx = axes
+                self._walk(child, scopes, src, imports, findings, ctx)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk(child, [child] + scopes, src, imports, findings,
+                           mesh_ctx)
             else:
-                self._walk(child, scopes, src, imports, findings)
+                self._walk(child, scopes, src, imports, findings, mesh_ctx)
+
+    def _with_mesh_axes(self, expr, scopes, src):
+        """Axis names a ``with`` item puts in scope: ``with mesh:`` /
+        ``with Mesh(devs, (...)):`` / ``with use_mesh(mesh):`` when the mesh
+        is statically known, else None."""
+        axes = self._mesh_axes(expr, scopes, src)
+        if axes is not None:
+            return axes
+        if isinstance(expr, ast.Call) and expr.args:
+            canon = self._file_imports(src).canonical(expr.func)
+            if canon and (canon == "use_mesh"
+                          or canon.endswith(".use_mesh")
+                          or canon.endswith(".set_mesh")):
+                return self._mesh_axes(expr.args[0], scopes, src)
+        return None
 
     # ---- body / mesh resolution ------------------------------------------
     def _lookup_name(self, name, scopes, src):
@@ -293,6 +324,48 @@ class ShardingSpecPass(AnalysisPass):
                     f"NamedSharding spec names axis '{name}' but its mesh "
                     f"only defines ({', '.join(mesh_axes)})",
                     _HINTS["SS106"], "error"))
+
+    def _check_jit_shardings(self, call, src, imports, findings, mesh_axes):
+        """SS106, jit keyword path: bare PartitionSpec values in
+        ``jax.jit(..., in_shardings=/out_shardings=)`` resolve against the
+        mesh active at trace time; lexically that is the enclosing ``with
+        <mesh>:`` block.  No statically-known enclosing mesh -> no finding
+        (skip, don't guess).  NamedSharding values carry their own mesh and
+        are validated at their construction site by the normal walk."""
+        if mesh_axes is None:
+            return
+        for kw in call.keywords:
+            if kw.arg not in ("in_shardings", "out_shardings"):
+                continue
+            for name, line in self._bare_spec_axes(kw.value, imports):
+                if name not in mesh_axes:
+                    findings.append(Finding(
+                        self.name, "SS106", src.path, line,
+                        f"jit {kw.arg} PartitionSpec names axis '{name}' "
+                        f"but the enclosing mesh context only defines "
+                        f"({', '.join(mesh_axes)})",
+                        _HINTS["SS106"], "error"))
+
+    @staticmethod
+    def _bare_spec_axes(node, imports):
+        """[(axis, line)] for literal axis strings in PartitionSpec calls
+        under ``node``, pruning NamedSharding(...) subtrees (their specs are
+        checked against their own mesh, not the context one)."""
+        out = []
+        stack = [node] if node is not None else []
+        while stack:
+            n = stack.pop()
+            if isinstance(n, ast.Call):
+                canon = imports.canonical(n.func)
+                if is_named_sharding(canon):
+                    continue
+                if is_partition_spec(canon):
+                    for a in list(n.args) + [kw.value for kw in n.keywords]:
+                        for name in _literal_axis_names(a) or ():
+                            out.append((name, n.lineno))
+                    continue
+            stack.extend(ast.iter_child_nodes(n))
+        return out
 
     @staticmethod
     def _return_tuple_arity(fn):

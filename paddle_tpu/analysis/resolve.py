@@ -80,6 +80,8 @@ _SHARD_MAP = ("jax.shard_map", "jax.experimental.shard_map.shard_map",
 _PARTITION_SPEC = ("jax.sharding.PartitionSpec",
                    "jax.experimental.pjit.PartitionSpec", "PartitionSpec")
 _NAMED_SHARDING = ("jax.sharding.NamedSharding", "NamedSharding")
+# jit entry points that accept in_shardings=/out_shardings= keywords
+_JIT = ("jax.jit", "pjit")
 # canonical-path suffix -> positional index of the axis-name argument
 _COLLECTIVES = {
     "lax.psum": 1, "lax.pmean": 1, "lax.pmax": 1, "lax.pmin": 1,
@@ -103,6 +105,10 @@ def is_partition_spec(canon: str | None) -> bool:
 
 def is_named_sharding(canon: str | None) -> bool:
     return _match(canon, _NAMED_SHARDING)
+
+
+def is_jit(canon: str | None) -> bool:
+    return _match(canon, _JIT)
 
 
 def collective_axis_arg(canon: str | None):

@@ -25,6 +25,10 @@ import struct
 import threading
 import time
 
+from ..core.retry import RetryError, RetryPolicy, retry_call
+from ..testing.faults import FAULTS as _faults
+from ..testing.faults import InjectedFault as _InjectedFault
+
 _SET, _GET, _ADD, _DELETE, _WAIT = 1, 2, 3, 4, 5
 
 
@@ -166,24 +170,30 @@ class TCPStore:
             bind = "0.0.0.0" if host == "127.0.0.1" else host
             port, self.server_kind = _start_server(bind, port)
         self.host, self.port = host, port
-        deadline = time.monotonic() + timeout
-        last = None
-        while True:
-            try:
-                self._sock = socket.create_connection((host, port), timeout=5)
-                self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY,
-                                      1)
-                # blocking get/wait time out SERVER-side (protocol timeout
-                # field); the connect timeout must not cap recv
-                self._sock.settimeout(None)
-                break
-            except OSError as e:
-                last = e
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"could not reach TCPStore at {host}:{port}") from last
-                time.sleep(0.1)
+        # shared backoff-with-jitter policy (core/retry.py) instead of the
+        # old flat 0.1s spin: a whole cohort connecting to a master that is
+        # still binding decorrelates instead of stampeding.  The deadline
+        # keeps the former `timeout` contract.
+        policy = RetryPolicy(max_attempts=64, base_delay=0.05, max_delay=1.0,
+                             deadline=timeout)
+        try:
+            self._sock = retry_call(self._connect, policy=policy,
+                                    retry_on=(OSError, _InjectedFault),
+                                    op="store.connect")
+        except RetryError as e:
+            raise TimeoutError(
+                f"could not reach TCPStore at {host}:{port}") from e.__cause__
         self._lock = threading.Lock()
+
+    def _connect(self):
+        if _faults.active:
+            _faults.raise_if("store.connect", host=self.host, port=self.port)
+        sock = socket.create_connection((self.host, self.port), timeout=5)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # blocking get/wait time out SERVER-side (protocol timeout field);
+        # the connect timeout must not cap recv
+        sock.settimeout(None)
+        return sock
 
     def _rpc(self, cmd, key, val=b"", timeout=None):
         t = self.timeout if timeout is None else timeout

@@ -107,10 +107,19 @@ class CommTaskManager:
                         del self._tasks[seq]
             for t in expired:
                 self.timed_out.append(t)
+                self._count_timeout(t)
                 try:
                     self.on_timeout(t)
                 except Exception:
                     traceback.print_exc()
+
+    @staticmethod
+    def _count_timeout(task: CommTask):
+        """Mirror the expiry into ``comm_watchdog_timeouts_total{op=...}``
+        so dashboards see probable hangs without scraping stderr."""
+        from .. import observability as _obs
+        if _obs.enabled():
+            _obs.COMM_WATCHDOG_TIMEOUTS.labels(op=task.name).inc()
 
     @staticmethod
     def _default_handler(task: CommTask):

@@ -42,6 +42,7 @@ TPU-native design:
 """
 from __future__ import annotations
 
+import enum
 import math
 import time
 from collections import OrderedDict, deque
@@ -52,10 +53,41 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import observability as _obs
+from ..core.retry import RetryError, RetryPolicy, retry_call
+from ..testing.faults import FAULTS as _faults
 
-__all__ = ["LLMEngine", "Request", "SpecConfig"]
+__all__ = ["LLMEngine", "Request", "RequestStatus", "SpecConfig"]
 
 _MAXK = 64        # static cap for per-slot dynamic top-k filtering
+
+
+class RequestStatus(enum.Enum):
+    """Request lifecycle. Exactly one terminal status per request:
+
+    FINISHED   max_new_tokens (or engine max_len) reached
+    EOS        the eos token was sampled
+    TIMEOUT    deadline expired (waiting: shed unserved; mid-decode: the
+               partial output is kept and the slot finalized cleanly)
+    CANCELLED  ``cancel(rid)`` — pages released through the refcounts
+    SHED       admission control refused the request at add_request
+    FAILED     quarantined by step-failure isolation (``Request.error`` holds
+               the underlying exception text)
+    """
+    QUEUED = "queued"
+    RUNNING = "running"
+    FINISHED = "finished"
+    EOS = "eos"
+    TIMEOUT = "timeout"
+    CANCELLED = "cancelled"
+    SHED = "shed"
+    FAILED = "failed"
+
+    @property
+    def terminal(self):
+        return self not in (RequestStatus.QUEUED, RequestStatus.RUNNING)
+
+
+_TERMINAL = tuple(s for s in RequestStatus if s.terminal)
 
 
 class _EngineMetrics:
@@ -90,12 +122,17 @@ class _EngineMetrics:
         self.spec_proposed = _obs.SERVING_SPEC_PROPOSED.labels(**e)
         self.spec_accepted = _obs.SERVING_SPEC_ACCEPTED.labels(**e)
         self.spec_acceptance = _obs.SERVING_SPEC_ACCEPTANCE.labels(**e)
+        self.terminal = {s: _obs.SERVING_TERMINALS.labels(status=s.value, **e)
+                         for s in _TERMINAL}
+        self.step_fail = {ph: _obs.SERVING_STEP_FAILURES.labels(phase=ph, **e)
+                          for ph in ("prefill", "decode", "verify")}
+        self.probes = _obs.SERVING_QUARANTINE_PROBES.labels(**e)
 
 
 class Request:
     def __init__(self, rid, prompt_ids, max_new_tokens, eos_token_id=None,
                  do_sample=False, temperature=1.0, top_p=1.0, top_k=0,
-                 seed=None):
+                 seed=None, deadline=None):
         self.rid = rid
         self.prompt = list(int(t) for t in np.asarray(prompt_ids).reshape(-1))
         self.prompt0 = list(self.prompt)   # original; preemption re-folds
@@ -112,6 +149,13 @@ class Request:
         self.done = False
         self.admit_seq = -1          # preemption picks the youngest
         self.t_submit = time.perf_counter()
+        # absolute wall deadline; expiry sheds a waiting request and cleanly
+        # finalizes a decoding one (both terminal status TIMEOUT)
+        self.deadline = (None if deadline is None
+                         else self.t_submit + float(deadline))
+        self.status = RequestStatus.QUEUED
+        self.error = None            # exception text when status is FAILED
+        self.t_finish = None
         self.ttft = None             # seconds to first generated token
         self.prefill_dispatches = 0  # prefill programs dispatched for us
         self.cached_tokens = 0       # prompt tokens served from prefix cache
@@ -243,6 +287,16 @@ class _DraftModelProposer:
         return [int(t) for t in seq[len(tokens):]]
 
 
+class _TransientStep(Exception):
+    """Private wrapper around a transient step error so :func:`retry_call`
+    retries exactly those — any non-transient error escapes the retry loop
+    unwrapped and falls through to quarantine isolation."""
+
+    def __init__(self, err):
+        super().__init__(str(err))
+        self.err = err
+
+
 class LLMEngine:
     """Continuous-batching paged-KV engine over a LlamaForCausalLM."""
 
@@ -252,7 +306,9 @@ class LLMEngine:
                  max_batch=4, max_len=256, page_size=16, prefill_chunk=32,
                  page_pool=None, decode_block=1, use_kernel=None, seed=0,
                  kv_cache_dtype="auto", decode_block_max=32,
-                 prefix_cache=False, spec_decode=None):
+                 prefix_cache=False, spec_decode=None, max_waiting=None,
+                 shed_min_free_ratio=0.0, default_deadline=None,
+                 step_retry=None, debug_refcount_audit=False):
         """page_pool: usable KV pages (the HBM budget). Defaults to the
         worst case (max_batch * ceil(max_len/page)); set it SMALLER to
         oversubscribe — on-demand growth means slots only claim what they
@@ -309,7 +365,30 @@ class LLMEngine:
         (a partially-filled page is truncated, never shared). Steps where
         no request has a draft fall through to the normal decode-block
         path. Counters: :meth:`spec_stats`, plus ``spec_proposed_total`` /
-        ``spec_accepted_total`` / acceptance histogram in the registry."""
+        ``spec_accepted_total`` / acceptance histogram in the registry.
+
+        Fault tolerance (see :meth:`health` for the counter snapshot):
+
+        max_waiting: admission-control queue bound — add_request beyond it
+        returns a request already terminal with status SHED (None keeps the
+        legacy unbounded queue).
+        shed_min_free_ratio: page-pressure watermark — while the backlog is
+        non-empty and (free + reclaimable) pages fall below this fraction of
+        the pool, new requests are shed.
+        default_deadline: seconds each request may spend end-to-end unless
+        add_request overrides; expiry sheds waiting requests and cleanly
+        finalizes decoding ones (status TIMEOUT, partial output kept).
+        step_retry: :class:`~paddle_tpu.core.retry.RetryPolicy` for
+        TRANSIENT step errors (an exception with a truthy ``transient``
+        attribute, e.g. an injected transient fault) — the step is retried
+        with backoff before failure isolation kicks in. Default: 3 attempts,
+        10ms base.  Non-transient step errors never crash the loop: the
+        failing dispatch is re-run one slot at a time and the slot that
+        fails alone is quarantined (terminal FAILED, pages freed through the
+        refcounts) while the rest keep serving.
+        debug_refcount_audit: run :meth:`audit_refcounts` after every step
+        and raise on any page-accounting violation (tier-1 chaos tests keep
+        this on to prove no failure path leaks pages)."""
         cfg = model.config
         self.cfg = cfg
         self.max_batch = max_batch
@@ -445,6 +524,23 @@ class LLMEngine:
         self.spec_accepted = 0          # draft tokens that matched
         self.spec_emitted = 0           # tokens emitted by verify steps
         self.spec_dispatches = 0        # verify programs dispatched
+        # fault tolerance: admission control, deadlines, failure isolation
+        self.max_waiting = None if max_waiting is None else int(max_waiting)
+        self.shed_min_free_ratio = float(shed_min_free_ratio)
+        self.default_deadline = default_deadline
+        self.debug_refcount_audit = bool(debug_refcount_audit)
+        self._step_retry = (step_retry if step_retry is not None else
+                            RetryPolicy(max_attempts=3, base_delay=0.01,
+                                        max_delay=0.25, seed=seed))
+        self._any_deadline = default_deadline is not None
+        self._step_phase = ("admit", ())
+        self.shed_requests = 0          # refused by admission control
+        self.timeouts = 0               # deadline expiries (waiting + active)
+        self.cancels = 0                # cancel(rid) that found the request
+        self.quarantined = 0            # requests isolated as FAILED
+        self.step_failures = 0          # step dispatches that raised
+        self.step_retries = 0           # transient-path retry invocations
+        self.quarantine_probes = 0      # single-slot isolation probes run
         self._m = _EngineMetrics(str(LLMEngine._engine_seq))
         LLMEngine._engine_seq += 1
         self._prefill = self._build_prefill()
@@ -659,7 +755,12 @@ class LLMEngine:
     # ------------------------------------------------------------- scheduling
     def add_request(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
                     do_sample=False, temperature=1.0, top_p=1.0, top_k=0,
-                    seed=None):
+                    seed=None, deadline=None):
+        """Submit a request; returns its rid.  ``deadline`` (seconds,
+        default ``default_deadline``) bounds its total wall time.  Admission
+        control may refuse it: the rid is still returned, but the request is
+        already terminal with :attr:`RequestStatus.SHED` (check
+        :meth:`status`) — malformed arguments still raise."""
         n_prompt = int(np.asarray(prompt_ids).reshape(-1).shape[0])
         if n_prompt == 0:
             raise ValueError("empty prompt")
@@ -676,12 +777,88 @@ class LLMEngine:
             raise ValueError(
                 f"top_k={top_k} exceeds the engine's in-graph cap "
                 f"{min(_MAXK, vocab)} (static top-k window)")
+        if deadline is None:
+            deadline = self.default_deadline
         r = Request(self._next_rid, prompt_ids, max_new_tokens, eos_token_id,
                     do_sample=do_sample, temperature=temperature,
-                    top_p=top_p, top_k=top_k, seed=seed)
+                    top_p=top_p, top_k=top_k, seed=seed, deadline=deadline)
         self._next_rid += 1
-        self._waiting.append(r)
+        if deadline is not None:
+            self._any_deadline = True
+        if self._should_shed():
+            self._finalize(r, RequestStatus.SHED)
+        else:
+            self._waiting.append(r)
         return r.rid
+
+    # ----------------------------------------------------- request lifecycle
+    def _should_shed(self):
+        """Watermark admission control over the same gauges metrics()
+        exports: a bounded waiting queue, plus a page-pressure floor that
+        sheds while a backlog already exists (an idle engine always admits —
+        a single fresh request can still run via preemption)."""
+        if self.max_waiting is not None \
+                and len(self._waiting) >= self.max_waiting:
+            return True
+        if self.shed_min_free_ratio > 0.0 and self._waiting:
+            avail = len(self._free_pages) + len(self._lru)
+            if avail < self.shed_min_free_ratio * (self.n_pages - 1):
+                return True
+        return False
+
+    def _finalize(self, r, status, error=None):
+        """Move ``r`` to its typed terminal status (the ONLY path into
+        ``_finished``), mirroring the terminal counters."""
+        r.status = status
+        r.done = True
+        r.slot = None
+        if error is not None:
+            r.error = f"{type(error).__name__}: {error}"
+        r.t_finish = time.perf_counter()
+        self._finished[r.rid] = r
+        if status is RequestStatus.SHED:
+            self.shed_requests += 1
+        elif status is RequestStatus.TIMEOUT:
+            self.timeouts += 1
+        elif status is RequestStatus.CANCELLED:
+            self.cancels += 1
+        elif status is RequestStatus.FAILED:
+            self.quarantined += 1
+        self._m.terminal[status].inc()
+
+    def cancel(self, rid):
+        """Cancel a request wherever it is: waiting (dequeued) or mid-serve
+        (slot released — pages return through the refcount machinery, so
+        prefix-cache pages other slots share stay live).  Returns True if
+        the request was found live; False if unknown or already terminal."""
+        for i, r in enumerate(self._waiting):
+            if r.rid == rid:
+                del self._waiting[i]
+                self._finalize(r, RequestStatus.CANCELLED)
+                return True
+        for slot, r in enumerate(self._slots):
+            if r is not None and r.rid == rid:
+                self._release(slot, RequestStatus.CANCELLED)
+                return True
+        return False
+
+    def _expire_deadlines(self):
+        """Deadline sweep at step entry: expired waiting requests are shed
+        unserved; an expired in-flight request finalizes cleanly (partial
+        output kept, pages released).  Both end TIMEOUT."""
+        now = time.perf_counter()
+        if self._waiting:
+            expired = [r for r in self._waiting
+                       if r.deadline is not None and now > r.deadline]
+            if expired:
+                self._waiting = deque(r for r in self._waiting
+                                      if not (r.deadline is not None
+                                              and now > r.deadline))
+                for r in expired:
+                    self._finalize(r, RequestStatus.TIMEOUT)
+        for slot, r in enumerate(self._slots):
+            if r is not None and r.deadline is not None and now > r.deadline:
+                self._release(slot, RequestStatus.TIMEOUT)
 
     # ------------------------------------------------------ page accounting
     def _page_keys(self, tokens):
@@ -714,6 +891,8 @@ class LLMEngine:
         """A writable page with refcount 1: free list first, then LRU
         eviction of the oldest cached-but-unreferenced page. Returns None
         when both are dry (the caller preempts — last resort)."""
+        if _faults.active and _faults.fire("serving.page_alloc") is not None:
+            return None               # injected allocation failure (dry pool)
         if self._free_pages:
             p = self._free_pages.popleft()
         elif self._lru:
@@ -805,8 +984,21 @@ class LLMEngine:
             for p in hits:                # ref hits BEFORE allocating fresh
                 self._ref_page(p)         # pages so eviction can't take them
                 pages.append(p)
+            aborted = False
             for _ in range(fresh):
-                pages.append(self._alloc_page())
+                p = self._alloc_page()
+                if p is None:
+                    # allocation failed mid-admission (injected fault, or a
+                    # racing claim): roll the claimed pages back and requeue
+                    # the request at the front — never a half-built table
+                    for q in pages:
+                        self._unref_page(q)
+                    self._waiting.appendleft(r)
+                    aborted = True
+                    break
+                pages.append(p)
+            if aborted:
+                break
             self._slot_tables[slot, :need] = pages
             self._slot_tables[slot, need:] = pages[-1]
             self._n_alloc[slot] = need
@@ -824,20 +1016,23 @@ class LLMEngine:
             r.pos = skip
             self._lens[slot] = skip
             r.slot = slot
+            r.status = RequestStatus.RUNNING
             r.admit_seq = self._admit_seq
             self._admit_seq += 1
             self._slots[slot] = r
 
-    def _release(self, slot, finished=True):
+    def _release(self, slot, status=None, error=None):
+        """Free the slot's pages through the refcounts; ``status`` None is
+        the requeue path (preemption — the request is NOT finalized), any
+        terminal status finalizes the request."""
         r = self._slots[slot]
         for p in self._slot_tables[slot, :int(self._n_alloc[slot])]:
             self._unref_page(int(p))
         self._slots[slot] = None
         self._lens[slot] = 0
         self._n_alloc[slot] = 0
-        if finished:
-            r.done = True
-            self._finished[r.rid] = r
+        if status is not None:
+            self._finalize(r, status, error=error)
 
     def _preempt_youngest(self, excluding):
         """Free the youngest slot's pages, requeueing it for recompute
@@ -853,8 +1048,9 @@ class LLMEngine:
         # folding the current (possibly already-folded) prompt would
         # duplicate earlier output on a second preemption
         r.prompt = r.prompt0 + r.out
-        self._release(slot, finished=False)
+        self._release(slot, status=None)
         r.slot = None
+        r.status = RequestStatus.QUEUED
         self._waiting.appendleft(r)
         self.preemptions += 1
         self._m.preempt.inc()
@@ -894,10 +1090,14 @@ class LLMEngine:
         hit_eos = (r.eos is not None and r.out[-1] == r.eos)
         if (len(r.out) >= r.max_new or hit_eos
                 or int(self._lens[slot]) >= self.max_len):
-            self._release(slot)
+            self._release(slot, RequestStatus.EOS if hit_eos
+                          else RequestStatus.FINISHED)
 
     def _prefill_chunk(self, slot):
         r = self._slots[slot]
+        self._step_phase = ("prefill", (slot,))
+        if _faults.active:
+            _faults.raise_if("serving.step", rids=[r.rid], phase="prefill")
         start = r.pos
         n = min(self.chunk, len(r.prompt) - start)
         if self.prefix_cache:
@@ -931,11 +1131,39 @@ class LLMEngine:
 
     def step(self):
         """One engine dispatch: a prefill chunk if any slot is mid-prompt,
-        else one decode token for every active slot. Returns #slots
-        served."""
+        else one decode token for every active slot. Returns #slots served.
+
+        This is the failure-isolation boundary: a step that raises never
+        kills the engine.  Transient errors (``err.transient`` truthy) are
+        retried with backoff; anything else triggers a quarantine sweep —
+        the failing dispatch is re-run one slot at a time and the slot that
+        still fails alone is finalized FAILED (pages freed), the rest keep
+        serving.  Isolation is exact for host-side failures; a fault inside
+        an already-dispatched XLA program is best-effort (the donated cache
+        buffer may be unrecoverable) — the engine still degrades per-request
+        instead of crashing the loop."""
+        if self._any_deadline:
+            self._expire_deadlines()
+        self._step_phase = ("admit", ())
+        try:
+            served = self._step_impl()
+        except Exception as e:  # noqa: BLE001 — the isolation boundary
+            served = self._survive_step_failure(e)
+        if self.debug_refcount_audit:
+            problems = self.audit_refcounts()
+            if problems:
+                raise RuntimeError("page-refcount audit failed:\n  "
+                                   + "\n  ".join(problems))
+        return served
+
+    def _step_impl(self):
         self._admit()
         if _obs.enabled():
             self._refresh_gauges()
+        if _faults.active:
+            point = _faults.fire("serving.slow_step")
+            if point is not None and point.delay:
+                time.sleep(point.delay)
         for slot, r in enumerate(self._slots):
             if r is not None and r.pos < len(r.prompt):
                 self._prefill_chunk(slot)
@@ -984,6 +1212,10 @@ class LLMEngine:
             topk[slot] = r.top_k
             seeds[slot] = self._next_seed(r)
             fold[slot] = 1 if r.seed is None else 0
+        self._step_phase = ("decode", tuple(s for s, _ in live))
+        if _faults.active:
+            _faults.raise_if("serving.step", rids=[r.rid for _, r in live],
+                             phase="decode")
         prog = self._decode_programs.get(k)
         compile_call = prog is None
         if compile_call:
@@ -1014,6 +1246,176 @@ class LLMEngine:
                 self._lens[slot] += 1
                 self._emit(slot, int(toks[j, slot]))
         return len(live)
+
+    # ----------------------------------------------------- failure isolation
+    def _survive_step_failure(self, e):
+        """Handle an exception that escaped :meth:`_step_impl`.  Transient
+        errors re-dispatch through the shared backoff policy; everything
+        else is attributed to a request and quarantined.  Returns the #slots
+        the recovery path ended up serving."""
+        phase, slots = self._step_phase
+        if phase == "admit":
+            # failed outside any dispatch — host-side bookkeeping, an
+            # engine bug rather than a poison request: surface it
+            raise e
+        self.step_failures += 1
+        self._m.step_fail[phase].inc()
+        if getattr(e, "transient", False):
+            ok, served, e = self._retry_step()
+            if ok:
+                return served
+            phase, slots = self._step_phase   # the failing retry's phase
+            if phase == "admit":
+                raise e
+        return self._isolate(phase, slots, e)
+
+    def _retry_step(self):
+        """Re-dispatch through the shared backoff policy.  Returns ``(True,
+        served, None)`` when a retry lands, ``(False, 0, err)`` when the
+        attempts run out — or a NON-transient error interrupts the retry
+        run; either way isolation takes over from whatever phase the final
+        error left in ``_step_phase``."""
+        def attempt():
+            try:
+                return self._step_impl()
+            except Exception as err:
+                if getattr(err, "transient", False):
+                    raise _TransientStep(err) from err
+                raise
+
+        def note(n, err, delay):
+            self.step_retries += 1
+
+        self.step_retries += 1        # the re-dispatch itself is a retry
+        try:
+            served = retry_call(attempt, policy=self._step_retry,
+                                retry_on=(_TransientStep,),
+                                op="serving.step", on_retry=note)
+        except RetryError as err:
+            return False, 0, err.__cause__.err
+        except Exception as err:  # noqa: BLE001 — non-transient mid-retry
+            return False, 0, err
+        return True, served, None
+
+    def _isolate(self, phase, slots, e):
+        """Quarantine the poison request(s) behind a failed dispatch: a
+        single-slot failure (prefill, or a 1-wide batch) is attributed
+        directly; a batched decode/verify failure is bisected by re-running
+        every member slot as a one-slot decode probe and quarantining
+        exactly those that still fail alone."""
+        todo = [s for s in slots if self._slots[s] is not None]
+        if len(todo) <= 1:
+            for s in todo:
+                self._quarantine(s, e)
+            return 0
+        served = 0
+        for s in todo:
+            if self._slots[s] is None:
+                continue          # released/preempted by an earlier probe
+            self.quarantine_probes += 1
+            self._m.probes.inc()
+            try:
+                self._decode_probe(s)
+                served += 1
+            except Exception as pe:  # noqa: BLE001 — probe attributes blame
+                self._quarantine(s, pe)
+        return served
+
+    def _quarantine(self, slot, err):
+        """Finalize the slot's request FAILED — the error is recorded on the
+        request, its pages return through the refcounts (shared prefix-cache
+        pages other slots map stay live) — and keep serving everyone else."""
+        self._release(slot, RequestStatus.FAILED, error=err)
+
+    def _decode_probe(self, slot):
+        """One-slot k=1 decode dispatch — the isolation probe run for each
+        member of a failed batch.  A raise here pins the failure on this
+        slot; success emits the token the probe decoded anyway, so a
+        surviving request loses no work to the sweep."""
+        r = self._slots[slot]
+        self._step_phase = ("decode", (slot,))
+        if _faults.active:
+            _faults.raise_if("serving.step", rids=[r.rid], phase="decode")
+        self._ensure_page(slot, ahead=1)
+        if self._slots[slot] is not r:
+            return                # growth preempted the probe target
+        active = np.zeros((self.max_batch,), np.int32)
+        tokens = np.zeros((self.max_batch,), np.int32)
+        greedy = np.ones((self.max_batch,), np.int32)
+        temp = np.ones((self.max_batch,), np.float32)
+        topp = np.ones((self.max_batch,), np.float32)
+        topk = np.zeros((self.max_batch,), np.int32)
+        seeds = np.zeros((self.max_batch,), np.int32)
+        fold = np.zeros((self.max_batch,), np.int32)
+        active[slot] = 1
+        tokens[slot] = r.out[-1]
+        greedy[slot] = 0 if r.do_sample else 1
+        temp[slot] = r.temperature
+        topp[slot] = r.top_p
+        topk[slot] = r.top_k
+        seeds[slot] = self._next_seed(r)
+        fold[slot] = 1 if r.seed is None else 0
+        prog = self._decode_programs.get(1)
+        if prog is None:
+            prog = self._decode_programs[1] = self._build_decode(1)
+        self._m.decode.inc()
+        with _obs.trace_span("serving.decode_probe"):
+            toks, self.cache = prog(
+                self.W, self.cache, jnp.asarray(tokens),
+                jnp.asarray(self._lens), jnp.asarray(self._slot_tables),
+                jnp.asarray(active), jnp.asarray(greedy), jnp.asarray(temp),
+                jnp.asarray(topp), jnp.asarray(topk), jnp.asarray(seeds),
+                jnp.asarray(fold))
+            toks = np.asarray(toks)
+        self._lens[slot] += 1
+        self._emit(slot, int(toks[0, slot]))
+
+    def audit_refcounts(self):
+        """Cross-check every page-accounting structure against the others;
+        returns a list of problem strings (empty means clean).  Invariants:
+        each page's refcount equals its slot-table references; free and
+        LRU-parked pages carry refcount 0 and never overlap; no page leaks
+        (refcount 0 yet neither free nor parked); LRU pages are
+        content-registered; the prefix key index is symmetric.  O(pages +
+        slots·pages_per_slot); runs after every step under
+        ``debug_refcount_audit``."""
+        problems = []
+        expected = np.zeros(self.n_pages, np.int64)
+        for slot, r in enumerate(self._slots):
+            if r is None:
+                continue
+            for j in range(int(self._n_alloc[slot])):
+                expected[int(self._slot_tables[slot, j])] += 1
+        free = [int(p) for p in self._free_pages]
+        free_set = set(free)
+        if len(free_set) != len(free):
+            problems.append("free list holds duplicate pages")
+        lru_set = {int(p) for p in self._lru}
+        both = free_set & lru_set
+        if both:
+            problems.append(f"pages both free and LRU-parked: {sorted(both)}")
+        for p in range(self.n_pages - 1):            # trash page excluded
+            refs, exp = int(self._page_ref[p]), int(expected[p])
+            if refs != exp:
+                problems.append(f"page {p}: refcount {refs} != "
+                                f"{exp} slot-table references")
+            if refs == 0 and p not in free_set and p not in lru_set:
+                problems.append(f"page {p}: leaked "
+                                "(refcount 0, neither free nor LRU-parked)")
+            if refs > 0 and (p in free_set or p in lru_set):
+                problems.append(f"page {p}: referenced but on the "
+                                "free/LRU list")
+        for p in lru_set:
+            if p not in self._page_key:
+                problems.append(f"page {p}: LRU-parked but not "
+                                "content-registered")
+        for p, key in self._page_key.items():
+            if self._key_page.get(key) != p:
+                problems.append(f"page {p}: page->key->page asymmetric")
+        for key, p in self._key_page.items():
+            if self._page_key.get(p) != key:
+                problems.append(f"page {p}: key->page->key asymmetric")
+        return problems
 
     # ---------------------------------------------------- speculative decode
     def _propose_drafts(self, live):
@@ -1064,6 +1466,10 @@ class LLMEngine:
             topk[slot] = r.top_k
             seeds[slot] = self._next_seed(r)
             fold[slot] = 1 if r.seed is None else 0
+        self._step_phase = ("verify", tuple(s for s, _ in live))
+        if _faults.active:
+            _faults.raise_if("serving.step", rids=[r.rid for _, r in live],
+                             phase="verify")
         prog = self._verify_programs.get(Kv)
         compile_call = prog is None
         if compile_call:
@@ -1285,3 +1691,41 @@ class LLMEngine:
     def ttft(self, rid):
         """Seconds from add_request to the first generated token."""
         return self._finished[rid].ttft
+
+    def status(self, rid):
+        """The request's :class:`RequestStatus` wherever it lives — waiting,
+        in a slot, or terminal.  KeyError for an unknown rid."""
+        for r in self._waiting:
+            if r.rid == rid:
+                return r.status
+        for r in self._slots:
+            if r is not None and r.rid == rid:
+                return r.status
+        return self._finished[rid].status
+
+    def error(self, rid):
+        """The recorded ``ExceptionType: message`` string for a FAILED
+        request; None for every other terminal status."""
+        return self._finished[rid].error
+
+    def health(self):
+        """One JSON-able liveness snapshot for external monitors — plain
+        counters, available whether or not observability is enabled."""
+        n_active = sum(1 for s in self._slots if s is not None)
+        return {
+            "active_slots": n_active,
+            "max_batch": self.max_batch,
+            "waiting": len(self._waiting),
+            "finished": len(self._finished),
+            "free_pages": len(self._free_pages),
+            "reclaimable_pages": len(self._lru),
+            "total_pages": self.n_pages - 1,
+            "shed_requests": self.shed_requests,
+            "timeouts": self.timeouts,
+            "cancels": self.cancels,
+            "quarantined": self.quarantined,
+            "step_failures": self.step_failures,
+            "step_retries": self.step_retries,
+            "quarantine_probes": self.quarantine_probes,
+            "preemptions": self.preemptions,
+        }

@@ -114,6 +114,32 @@ SERVING_SPEC_ACCEPTANCE = REGISTRY.histogram(
     "per-verify-step accepted/proposed draft ratio", ("engine",),
     buckets=(0.0, 0.25, 0.5, 0.75, 0.9, 1.0))
 
+SERVING_TERMINALS = REGISTRY.counter(
+    "serving_terminal_requests_total",
+    "requests reaching a typed terminal status "
+    "(finished/eos/timeout/cancelled/shed/failed)", ("engine", "status"))
+SERVING_STEP_FAILURES = REGISTRY.counter(
+    "serving_step_failures_total",
+    "engine step dispatches that raised (pre-isolation)",
+    ("engine", "phase"))                       # phase: prefill | decode | verify
+SERVING_QUARANTINE_PROBES = REGISTRY.counter(
+    "serving_quarantine_probes_total",
+    "single-slot isolation probes dispatched after a batched-step failure",
+    ("engine",))
+
+# shared retry helper (core/retry.py); op labels the retried operation
+RETRY_ATTEMPTS = REGISTRY.histogram(
+    "retry_attempts", "attempts consumed per retried operation", ("op",),
+    buckets=(1.0, 2.0, 3.0, 5.0, 8.0, 13.0))
+RETRY_EXHAUSTED = REGISTRY.counter(
+    "retry_exhausted_total", "retried operations that ran out of attempts",
+    ("op",))
+
+# collective watchdog (distributed/watchdog.py)
+COMM_WATCHDOG_TIMEOUTS = REGISTRY.counter(
+    "comm_watchdog_timeouts_total",
+    "collectives the watchdog declared timed out (probable hangs)", ("op",))
+
 # collective plane (distributed/collective.py + parallel/ layers)
 COLLECTIVE_CALLS = REGISTRY.counter(
     "collective_invocations_total",

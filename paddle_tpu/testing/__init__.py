@@ -1,0 +1,13 @@
+"""paddle_tpu.testing — deterministic chaos/fault tooling for tier-1 tests.
+
+The reference repo validates its fault paths with live multi-node kill tests;
+on a single CPU host the equivalent is *injected* failure: named fault points
+threaded through the serving engine and the control-plane store, driven by
+seeded schedules so every failure path is exercised deterministically (see
+:mod:`.faults`).
+"""
+from .faults import (FAULTS, FailNth, FailProb, FaultInjector,  # noqa: F401
+                     InjectedFault, injected)
+
+__all__ = ["FAULTS", "FaultInjector", "InjectedFault", "FailNth",
+           "FailProb", "injected"]

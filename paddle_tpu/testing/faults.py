@@ -1,0 +1,179 @@
+"""Deterministic fault injection — named fault points with seeded schedules.
+
+Production code declares *fault points* — ``FAULTS.fire("serving.page_alloc")``
+at the spot where an allocation could fail, ``FAULTS.raise_if("serving.step",
+rids=[...])`` where a dispatch could blow up — and pays one dict-emptiness
+check while nothing is installed.  Tests arm a point with a schedule:
+
+    from paddle_tpu.testing import FAULTS, FailNth, FailProb
+
+    FAULTS.install("serving.page_alloc", FailNth(3))          # 3rd call fails
+    FAULTS.install("serving.step", FailProb(0.2, seed=7))     # seeded coin
+    FAULTS.install("serving.step", FailNth(1), transient=True,
+                   match=lambda ctx: 42 in ctx.get("rids", ()))
+    ...
+    FAULTS.reset()
+
+or scoped with the context manager::
+
+    with injected("store.connect", FailNth({1, 2})):
+        ...
+
+Schedules are pure functions of their own call counter (plus a seeded RNG for
+:class:`FailProb`), so a chaos test replays the exact same failure sequence
+every run.  Known points today: ``serving.page_alloc`` (allocation returns
+dry), ``serving.step`` (dispatch raises :class:`InjectedFault`),
+``serving.slow_step`` (dispatch stalls ``delay`` seconds), ``store.connect``
+(client connect raises).  The registry is name-keyed and open: new subsystems
+add points without touching this module.
+"""
+from __future__ import annotations
+
+import random
+import threading
+from contextlib import contextmanager
+
+__all__ = ["InjectedFault", "FailNth", "FailProb", "Always", "Never",
+           "FaultPoint", "FaultInjector", "FAULTS", "injected"]
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an armed fault point. ``transient`` marks errors the
+    consuming subsystem should treat as retryable (the serving engine routes
+    those through its backoff path instead of quarantining a request)."""
+
+    def __init__(self, point, transient=False):
+        super().__init__(f"injected fault at {point!r}"
+                         + (" (transient)" if transient else ""))
+        self.point = point
+        self.transient = transient
+
+
+# ---- schedules ---------------------------------------------------------------
+class FailNth:
+    """Fire on specific 1-based call numbers: ``FailNth(3)`` fails the third
+    call only; ``FailNth({1, 2, 5})`` each listed call; ``FailNth(2, every=
+    True)`` call 2 and every call after it."""
+
+    def __init__(self, nth, every=False):
+        self.nth = {int(nth)} if isinstance(nth, int) else {int(n) for n in nth}
+        self.every = every
+        self._floor = min(self.nth)
+
+    def should_fire(self, n_call):
+        if self.every:
+            return n_call >= self._floor
+        return n_call in self.nth
+
+
+class FailProb:
+    """Fire with probability ``p`` per call from a private seeded stream —
+    chaotic in shape, bit-reproducible across runs."""
+
+    def __init__(self, p, seed=0):
+        if not 0.0 <= p <= 1.0:
+            raise ValueError("p must be in [0, 1]")
+        self.p = float(p)
+        self._rng = random.Random(seed)
+
+    def should_fire(self, n_call):
+        return self._rng.random() < self.p
+
+
+class Always:
+    def should_fire(self, n_call):
+        return True
+
+
+class Never:
+    def should_fire(self, n_call):
+        return False
+
+
+# ---- registry ----------------------------------------------------------------
+class FaultPoint:
+    """One armed point: a schedule, an optional context predicate, and the
+    knobs consumers read off a firing (``transient``, ``delay``)."""
+
+    def __init__(self, name, schedule, match=None, transient=False,
+                 delay=0.0):
+        self.name = name
+        self.schedule = schedule
+        self.match = match
+        self.transient = transient
+        self.delay = float(delay)
+        self.calls = 0          # times the point was evaluated
+        self.fires = 0          # times it actually fired
+
+    def evaluate(self, ctx):
+        if self.match is not None and not self.match(ctx):
+            return False
+        self.calls += 1
+        if self.schedule.should_fire(self.calls):
+            self.fires += 1
+            return True
+        return False
+
+
+class FaultInjector:
+    """Process-wide fault-point registry (usually the :data:`FAULTS`
+    singleton).  ``fire`` is the hot-path probe: with nothing installed it is
+    a single attribute read returning None."""
+
+    def __init__(self):
+        self._points: dict[str, FaultPoint] = {}
+        self._mu = threading.Lock()
+
+    @property
+    def active(self):
+        return bool(self._points)
+
+    def install(self, name, schedule, match=None, transient=False,
+                delay=0.0) -> FaultPoint:
+        point = FaultPoint(name, schedule, match=match, transient=transient,
+                           delay=delay)
+        with self._mu:
+            self._points[name] = point
+        return point
+
+    def remove(self, name):
+        with self._mu:
+            self._points.pop(name, None)
+
+    def reset(self):
+        with self._mu:
+            self._points.clear()
+
+    def point(self, name) -> FaultPoint | None:
+        return self._points.get(name)
+
+    def fire(self, name, **ctx) -> FaultPoint | None:
+        """Evaluate point ``name``; returns the :class:`FaultPoint` when it
+        fires (so the caller can read ``delay``/``transient``), else None."""
+        if not self._points:
+            return None
+        point = self._points.get(name)
+        if point is None or not point.evaluate(ctx):
+            return None
+        return point
+
+    def raise_if(self, name, **ctx):
+        """Raise :class:`InjectedFault` when point ``name`` fires."""
+        point = self.fire(name, **ctx)
+        if point is not None:
+            raise InjectedFault(name, transient=point.transient)
+
+
+FAULTS = FaultInjector()
+
+
+@contextmanager
+def injected(name, schedule, match=None, transient=False, delay=0.0):
+    """Arm ``name`` on the process singleton for the enclosed block; the
+    point is removed (not reset-all) on exit so nested injections compose."""
+    point = FAULTS.install(name, schedule, match=match, transient=transient,
+                           delay=delay)
+    try:
+        yield point
+    finally:
+        FAULTS.remove(name)

@@ -1,0 +1,40 @@
+"""Lint fixture: swallowed exceptions the robustness pass must catch.
+
+Never imported or executed — read as source.  Each handler below silently
+discards every failure; tests assert one RB101 warning per site.
+"""
+
+
+def bare_swallow(fn):
+    try:
+        return fn()
+    except:  # noqa: E722
+        pass
+
+
+def broad_swallow(fn):
+    try:
+        return fn()
+    except Exception:
+        pass
+
+
+def base_swallow(fn):
+    try:
+        return fn()
+    except BaseException:
+        pass
+
+
+def tuple_swallow(fn):
+    try:
+        return fn()
+    except (ValueError, Exception):
+        pass
+
+
+def ellipsis_swallow(fn):
+    try:
+        return fn()
+    except Exception:
+        ...

@@ -1,0 +1,35 @@
+"""Lint fixture: exception handling the robustness pass must NOT flag —
+narrow swallows, broad handlers that act, and pragma'd deliberate swallows."""
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def narrow_probe(d, k):
+    try:
+        return d[k]
+    except KeyError:          # narrow: idiomatic dict probing
+        pass
+    return None
+
+
+def broad_but_logged(fn):
+    try:
+        return fn()
+    except Exception as e:    # broad, but the error is surfaced
+        log.warning("fn failed: %s", e)
+        return None
+
+
+def broad_reraise(fn):
+    try:
+        return fn()
+    except Exception:
+        raise RuntimeError("fn failed")
+
+
+def deliberate(fn):
+    try:
+        return fn()
+    except Exception:  # graftlint: disable=robustness — shutdown cleanup
+        pass

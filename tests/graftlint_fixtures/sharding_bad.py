@@ -72,3 +72,11 @@ def bad_named_sharding(x):
     from jax.sharding import NamedSharding
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh, P("tp", None)))
+
+
+def bad_jit_shardings(fn, x):
+    # SS106 (jit keyword path): bare PartitionSpec in in_shardings resolves
+    # against the enclosing `with mesh:` context — 'fsdp' is not an axis
+    with mesh:
+        g = jax.jit(fn, in_shardings=(P("fsdp"),), out_shardings=P("dp"))
+        return g(x)

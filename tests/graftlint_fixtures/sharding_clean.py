@@ -36,3 +36,25 @@ def clean_dynamic_sharding(x, mesh2, spec):
     # dynamic mesh/spec: skipped, never guessed
     from jax.sharding import NamedSharding
     return jax.device_put(x, NamedSharding(mesh2, spec))
+
+
+def clean_jit_shardings(fn, x):
+    # bare PartitionSpec on axes the context mesh defines — no SS106
+    with mesh:
+        g = jax.jit(fn, in_shardings=(P("dp"),), out_shardings=P("dp", "mp"))
+        return g(x)
+
+
+def clean_jit_no_context(fn, x):
+    # no statically-known enclosing mesh: skipped, never guessed
+    g = jax.jit(fn, in_shardings=(P("anything"),))
+    return g(x)
+
+
+def clean_jit_named_sharding(fn, x):
+    # NamedSharding inside jit kwargs carries its OWN mesh — validated at
+    # its construction site, not against the context mesh
+    from jax.sharding import NamedSharding
+    with mesh:
+        g = jax.jit(fn, in_shardings=(NamedSharding(mesh, P("mp")),))
+        return g(x)

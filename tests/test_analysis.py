@@ -332,13 +332,19 @@ def test_sharding_spec_catches_seeded_violations():
     res = _sharding([FIXTURES / "sharding_bad.py"])
     assert _codes(res) == {"SS101", "SS102", "SS103", "SS104", "SS105",
                            "SS106"}
-    by_code = {f.code: f for f in res.findings}
-    assert "2 positional argument(s)" in by_code["SS101"].message
-    assert "'ep'" in by_code["SS102"].message
-    assert "'sep'" in by_code["SS103"].message
-    assert by_code["SS104"].severity == "warning"       # divergence risk
-    assert "3-tuple" in by_code["SS105"].message
-    assert "'tp'" in by_code["SS106"].message
+    by_code = {}
+    for f in res.findings:
+        by_code.setdefault(f.code, []).append(f)
+    assert "2 positional argument(s)" in by_code["SS101"][0].message
+    assert "'ep'" in by_code["SS102"][0].message
+    assert "'sep'" in by_code["SS103"][0].message
+    assert by_code["SS104"][0].severity == "warning"    # divergence risk
+    assert "3-tuple" in by_code["SS105"][0].message
+    # SS106 fires at BOTH spec-vs-mesh sites: the NamedSharding ctor and
+    # the bare PartitionSpec inside jit's in_shardings keyword
+    ss106 = " | ".join(f.message for f in by_code["SS106"])
+    assert "'tp'" in ss106 and "'fsdp'" in ss106
+    assert any("in_shardings" in f.message for f in by_code["SS106"])
     assert all(f.severity == "error" for f in res.findings
                if f.code != "SS104")
     assert all(f.hint for f in res.findings)
@@ -356,6 +362,42 @@ def test_sharding_spec_resolves_body_across_files():
     (f,) = res.findings
     assert f.path.endswith("sharding_xfile_use.py")
     assert "3 positional argument(s)" in f.message
+
+
+def test_jit_shardings_use_mesh_spelling(tmp_path):
+    src = """
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(jax.devices(), ("dp",))
+
+        def f(fn, x):
+            with jax.sharding.use_mesh(mesh):
+                g = jax.jit(fn, out_shardings=P("mp"))
+                return g(x)
+    """
+    res = _lint(tmp_path, src, select=["sharding-spec-coverage"])
+    assert _codes(res) == {"SS106"}
+    (f,) = res.findings
+    assert "'mp'" in f.message and "out_shardings" in f.message
+
+
+# --------------------------------------------------------------- robustness
+
+def test_robustness_flags_swallowed_exceptions():
+    res = run([str(FIXTURES / "robustness_bad.py")], select=["robustness"])
+    assert _codes(res) == {"RB101"}
+    assert len(res.findings) == 5
+    assert all(f.severity == "warning" for f in res.findings)
+    msgs = " | ".join(f.message for f in res.findings)
+    assert "bare except" in msgs and "except BaseException" in msgs
+    assert all(f.hint for f in res.findings)
+
+
+def test_robustness_clean_fixture_not_flagged():
+    res = run([str(FIXTURES / "robustness_clean.py")], select=["robustness"])
+    assert res.findings == []
+    assert res.suppressed == 1          # the pragma'd deliberate swallow
 
 
 def test_sharding_spec_repo_parallel_tree_is_clean():

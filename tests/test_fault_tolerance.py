@@ -1,0 +1,429 @@
+"""Fault tolerance: deterministic chaos over the serving engine, the shared
+retry helper, the fault-injection harness itself, and the control-plane
+store/watchdog robustness paths.
+
+The chaos suite's contract: under injected page-allocation failures, a
+poison request, deadline expiries, and cancellations, the engine (a) never
+dies, (b) gives every request exactly one typed terminal status, (c) leaks
+zero pages (refcount audit runs after every step), and (d) keeps every
+surviving greedy request token-exact with a fault-free run."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core.retry import RetryError, RetryPolicy, retry_call
+from paddle_tpu.testing import FAULTS, FailNth, FailProb, InjectedFault, injected
+from paddle_tpu.testing.faults import Always, Never
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+# ------------------------------------------------------------ fault harness
+
+class TestFaultHarness:
+    def test_fail_nth_schedules(self):
+        s = FailNth(3)
+        assert [s.should_fire(n) for n in (1, 2, 3, 4)] == [
+            False, False, True, False]
+        s = FailNth({1, 4})
+        assert [s.should_fire(n) for n in (1, 2, 3, 4)] == [
+            True, False, False, True]
+        s = FailNth(2, every=True)
+        assert [s.should_fire(n) for n in (1, 2, 3, 9)] == [
+            False, True, True, True]
+
+    def test_fail_prob_is_seed_reproducible(self):
+        sa, sb = FailProb(0.5, seed=7), FailProb(0.5, seed=7)
+        a = [sa.should_fire(n) for n in range(40)]
+        b = [sb.should_fire(n) for n in range(40)]
+        assert a == b and True in a and False in a
+        with pytest.raises(ValueError):
+            FailProb(1.5)
+
+    def test_match_does_not_consume_schedule(self):
+        # a poison-request matcher must not burn FailNth counts on calls
+        # for OTHER requests: calls increments only on matching contexts
+        with injected("p", FailNth(1), match=lambda c: c.get("rid") == 9) as pt:
+            assert FAULTS.fire("p", rid=1) is None
+            assert FAULTS.fire("p", rid=2) is None
+            assert pt.calls == 0
+            assert FAULTS.fire("p", rid=9) is pt
+            assert pt.calls == 1 and pt.fires == 1
+        assert not FAULTS.active
+
+    def test_raise_if_and_transient_flag(self):
+        with injected("q", Always(), transient=True):
+            with pytest.raises(InjectedFault) as ei:
+                FAULTS.raise_if("q")
+            assert ei.value.transient and ei.value.point == "q"
+        with injected("q", Never()):
+            FAULTS.raise_if("q")            # never fires
+
+    def test_injected_removes_only_its_point(self):
+        FAULTS.install("keep", Always())
+        with injected("scoped", Always()):
+            assert FAULTS.point("scoped") is not None
+        assert FAULTS.point("scoped") is None
+        assert FAULTS.point("keep") is not None
+
+
+# ------------------------------------------------------------- retry helper
+
+class TestRetry:
+    def test_succeeds_after_transient_failures(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("boom")
+            return "ok"
+
+        slept = []
+        out = retry_call(flaky, policy=RetryPolicy(max_attempts=5, seed=0),
+                         retry_on=(OSError,), sleep=slept.append)
+        assert out == "ok" and len(calls) == 3 and len(slept) == 2
+
+    def test_exhaustion_raises_retry_error_with_cause(self):
+        def dead():
+            raise OSError("down")
+
+        with pytest.raises(RetryError) as ei:
+            retry_call(dead, policy=RetryPolicy(max_attempts=3, seed=0),
+                       retry_on=(OSError,), op="x", sleep=lambda d: None)
+        assert ei.value.attempts == 3
+        assert isinstance(ei.value.__cause__, OSError)
+        assert "x failed after 3 attempt(s)" in str(ei.value)
+
+    def test_non_matching_error_propagates_immediately(self):
+        def bad():
+            raise ValueError("logic bug")
+
+        with pytest.raises(ValueError):
+            retry_call(bad, retry_on=(OSError,), sleep=lambda d: None)
+
+    def test_backoff_curve_capped_and_jittered_in_range(self):
+        p = RetryPolicy(max_attempts=6, base_delay=0.1, max_delay=0.4,
+                        multiplier=2.0, seed=3)
+        ds = list(p.delays())
+        caps = [0.1, 0.2, 0.4, 0.4, 0.4]
+        assert len(ds) == 5
+        for d, cap in zip(ds, caps):
+            assert cap / 2 <= d <= cap          # equal jitter: [cap/2, cap]
+        assert ds == list(RetryPolicy(max_attempts=6, base_delay=0.1,
+                                      max_delay=0.4, seed=3).delays())
+
+    def test_deadline_stops_before_overrunning_sleep(self):
+        now = [0.0]
+
+        def clock():
+            return now[0]
+
+        def sleep(d):
+            now[0] += d
+
+        def dead():
+            raise OSError("down")
+
+        with pytest.raises(RetryError) as ei:
+            retry_call(dead, policy=RetryPolicy(
+                max_attempts=50, base_delay=1.0, multiplier=1.0,
+                jitter=False, deadline=3.5), retry_on=(OSError,),
+                sleep=sleep, clock=clock)
+        # 1s per sleep: attempts at t=0,1,2,3; the sleep to t=4 would
+        # overrun the 3.5s deadline, so exactly 4 attempts happen
+        assert ei.value.attempts == 4
+
+
+# ----------------------------------------------------------- serving chaos
+
+def _tiny_model():
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=176,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=128)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+class TestServingChaos:
+    @pytest.fixture(scope="class")
+    def model(self):
+        return _tiny_model()
+
+    def _engine(self, model, **kw):
+        from paddle_tpu.inference.serving import LLMEngine
+        kw.setdefault("max_batch", 3)
+        kw.setdefault("max_len", 64)
+        kw.setdefault("page_size", 8)
+        kw.setdefault("debug_refcount_audit", True)   # audit EVERY step
+        return LLMEngine(model, **kw)
+
+    def _prompts(self, n, seed=0):
+        rng = np.random.RandomState(seed)
+        return [rng.randint(1, 128, (4 + 3 * i,)).astype(np.int32)
+                for i in range(n)]
+
+    def test_chaos_survivors_token_exact(self, model):
+        """The acceptance chaos run: page-alloc failures + a poison request
+        + a deadline expiry during a multi-request serve.  Survivors match
+        the fault-free run token for token; every request ends in exactly
+        one typed terminal status; the per-step refcount audit stays
+        clean."""
+        from paddle_tpu.inference.serving import RequestStatus
+        prompts = self._prompts(5)
+
+        ref_eng = self._engine(model)
+        ref_rids = [ref_eng.add_request(p, max_new_tokens=6) for p in prompts]
+        ref_eng.run_until_done()
+        ref = {i: ref_eng.result(r) for i, r in enumerate(ref_rids)}
+
+        eng = self._engine(model)
+        rids = [eng.add_request(p, max_new_tokens=6) for p in prompts]
+        # request #2 is expired before it can finish; #3 is poison (its
+        # batched decode dispatches always fail; probes pin the blame)
+        eng._waiting[2].deadline = time.perf_counter() - 1.0
+        eng._any_deadline = True
+        poison = rids[3]
+        FAULTS.install("serving.page_alloc", FailNth({2, 5, 9}))
+        FAULTS.install(
+            "serving.step", Always(),
+            match=lambda ctx: (ctx.get("phase") == "decode"
+                               and poison in ctx.get("rids", ())))
+        eng.run_until_done()
+        FAULTS.reset()
+
+        statuses = {i: eng.status(r) for i, r in enumerate(rids)}
+        assert statuses[2] == RequestStatus.TIMEOUT
+        assert statuses[3] == RequestStatus.FAILED
+        assert "InjectedFault" in eng.error(poison)
+        for i in (0, 1, 4):                      # the survivors
+            assert statuses[i] == RequestStatus.FINISHED
+            assert eng.result(rids[i]) == ref[i], i
+        assert eng.quarantined == 1 and eng.timeouts == 1
+        assert eng.step_failures >= 1
+        assert eng.audit_refcounts() == []       # zero leaked pages
+        h = eng.health()
+        assert h["active_slots"] == 0 and h["waiting"] == 0
+        assert h["finished"] == len(rids)
+
+    def test_seeded_probability_chaos_converges(self, model):
+        """FailProb page-alloc chaos: allocation randomly (but seed-
+        reproducibly) runs dry; every request still finishes and matches
+        the fault-free tokens."""
+        from paddle_tpu.inference.serving import RequestStatus
+        prompts = self._prompts(4, seed=1)
+        ref_eng = self._engine(model)
+        ref = [ref_eng.add_request(p, max_new_tokens=5) for p in prompts]
+        ref_eng.run_until_done()
+        eng = self._engine(model)
+        rids = [eng.add_request(p, max_new_tokens=5) for p in prompts]
+        with injected("serving.page_alloc", FailProb(0.3, seed=11)):
+            eng.run_until_done()
+        for rr, r in zip(ref, rids):
+            assert eng.status(r) == RequestStatus.FINISHED
+            assert eng.result(r) == ref_eng.result(rr)
+        assert eng.audit_refcounts() == []
+
+    def test_transient_step_errors_are_retried(self, model):
+        from paddle_tpu.inference.serving import RequestStatus
+        prompts = self._prompts(3, seed=2)
+        ref_eng = self._engine(model)
+        ref = [ref_eng.add_request(p, max_new_tokens=5) for p in prompts]
+        ref_eng.run_until_done()
+        eng = self._engine(model)
+        rids = [eng.add_request(p, max_new_tokens=5) for p in prompts]
+        with injected("serving.step", FailNth({2, 7}), transient=True):
+            eng.run_until_done()
+        assert eng.step_retries >= 1 and eng.quarantined == 0
+        for rr, r in zip(ref, rids):
+            assert eng.status(r) == RequestStatus.FINISHED
+            assert eng.result(r) == ref_eng.result(rr)
+
+    def test_poison_prefill_quarantined_without_probes(self, model):
+        # prefill is single-slot: attribution is direct, no probe sweep
+        from paddle_tpu.inference.serving import RequestStatus
+        prompts = self._prompts(3, seed=3)
+        eng = self._engine(model)
+        rids = [eng.add_request(p, max_new_tokens=4) for p in prompts]
+        poison = rids[1]
+        FAULTS.install(
+            "serving.step", Always(),
+            match=lambda ctx: (ctx.get("phase") == "prefill"
+                               and poison in ctx.get("rids", ())))
+        eng.run_until_done()
+        FAULTS.reset()
+        assert eng.status(poison) == RequestStatus.FAILED
+        assert eng.quarantine_probes == 0
+        assert [eng.status(r) for r in rids if r != poison] == [
+            RequestStatus.FINISHED] * 2
+        assert eng.audit_refcounts() == []
+
+    def test_slow_step_fault_stalls_but_serves(self, model):
+        from paddle_tpu.inference.serving import RequestStatus
+        eng = self._engine(model)
+        rid = eng.add_request([1, 2, 3, 4], max_new_tokens=3)
+        t0 = time.perf_counter()
+        with injected("serving.slow_step", FailNth(1), delay=0.2):
+            eng.run_until_done()
+        assert time.perf_counter() - t0 >= 0.2
+        assert eng.status(rid) == RequestStatus.FINISHED
+
+    def test_deadline_mid_decode_keeps_partial_output(self, model):
+        from paddle_tpu.inference.serving import RequestStatus
+        eng = self._engine(model)
+        rid = eng.add_request([1, 2, 3, 4], max_new_tokens=50, deadline=30.0)
+        for _ in range(4):                       # prefill + a few tokens
+            eng.step()
+        r = eng._slots[[s is not None for s in eng._slots].index(True)]
+        n_before = len(r.out)
+        assert n_before >= 1
+        r.deadline = time.perf_counter() - 1.0   # force expiry
+        eng.step()
+        assert eng.status(rid) == RequestStatus.TIMEOUT
+        assert len(eng.result(rid)) == n_before  # partial output kept
+        assert eng.audit_refcounts() == []
+
+    def test_cancel_during_prefill(self, model):
+        from paddle_tpu.inference.serving import RequestStatus
+        # prompt spans several prefill chunks; cancel after the first
+        eng = self._engine(model, prefill_chunk=8)
+        rng = np.random.RandomState(4)
+        rid = eng.add_request(rng.randint(1, 128, (30,)), max_new_tokens=4)
+        other = eng.add_request(rng.randint(1, 128, (5,)), max_new_tokens=4)
+        eng.step()                               # first prefill chunk only
+        r = next(s for s in eng._slots if s is not None and s.rid == rid)
+        assert r.pos < len(r.prompt)             # genuinely mid-prefill
+        assert eng.cancel(rid) is True
+        eng.run_until_done()
+        assert eng.status(rid) == RequestStatus.CANCELLED
+        assert eng.result(rid) == []
+        assert eng.status(other) == RequestStatus.FINISHED
+        assert eng.audit_refcounts() == []
+
+    def test_cancel_request_sharing_prefix_pages(self, model):
+        """Cancelling a request whose pages the prefix cache shares with a
+        live request must not free the shared pages out from under it."""
+        from paddle_tpu.inference.serving import RequestStatus
+        eng = self._engine(model, prefix_cache=True, max_batch=2)
+        prompt = list(range(1, 25))              # three full 8-token pages
+        a = eng.add_request(prompt, max_new_tokens=8)
+        while eng._waiting:                      # admit + let pages register
+            eng.step()
+        for _ in range(3):
+            eng.step()
+        b = eng.add_request(prompt, max_new_tokens=8)  # shares a's pages
+        while eng._waiting:
+            eng.step()
+        assert eng.cache_hits > 0                # b really did share pages
+        assert eng.cancel(a) is True             # free sharer mid-flight
+        eng.step()
+        assert eng.audit_refcounts() == []       # shared pages survived
+        eng.run_until_done()
+        assert eng.status(a) == RequestStatus.CANCELLED
+        assert eng.status(b) == RequestStatus.FINISHED
+        assert len(eng.result(b)) == 8
+        assert eng.audit_refcounts() == []
+
+    def test_cancel_waiting_and_unknown(self, model):
+        from paddle_tpu.inference.serving import RequestStatus
+        eng = self._engine(model, max_batch=1)
+        busy = eng.add_request([1, 2, 3], max_new_tokens=4)
+        queued = eng.add_request([4, 5, 6], max_new_tokens=4)
+        eng.step()
+        assert eng.cancel(queued) is True        # still waiting: dequeued
+        assert eng.cancel(queued) is False       # already terminal
+        assert eng.cancel(10_000) is False       # unknown rid
+        eng.run_until_done()
+        assert eng.status(queued) == RequestStatus.CANCELLED
+        assert eng.status(busy) == RequestStatus.FINISHED
+
+    def test_admission_control_sheds_on_queue_bound(self, model):
+        from paddle_tpu.inference.serving import RequestStatus
+        eng = self._engine(model, max_batch=1, max_waiting=2)
+        rids = [eng.add_request([1, 2, 3], max_new_tokens=3)
+                for _ in range(5)]
+        # nothing has been admitted to a slot yet, so all five queue:
+        # the bound of 2 sheds the last three
+        shed = [r for r in rids if eng.status(r) == RequestStatus.SHED]
+        assert len(shed) == 3 and eng.shed_requests == 3
+        eng.run_until_done()
+        for r in rids:
+            if r not in shed:
+                assert eng.status(r) == RequestStatus.FINISHED
+        # terminal statuses also reached the metrics registry mirror
+        assert eng.health()["shed_requests"] == 3
+
+    def test_shed_terminal_counters_in_registry(self, model):
+        from paddle_tpu import observability as obs
+        obs.reset()
+        obs.enable()
+        try:
+            eng = self._engine(model, max_batch=1, max_waiting=1)
+            rids = [eng.add_request([1, 2], max_new_tokens=2)
+                    for _ in range(4)]
+            eng.run_until_done()
+            snap = obs.snapshot(prefix="serving_terminal_requests_total")
+            series = snap["serving_terminal_requests_total"]["series"]
+            mine = {s["labels"]["status"]: s["value"] for s in series
+                    if s["labels"]["engine"] == eng._m.label}
+            assert mine.get("shed") == 3
+            assert mine.get("finished") == 1
+            assert rids
+        finally:
+            obs.disable()
+            obs.reset()
+
+
+# ------------------------------------------------------ store + watchdog
+
+class TestControlPlaneFaults:
+    def test_store_reconnect_with_injected_drops(self, monkeypatch):
+        from paddle_tpu.distributed.store import TCPStore
+        monkeypatch.setenv("PADDLE_TPU_PURE_PY_STORE", "1")
+        master = TCPStore(is_master=True, timeout=20)
+        # first two connect attempts fail; backoff retries land the third
+        with injected("store.connect", FailNth({1, 2})) as point:
+            client = TCPStore(host="127.0.0.1", port=master.port, timeout=20)
+        assert point.fires == 2 and point.calls == 3
+        master.set("k", {"v": 1})
+        assert client.get("k") == {"v": 1}
+
+    def test_store_connect_exhaustion_times_out(self, monkeypatch):
+        from paddle_tpu.distributed.store import TCPStore
+        monkeypatch.setenv("PADDLE_TPU_PURE_PY_STORE", "1")
+        master = TCPStore(is_master=True, timeout=20)
+        with injected("store.connect", Always()):
+            with pytest.raises(TimeoutError, match="could not reach"):
+                TCPStore(host="127.0.0.1", port=master.port, timeout=0.3)
+
+    def test_watchdog_timeout_counter(self):
+        from paddle_tpu import observability as obs
+        from paddle_tpu.distributed.watchdog import CommTaskManager
+        mgr = CommTaskManager()                  # private, not the singleton
+        obs.reset()
+        obs.enable()
+        try:
+            fired = threading.Event()
+            mgr.enable(timeout=0.05, poll_interval=0.01,
+                       on_timeout=lambda t: fired.set())
+            seq = mgr.begin("all_reduce", rank=0)
+            assert seq > 0
+            assert fired.wait(5.0)
+            mgr.disable()
+            child = obs.COMM_WATCHDOG_TIMEOUTS.labels(op="all_reduce")
+            assert child.value >= 1.0
+        finally:
+            mgr.disable()
+            obs.disable()
+            obs.reset()
