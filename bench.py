@@ -700,6 +700,91 @@ def _serving_bench(paddle, on_tpu, budget_left_s=None):
         return None
 
 
+def _frontend_bench(paddle, on_tpu, budget_left_s=None):
+    """Serving front-door extra: a 2-replica ReplicaSet driven by the
+    deterministic trace loadgen at N in {4, 16, 64} closed-loop clients,
+    prefix-affinity routing vs round-robin.  Reports aggregate tokens/s and
+    p50/p95 TTFT per (N, router) plus the prefix-cache hit ratio the router
+    earned.  Best-effort: returns a dict or None; each N level is clamped
+    up front by the wall-budget projection (same discipline as the serving
+    extra)."""
+    try:
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.inference.serving import LLMEngine
+        from paddle_tpu.inference.frontend import ReplicaSet
+        from paddle_tpu.inference.frontend.loadgen import (make_trace,
+                                                           run_closed_loop,
+                                                           summarize)
+        from paddle_tpu.inference.frontend.router import (
+            PrefixAffinityRouter, RoundRobinRouter)
+        paddle.seed(0)
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=512,
+                          intermediate_size=1408, num_hidden_layers=4,
+                          num_attention_heads=8, num_key_value_heads=4,
+                          max_position_embeddings=1024) if on_tpu \
+            else LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        PAGE = 16 if on_tpu else 8
+        PREFIX_PAGES, SUFFIX, NEW = (8, 16, 32) if on_tpu else (2, 3, 4)
+        max_len = PREFIX_PAGES * PAGE + SUFFIX + NEW + PAGE
+        t_enter = time.perf_counter()
+
+        def _mk_set(router):
+            return ReplicaSet(
+                [LLMEngine(m, max_batch=4, max_len=max_len, page_size=PAGE,
+                           prefix_cache=True) for _ in range(2)],
+                router=router)
+
+        def _run(router, n_clients, n_requests):
+            trace = make_trace(3, n_requests, groups=4,
+                               prefix_pages=PREFIX_PAGES, page_size=PAGE,
+                               suffix_tokens=SUFFIX, max_new_tokens=NEW,
+                               group_major=True)
+            rs = _mk_set(router)
+            try:
+                records, wall = run_closed_loop(rs, trace,
+                                                concurrency=n_clients)
+                stats = [r.engine.prefix_cache_stats() for r in rs.replicas]
+            finally:
+                rs.close()
+            s = summarize(records, wall)
+            hits = sum(st["hits"] for st in stats)
+            lookups = hits + sum(st["misses"] for st in stats)
+            s["prefix_hit_ratio"] = round(hits / lookups, 3) if lookups \
+                else None
+            return s
+
+        out = {"replicas": 2, "by_concurrency": {}}
+        sect0 = None
+        for n in (4, 16, 64):
+            n_requests = 2 * n
+            if sect0 is not None and budget_left_s is not None:
+                spent = time.perf_counter() - t_enter
+                projected = sect0 * (n_requests / 8)
+                if spent + projected > budget_left_s:
+                    out.setdefault("skipped", []).append(f"N={n}")
+                    print(f"frontend extra 'N={n}' skipped: projected "
+                          f"{projected:.0f}s would overrun the "
+                          f"{budget_left_s - spent:.0f}s left in the wall "
+                          f"budget", file=sys.stderr)
+                    continue
+            t0 = time.perf_counter()
+            out["by_concurrency"][str(n)] = {
+                "routed": _run(PrefixAffinityRouter(page_size=PAGE), n,
+                               n_requests),
+                "round_robin": _run(RoundRobinRouter(), n, n_requests)}
+            if sect0 is None:
+                # first level's wall (includes compile warmup) calibrates
+                # the projections for the bigger levels
+                sect0 = time.perf_counter() - t0
+        return out
+    except Exception as e:  # noqa: BLE001 — extras must not kill the bench
+        print(f"frontend bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return None
+
+
 def _decode_bench(paddle, on_tpu):
     """KV-cache decode throughput on a small Llama (serving-path extra).
     Best-effort: returns tokens/s or None."""
@@ -1116,6 +1201,11 @@ def main():
     art["extra"]["decode_tokens_per_sec"] = _decode_bench(paddle, on_tpu)
     _flush_partial()
     art["extra"]["serving"] = _serving_bench(
+        paddle, on_tpu,
+        _budget - (300 if on_tpu else 10)
+        - (time.perf_counter() - _t_start))
+    _flush_partial()
+    art["extra"]["frontend"] = _frontend_bench(
         paddle, on_tpu,
         _budget - (300 if on_tpu else 10)
         - (time.perf_counter() - _t_start))

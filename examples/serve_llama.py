@@ -1,14 +1,17 @@
-"""Serve a (tiny) Llama with the continuous-batching paged-KV engine.
+"""Serve a (tiny) Llama behind the streaming serving front door.
 
-Features on display: chunked prefill, in-graph per-request sampling,
-on-demand paging with preemption, RTT-adaptive decode blocks, and int8
-KV-cache pages (~2x slots at the same HBM budget).
+Features on display: a 2-replica :class:`ReplicaSet` of continuous-batching
+paged-KV engines (chunked prefill, int8 KV pages, RTT-adaptive decode
+blocks), prefix-affinity routing, SLO-aware admission, and the stdlib SSE
+gateway -- the script starts the HTTP front door, drives it with a few
+clients (streaming and non-streaming), and prints what came back.
 
 Run:  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python examples/serve_llama.py
 
-Set METRICS_PORT to also expose engine telemetry on a Prometheus pull
-endpoint for the duration of the run (e.g. METRICS_PORT=9400 -> scrape
-http://127.0.0.1:9400/metrics; 0 lets the OS pick a port).
+Set METRICS_PORT to also expose engine + frontend telemetry on a
+Prometheus pull endpoint for the duration of the run (e.g.
+METRICS_PORT=9400 -> scrape http://127.0.0.1:9400/metrics; 0 lets the OS
+pick a port).  The gateway itself always serves /metrics too.
 """
 import os
 import sys
@@ -23,6 +26,8 @@ import paddle_tpu as paddle
 from paddle_tpu import observability as obs
 from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 from paddle_tpu.inference.serving import LLMEngine
+from paddle_tpu.inference.frontend import (
+    ReplicaSet, SLOAdmission, start_gateway, http_completion)
 
 
 def main():
@@ -35,26 +40,48 @@ def main():
         print(f"metrics endpoint: {metrics.url}")
     model = LlamaForCausalLM(LlamaConfig.tiny())
     model.eval()
-    eng = LLMEngine(model, max_batch=2, max_len=96, page_size=8,
-                    prefill_chunk=16, decode_block="auto",
-                    kv_cache_dtype="int8")
+
+    def _engine():
+        return LLMEngine(model, max_batch=2, max_len=96, page_size=8,
+                         prefill_chunk=16, decode_block="auto",
+                         kv_cache_dtype="int8", prefix_cache=True)
+
     rng = np.random.RandomState(0)
-    rids = [eng.add_request(
-        rng.randint(1, model.config.vocab_size, (12,)).astype(np.int32),
-        max_new_tokens=16, do_sample=bool(i), temperature=0.8, top_p=0.9,
-        seed=7) for i in range(3)]
-    steps = eng.run_until_done()
-    for rid in rids:
-        toks = eng.result(rid)
-        print(f"request {rid}: {len(toks)} tokens, "
-              f"TTFT {eng.ttft(rid) * 1e3:.1f} ms -> {toks[:8]}...")
-    print(f"engine dispatches: {steps}, "
-          f"auto decode block: {eng.auto_decode_block}, "
-          f"KV bytes/page: {eng.kv_bytes_per_page()}")
+    with ReplicaSet([_engine(), _engine()],
+                    admission=SLOAdmission(max_queue_per_replica=32)) as rs:
+        gw = start_gateway(rs, port=int(os.environ.get("PORT", 0)))
+        print(f"front door: {gw.url}/v1/completions")
+        try:
+            shared = rng.randint(
+                1, model.config.vocab_size, (12,)).tolist()
+            # one streaming client: tokens arrive as SSE events
+            out = http_completion(gw.url, shared, max_tokens=16,
+                                  stream=True)
+            print(f"stream: {len(out['tokens'])} tokens over "
+                  f"{out['events']} SSE events ({out['status']}) "
+                  f"-> {out['tokens'][:8]}...")
+            # a few non-streaming clients sharing the same prompt prefix,
+            # so the router can exploit the replicas' prefix caches
+            for i in range(3):
+                prompt = shared + rng.randint(
+                    1, model.config.vocab_size, (4,)).tolist()
+                out = http_completion(
+                    gw.url, prompt, max_tokens=16, do_sample=bool(i),
+                    temperature=0.8, top_p=0.9, seed=7)
+                print(f"request {i}: {len(out['tokens'])} tokens on "
+                      f"{out['replica']} ({out['status']}) "
+                      f"-> {out['tokens'][:8]}...")
+            for name, h in rs.health().items():
+                print(f"replica {name}: finished={h['finished']} "
+                      f"free_pages={h['free_pages']} alive={h['alive']}")
+        finally:
+            gw.close()
     if metrics is not None:
-        ttft = [ln for ln in obs.render_prometheus().splitlines()
-                if ln.startswith("serving_ttft_seconds_count")]
-        print("scraped:", *ttft, sep="\n  ")
+        lines = [ln for ln in obs.render_prometheus().splitlines()
+                 if ln.startswith(("serving_ttft_seconds_count",
+                                   "frontend_requests_total",
+                                   "frontend_routed_total"))]
+        print("scraped:", *lines, sep="\n  ")
         metrics.close()
         obs.disable()
 

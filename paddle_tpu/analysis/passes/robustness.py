@@ -7,10 +7,16 @@ expiries; the retry helper re-raises after backoff).  Flagged:
 
   * ``except: pass`` / ``except Exception: pass`` /
     ``except BaseException: pass`` (``...`` counts as ``pass``).  (RB101)
+  * a broad handler whose whole body is a bare control-flow escape —
+    ``continue``, ``break``, ``return`` / ``return None`` — the loop-shaped
+    variant of the same swallow: the failure vanishes AND the iteration's
+    work silently disappears with it.  (RB102)
 
-Narrow handlers (``except KeyError: pass``) are idiomatic dict-probing and
-stay silent.  Deliberate broad swallows — shutdown paths where any cleanup
-error is acceptable — carry a line pragma or a baseline entry stating so.
+Narrow handlers (``except KeyError: continue``) are idiomatic probing and
+stay silent, as are broad handlers that do anything observable (log, count,
+record) before escaping.  Deliberate broad swallows — shutdown paths where
+any cleanup error is acceptable, best-effort per-item scans — carry a line
+pragma or a baseline entry stating so.
 """
 from __future__ import annotations
 
@@ -48,23 +54,50 @@ def _swallows(handler):
             and stmt.value.value is Ellipsis)
 
 
+def _escapes(handler):
+    """Body is a single bare control-flow escape: the RB102 shape.  A
+    ``return <value>`` (other than an explicit None) communicates something
+    to the caller, so it does not count."""
+    if len(handler.body) != 1:
+        return False
+    stmt = handler.body[0]
+    if isinstance(stmt, (ast.Continue, ast.Break)):
+        return type(stmt).__name__.lower()
+    if isinstance(stmt, ast.Return):
+        if stmt.value is None or (isinstance(stmt.value, ast.Constant)
+                                  and stmt.value.value is None):
+            return "return"
+    return False
+
+
 @register_pass
 class RobustnessPass(AnalysisPass):
     name = "robustness"
-    version = 1
+    version = 2
     description = ("swallowed exceptions: broad except handlers whose "
-                   "whole body is pass")
+                   "whole body is pass (RB101) or a bare "
+                   "continue/break/return (RB102)")
 
     def check_file(self, src) -> list[Finding]:
         findings: list[Finding] = []
         for node in ast.walk(src.tree):
             if not isinstance(node, ast.ExceptHandler):
                 continue
-            if _is_broad(node) and _swallows(node):
-                what = ("bare except" if node.type is None
-                        else f"except {ast.unparse(node.type)}")
+            if not _is_broad(node):
+                continue
+            what = ("bare except" if node.type is None
+                    else f"except {ast.unparse(node.type)}")
+            if _swallows(node):
                 findings.append(Finding(
                     self.name, "RB101", src.path, node.lineno,
                     f"{what}: pass — swallows every failure silently",
+                    _HINT, severity="warning"))
+                continue
+            esc = _escapes(node)
+            if esc:
+                findings.append(Finding(
+                    self.name, "RB102", src.path, node.lineno,
+                    f"{what}: {esc} — swallows the failure and silently "
+                    f"drops the iteration's work",
                     _HINT, severity="warning"))
         return findings

@@ -69,13 +69,30 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     v.copy_to_host_async()
                 except Exception:
                     pass
-        snapshot = {k: np.asarray(v) if isinstance(v, jax.Array) and
-                    getattr(v.sharding, "num_devices", 1) == 1
-                    else v for k, v in arrays.items()}
+        snapshot = {k: _snapshot_for_queue(v) for k, v in arrays.items()}
         _async_queue.put((snapshot, meta, path))
         _ensure_async_worker()
         return
     _write(arrays, meta, path)
+
+
+def _snapshot_for_queue(v):
+    """A buffer the writer thread owns outright.  ``np.asarray`` of a CPU
+    ``jax.Array`` can be a ZERO-COPY view and plain ``np.ndarray`` params
+    are the caller's own mutable storage — queueing either by reference
+    means an in-place update (or donation) right after ``async_save``
+    returns silently corrupts the checkpoint being written.  Single-device
+    arrays are force-copied to host; multi-device arrays are rebuilt from
+    per-shard host copies on their original sharding so the shard-wise
+    write path still deduplicates replicas."""
+    if isinstance(v, jax.Array):
+        if getattr(v.sharding, "num_devices", 1) == 1:
+            return np.array(v)                      # copy, never a view
+        shards = [jax.device_put(np.array(s.data), s.device)
+                  for s in v.addressable_shards]
+        return jax.make_array_from_single_device_arrays(
+            v.shape, v.sharding, shards)
+    return np.array(v)                              # detach from caller
 
 
 def _write(arrays, meta, path):

@@ -1,0 +1,43 @@
+"""paddle_tpu.inference.frontend — the serving front door.
+
+Turns N single-caller :class:`~paddle_tpu.inference.serving.LLMEngine`
+replicas into one service:
+
+- :mod:`.replica` — per-replica step-loop threads behind a thread-safe
+  submit/stream/cancel facade (:class:`ReplicaSet`), with replica death as a
+  first-class typed event.
+- :mod:`.router` — prefix-cache-aware routing on the engine's own chain-hash
+  page keys (:class:`PrefixAffinityRouter`), round-robin baseline.
+- :mod:`.admission` — SLO-aware shedding before a request reaches a replica
+  (:class:`SLOAdmission`), typed :class:`ShedError`.
+- :mod:`.gateway` — stdlib streaming HTTP/SSE server
+  (``POST /v1/completions``, ``/healthz``, ``/metrics``).
+- :mod:`.loadgen` — deterministic trace-driven load generation for tests and
+  the bench frontend extra.
+
+Quick start::
+
+    from paddle_tpu.inference.frontend import ReplicaSet, start_gateway
+
+    rs = ReplicaSet([engine_a, engine_b])           # threads start here
+    gw = start_gateway(rs, port=8000)
+    ...  # POST http://127.0.0.1:8000/v1/completions
+    gw.close(); rs.close()
+"""
+from .admission import (AdmissionDecision, AlwaysAdmit,  # noqa: F401
+                        ShedError, SLOAdmission)
+from .gateway import Gateway, start_gateway  # noqa: F401
+from .loadgen import (http_completion, make_trace,  # noqa: F401
+                      run_closed_loop, summarize)
+from .replica import (EngineReplica, ReplicaDeadError,  # noqa: F401
+                      ReplicaSet, RequestHandle)
+from .router import (PrefixAffinityRouter, RouteDecision,  # noqa: F401
+                     RoundRobinRouter)
+
+__all__ = [
+    "ReplicaSet", "EngineReplica", "RequestHandle", "ReplicaDeadError",
+    "PrefixAffinityRouter", "RoundRobinRouter", "RouteDecision",
+    "SLOAdmission", "AlwaysAdmit", "AdmissionDecision", "ShedError",
+    "Gateway", "start_gateway",
+    "make_trace", "run_closed_loop", "summarize", "http_completion",
+]

@@ -1,0 +1,210 @@
+"""Streaming HTTP/SSE gateway over a :class:`~.replica.ReplicaSet`.
+
+Pure stdlib (same ``ThreadingHTTPServer`` discipline as
+``observability/exporter.py`` — daemon threads, handle object with
+``url``/``close()``): each request runs on its own handler thread and blocks
+on the replica's condition variable, so N concurrent clients cost N parked
+threads, not N polling loops.
+
+Endpoints::
+
+    POST /v1/completions   JSON body {"prompt": [token ids],
+                           "max_tokens": n, "stream": bool, ...sampling}
+    GET  /healthz          per-replica health snapshots (JSON)
+    GET  /metrics          Prometheus text exposition of the registry
+
+Terminal-status → HTTP mapping:
+
+    SHED      429 Too Many Requests + Retry-After (admission or engine shed;
+              decided before any tokens move, stream and non-stream alike)
+    TIMEOUT   408 Request Timeout on the non-stream path; a stream that
+              times out mid-flight has already sent 200 + tokens, so the
+              deadline surfaces in the final SSE event's ``status``
+    FAILED    500 on non-stream (error string in the body) / final-event
+              status on streams
+    CANCELLED client disconnect mid-stream — the handler detects the broken
+              pipe on write and calls ``cancel(rid)`` so the engine frees
+              the request's pages instead of decoding for nobody
+
+Stream framing is SSE: one ``data: {"token": t, "index": i}`` event per
+token, then ``data: {"status": ..., "usage": ...}``, then ``data: [DONE]``.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ... import observability as _obs
+from ..serving import RequestStatus
+from .admission import ShedError
+from .replica import ReplicaDeadError
+
+__all__ = ["Gateway", "start_gateway"]
+
+_SAMPLING_KEYS = ("eos_token_id", "do_sample", "temperature", "top_p",
+                  "top_k", "seed", "deadline")
+
+
+class Gateway:
+    """Handle on a running gateway: ``addr``/``port``/``url`` + ``close()``.
+    Owns the HTTP server only — the ReplicaSet's lifecycle stays with its
+    creator (``close()`` does not stop the replicas)."""
+
+    def __init__(self, httpd, thread, replica_set):
+        self._httpd = httpd
+        self._thread = thread
+        self.replica_set = replica_set
+        self.addr, self.port = httpd.server_address[:2]
+        self.url = f"http://{self.addr}:{self.port}"
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=10.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    replica_set = None       # bound per-server by start_gateway
+
+    # ---- GET -----------------------------------------------------------------
+    def do_GET(self):  # noqa: N802 (stdlib handler API)
+        path = self.path.split("?")[0]
+        if path == "/healthz":
+            self._send_json(200, self.replica_set.health())
+        elif path == "/metrics":
+            body = _obs.render_prometheus().encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self._send_json(404, {"error": f"no route for {path}"})
+
+    # ---- POST /v1/completions ------------------------------------------------
+    def do_POST(self):  # noqa: N802 (stdlib handler API)
+        if self.path.split("?")[0] != "/v1/completions":
+            self._send_json(404, {"error": f"no route for {self.path}"})
+            return
+        try:
+            req = self._read_body()
+            prompt = req["prompt"]
+            if not isinstance(prompt, list) or not all(
+                    isinstance(t, int) for t in prompt):
+                raise ValueError("'prompt' must be a list of token ids")
+            kw = {k: req[k] for k in _SAMPLING_KEYS if k in req}
+            kw["max_new_tokens"] = int(req.get("max_tokens", 16))
+            stream = bool(req.get("stream", False))
+        except (ValueError, KeyError, TypeError) as e:
+            self._send_json(400, {"error": f"bad request: {e}"})
+            return
+        try:
+            handle = self.replica_set.submit(prompt, **kw)
+        except ShedError as e:
+            self.send_response(429)
+            body = json.dumps({"error": str(e),
+                               "reason": e.reason}).encode("utf-8")
+            self.send_header("Retry-After", str(max(1, int(e.retry_after))))
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        except (ReplicaDeadError, ValueError) as e:
+            code = 503 if isinstance(e, ReplicaDeadError) else 400
+            self._send_json(code, {"error": str(e)})
+            return
+        if stream:
+            self._stream_response(handle)
+        else:
+            self._blocking_response(handle)
+
+    def _blocking_response(self, handle):
+        rs = self.replica_set
+        tokens, status = rs.result(handle)
+        if status is RequestStatus.TIMEOUT and not tokens:
+            self._send_json(408, {"error": "deadline expired unserved",
+                                  "status": status.value})
+            return
+        if status is RequestStatus.FAILED:
+            self._send_json(500, {"error": rs.request_error(handle),
+                                  "status": status.value})
+            return
+        self._send_json(200, {
+            "replica": handle.replica.name,
+            "status": status.value,
+            "tokens": tokens,
+            "usage": {"completion_tokens": len(tokens)},
+        })
+
+    def _stream_response(self, handle):
+        rs = self.replica_set
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-store")
+        self.send_header("Connection", "close")
+        # SSE has no predeclared length; closing the socket ends the stream
+        self.close_connection = True
+        self.end_headers()
+        try:
+            i = 0
+            for tok in rs.stream(handle):
+                self._sse({"token": int(tok), "index": i})
+                i += 1
+            status = rs.status(handle)
+            final = {"status": status.value,
+                     "replica": handle.replica.name,
+                     "usage": {"completion_tokens": i}}
+            if status is RequestStatus.FAILED:
+                final["error"] = rs.request_error(handle)
+            self._sse(final)
+            self.wfile.write(b"data: [DONE]\n\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionError, OSError):
+            # client went away mid-stream: stop decoding for nobody
+            rs.cancel(handle)
+
+    # ---- plumbing ------------------------------------------------------------
+    def _read_body(self):
+        n = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(n) if n else b"{}"
+        return json.loads(raw.decode("utf-8"))
+
+    def _sse(self, obj):
+        self.wfile.write(b"data: " + json.dumps(obj).encode("utf-8")
+                         + b"\n\n")
+        self.wfile.flush()
+
+    def _send_json(self, code, obj):
+        body = json.dumps(obj).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):    # requests are metered, not log events
+        pass
+
+
+def start_gateway(replica_set, port=0, addr="127.0.0.1"):
+    """Serve ``replica_set`` at ``http://addr:port`` from a daemon thread;
+    ``port=0`` lets the OS pick (read it back from the returned handle).
+    The caller owns the handle: ``close()`` stops the HTTP server (the
+    replicas keep running until their owner closes them)."""
+    handler = type("_BoundHandler", (_Handler,), {"replica_set": replica_set})
+    httpd = ThreadingHTTPServer((addr, port), handler)
+    httpd.daemon_threads = True
+    thread = threading.Thread(target=httpd.serve_forever,
+                              name="paddle-tpu-gateway", daemon=True)
+    thread.start()
+    return Gateway(httpd, thread, replica_set)

@@ -1,0 +1,345 @@
+"""Threaded engine replicas behind one thread-safe facade.
+
+``LLMEngine`` is single-caller by design: ``step()`` mutates slot tables,
+page refcounts, and the prefix index with no internal locking.  This module
+keeps that invariant while serving many concurrent callers by giving each
+replica ONE ``threading.Condition`` that serializes every engine touch — the
+step loop holds it per step, and ``submit`` / ``new_tokens`` / ``cancel`` /
+``health`` take it per call.  Streams block on the condition and are woken
+after every step, so token latency is one notify away from the engine's own
+cadence rather than a polling interval.
+
+Replica death is a first-class event: when the step loop dies (an armed
+``frontend.step`` fault, or an error that escapes the engine's own
+step-isolation machinery) the replica finalizes every inflight request as
+FAILED via ``LLMEngine.fail_all`` — streams observe a typed terminal status
+instead of hanging — drops its prefix-key mirror from the router, and is
+excluded from routing from then on.
+
+Fault points (see :mod:`paddle_tpu.testing.faults`): ``frontend.route``
+fires before routing, ``frontend.submit`` after a replica is chosen (ctx has
+``replica``), ``frontend.step`` inside a replica's step loop (ctx has
+``replica``) — the chaos tests use the last to kill a replica mid-stream.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ... import observability as _obs
+from ...testing import faults as _faults
+from ..serving import RequestStatus as _RequestStatus
+from .admission import AlwaysAdmit, ShedError
+from .router import PrefixAffinityRouter
+
+__all__ = ["ReplicaDeadError", "EngineReplica", "RequestHandle", "ReplicaSet"]
+
+
+class ReplicaDeadError(RuntimeError):
+    """Raised when submitting to a dead replica, or when no replica in the
+    set is alive."""
+
+
+class EngineReplica:
+    """One engine + the lock that makes it multi-caller safe + the thread
+    that drives it.  All public methods are thread-safe."""
+
+    def __init__(self, name, engine, router=None, poll_interval=0.05):
+        self.name = str(name)
+        self.engine = engine
+        self.router = router
+        self.alive = True
+        self.error = None
+        self._cv = threading.Condition(threading.RLock())
+        self._stop = False
+        self._thread = None
+        self._poll = float(poll_interval)
+        if router is not None:
+            # called from inside step() while the step thread holds our
+            # condition; the router only takes its own (leaf) lock.
+            engine.cache_event_listener = (
+                lambda event, key: router.note_event(self.name, event, key))
+
+    # ---- lifecycle -----------------------------------------------------------
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name=f"replica-{self.name}", daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self):
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def _has_work(self):
+        eng = self.engine
+        return bool(eng._waiting) or any(s is not None for s in eng._slots)
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                if self._stop:
+                    return
+                if not self._has_work():
+                    self._cv.wait(self._poll)
+                    continue
+                try:
+                    if _faults.FAULTS.active:
+                        _faults.FAULTS.raise_if("frontend.step",
+                                                replica=self.name)
+                    self.engine.step()
+                except Exception as e:  # noqa: BLE001 — replica death boundary
+                    self._die(e)
+                    return
+                self._cv.notify_all()
+
+    def _die(self, error):
+        """Step loop died: fail every inflight request with a typed terminal
+        status, drop our prefix mirror, and stop accepting work.  Caller
+        holds the condition."""
+        self.alive = False
+        self.error = error
+        try:
+            self.engine.fail_all(error)
+        finally:
+            if self.router is not None:
+                self.router.forget(self.name)
+            self._cv.notify_all()
+
+    # ---- request facade ------------------------------------------------------
+    def load(self):
+        """Scheduling pressure: waiting + active requests (the router's
+        tie-breaker and the least-loaded fallback metric)."""
+        with self._cv:
+            eng = self.engine
+            return len(eng._waiting) + sum(
+                1 for s in eng._slots if s is not None)
+
+    def submit(self, prompt_ids, **kw):
+        """Thread-safe ``add_request``; wakes the step loop.  The returned
+        rid may already be terminal SHED (engine-level admission)."""
+        with self._cv:
+            if not self.alive:
+                raise ReplicaDeadError(
+                    f"replica {self.name!r} is dead: {self.error!r}")
+            rid = self.engine.add_request(prompt_ids, **kw)
+            self._cv.notify_all()
+            return rid
+
+    def poll(self, rid, timeout=None):
+        """Block until ``rid`` has new tokens or is terminal; returns
+        ``(tokens, status)``.  ``timeout`` bounds the wait — on expiry the
+        current (possibly empty) increment is returned with a live status."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                toks = self.engine.new_tokens(rid)
+                status = self.engine.status(rid)
+                if toks or status.terminal:
+                    return toks, status
+                if deadline is not None:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        return [], status
+                    self._cv.wait(min(left, self._poll))
+                else:
+                    self._cv.wait(self._poll)
+
+    def cancel(self, rid):
+        with self._cv:
+            ok = self.engine.cancel(rid)
+            self._cv.notify_all()
+            return ok
+
+    def status(self, rid):
+        with self._cv:
+            return self.engine.status(rid)
+
+    def result(self, rid):
+        with self._cv:
+            return list(self.engine.result(rid))
+
+    def request_error(self, rid):
+        with self._cv:
+            return self.engine.error(rid)
+
+    def ttft(self, rid):
+        with self._cv:
+            try:
+                return self.engine.ttft(rid)
+            except KeyError:
+                return None
+
+    def health(self):
+        with self._cv:
+            h = self.engine.health()
+        h["replica"] = self.name
+        h["alive"] = self.alive
+        h["error"] = repr(self.error) if self.error is not None else None
+        return h
+
+    def metrics(self):
+        with self._cv:
+            return self.engine.metrics()
+
+
+class RequestHandle:
+    """Where a routed request lives: the replica, its rid there, and the
+    submit timestamp the stream-duration histogram measures from."""
+
+    __slots__ = ("replica", "rid", "t0", "_accounted")
+
+    def __init__(self, replica, rid):
+        self.replica = replica
+        self.rid = rid
+        self.t0 = time.perf_counter()
+        self._accounted = False
+
+    def __repr__(self):
+        return f"RequestHandle({self.replica.name!r}, rid={self.rid})"
+
+
+class ReplicaSet:
+    """N replicas behind one submit/stream/cancel facade.
+
+    ``engines`` may be constructed engines or a list of (name, engine)
+    pairs; default names are ``r0..rN-1``.  The default router is
+    :class:`~.router.PrefixAffinityRouter` fed by every replica's cache
+    events; pass ``router=RoundRobinRouter()`` for the affinity-blind
+    baseline.  ``admission`` is consulted before routing — a refusal raises
+    :class:`~.admission.ShedError` without touching any replica.
+    """
+
+    def __init__(self, engines, router=None, admission=None, names=None,
+                 start=True, poll_interval=0.05):
+        engines = list(engines)
+        if not engines:
+            raise ValueError("ReplicaSet needs at least one engine")
+        if engines and isinstance(engines[0], tuple):
+            names = [n for n, _ in engines]
+            engines = [e for _, e in engines]
+        if names is None:
+            names = [f"r{i}" for i in range(len(engines))]
+        if router is None:
+            router = PrefixAffinityRouter(page_size=engines[0].page)
+        self.router = router
+        self.admission = admission if admission is not None else AlwaysAdmit()
+        self.replicas = [
+            EngineReplica(n, e, router=router, poll_interval=poll_interval)
+            for n, e in zip(names, engines)]
+        self._by_name = {r.name: r for r in self.replicas}
+        if start:
+            self.start()
+
+    # ---- lifecycle -----------------------------------------------------------
+    def start(self):
+        for r in self.replicas:
+            r.start()
+        return self
+
+    def close(self):
+        for r in self.replicas:
+            r.close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def replica(self, name):
+        return self._by_name[name]
+
+    def alive_replicas(self):
+        return [r for r in self.replicas if r.alive]
+
+    # ---- request facade ------------------------------------------------------
+    def submit(self, prompt_ids, **kw):
+        """Admit, route, and submit one request; returns a
+        :class:`RequestHandle`.  Raises :class:`~.admission.ShedError` on
+        admission refusal and :class:`ReplicaDeadError` with no live
+        replicas."""
+        if _faults.FAULTS.active:
+            _faults.FAULTS.raise_if("frontend.route")
+        alive = self.alive_replicas()
+        if not alive:
+            raise ReplicaDeadError("no live replicas")
+        decision = self.admission.decide(alive)
+        if not decision.admit:
+            _obs.FRONTEND_SHED.inc(reason=decision.reason)
+            _obs.FRONTEND_REQUESTS.inc(outcome="shed")
+            raise ShedError(decision.reason, decision.retry_after)
+        route = self.router.route(prompt_ids, alive)
+        rep = route.replica
+        if _faults.FAULTS.active:
+            _faults.FAULTS.raise_if("frontend.submit", replica=rep.name)
+        rid = rep.submit(prompt_ids, **kw)
+        if rep.status(rid) is _RequestStatus.SHED:
+            # the engine's own admission control refused it (queue bound /
+            # page watermark); surface it exactly like a frontend shed
+            _obs.FRONTEND_SHED.inc(reason="engine")
+            _obs.FRONTEND_REQUESTS.inc(outcome="shed")
+            raise ShedError("engine", decision.retry_after)
+        _obs.FRONTEND_ROUTED.inc(replica=rep.name, reason=route.reason)
+        _obs.FRONTEND_INFLIGHT.inc()
+        return RequestHandle(rep, rid)
+
+    def _account(self, handle, status):
+        """First terminal observation of a request: outcome counter, inflight
+        gauge, stream-duration histogram, and the admission policy's TTFT
+        window.  Idempotent per handle."""
+        if handle._accounted:
+            return
+        handle._accounted = True
+        _obs.FRONTEND_REQUESTS.inc(outcome=status.value)
+        _obs.FRONTEND_INFLIGHT.inc(-1)
+        _obs.FRONTEND_STREAM_SECONDS.observe(time.perf_counter() - handle.t0)
+        self.admission.observe_ttft(handle.replica.ttft(handle.rid))
+
+    def stream(self, handle, poll_timeout=0.5):
+        """Yield ``handle``'s tokens as they are emitted, one int at a time,
+        until the request is terminal.  Check ``self.status(handle)`` after
+        exhaustion for the terminal status."""
+        while True:
+            toks, status = handle.replica.poll(handle.rid,
+                                               timeout=poll_timeout)
+            yield from toks
+            if status.terminal and not toks:
+                # drain once more: tokens emitted by the finalizing step
+                # land before the terminal status is visible
+                yield from handle.replica.poll(handle.rid, timeout=0)[0]
+                self._account(handle, status)
+                return
+
+    def result(self, handle, timeout=None):
+        """Block until terminal; returns ``(tokens, status)``."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            _, status = handle.replica.poll(handle.rid, timeout=1.0)
+            if status.terminal:
+                self._account(handle, status)
+                return handle.replica.result(handle.rid), status
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"{handle!r} not terminal after {timeout}s")
+
+    def status(self, handle):
+        return handle.replica.status(handle.rid)
+
+    def cancel(self, handle):
+        return handle.replica.cancel(handle.rid)
+
+    def request_error(self, handle):
+        return handle.replica.request_error(handle.rid)
+
+    def health(self):
+        """Per-replica health snapshots keyed by replica name."""
+        return {r.name: r.health() for r in self.replicas}
+
+    def metrics(self):
+        """Per-replica registry snapshots keyed by replica name."""
+        return {r.name: r.metrics() for r in self.replicas}

@@ -1,0 +1,137 @@
+"""Prefix-cache-aware request routing across engine replicas.
+
+The serving engine keys its radix-style prefix index by chain hashes of full
+KV pages (:func:`paddle_tpu.inference.serving.prefix_page_keys`).  Because
+the chain hash is deterministic and shared, the router can compute a
+request's page keys *before* dispatch and ask: which replica already holds
+the longest prefix of those pages?  Routing there turns the replica's cached
+pages into skipped prefill work.
+
+The router keeps one key-set per replica, maintained from the engine's own
+cache events (``register`` when a page enters the index, ``evict`` when the
+LRU reclaims it) — :class:`~.replica.EngineReplica` subscribes the engine's
+``cache_event_listener`` hook to :meth:`PrefixAffinityRouter.note_event`, so
+the mirror can never drift from the real index except by the events in
+flight during a step (self-correcting on the next event).
+
+Scoring is ``(longest contiguous prefix-page overlap, -load, name)``: the
+deepest cached prefix wins, load breaks overlap ties, and the replica name
+breaks exact ties so routing is deterministic under equal state.  With zero
+overlap everywhere the router degrades to least-loaded.
+"""
+from __future__ import annotations
+
+import threading
+
+from ... import observability as _obs
+from ..serving import prefix_page_keys
+
+__all__ = ["RouteDecision", "PrefixAffinityRouter", "RoundRobinRouter"]
+
+
+class RouteDecision:
+    """Outcome of one routing call: the chosen replica, why it won
+    (``affinity`` | ``least_loaded`` | ``round_robin``), and how many
+    contiguous prefix pages it already caches."""
+
+    __slots__ = ("replica", "reason", "overlap")
+
+    def __init__(self, replica, reason, overlap=0):
+        self.replica = replica
+        self.reason = reason
+        self.overlap = int(overlap)
+
+    def __repr__(self):
+        return (f"RouteDecision({getattr(self.replica, 'name', self.replica)!r},"
+                f" {self.reason!r}, overlap={self.overlap})")
+
+
+class PrefixAffinityRouter:
+    """Route to the replica whose prefix cache holds the deepest prefix of
+    the request; fall back to least-loaded.  Thread-safe: ``note_event``
+    arrives from replica step threads while ``route`` runs on gateway
+    threads."""
+
+    def __init__(self, page_size):
+        self.page = int(page_size)
+        self._lock = threading.Lock()
+        self._keys = {}          # replica name -> set of live chain keys
+
+    # ---- index maintenance (driven by engine cache events) ------------------
+    def note_event(self, replica_name, event, key):
+        """Mirror one engine cache event into the per-replica key index.
+        ``register`` adds the chain key, ``evict`` drops it; unknown events
+        are ignored so the listener contract stays forward-compatible."""
+        with self._lock:
+            keys = self._keys.setdefault(replica_name, set())
+            if event == "register":
+                keys.add(key)
+            elif event == "evict":
+                keys.discard(key)
+
+    def forget(self, replica_name):
+        """Drop a replica's whole index (its pages died with it)."""
+        with self._lock:
+            self._keys.pop(replica_name, None)
+
+    def known_keys(self, replica_name):
+        """Snapshot of the chain keys mirrored for one replica."""
+        with self._lock:
+            return frozenset(self._keys.get(replica_name, ()))
+
+    # ---- scoring -------------------------------------------------------------
+    def overlap(self, replica_name, chain_keys):
+        """Longest *contiguous* prefix of ``chain_keys`` present in the
+        replica's index.  Contiguity matters: chain key i is only reusable
+        when pages 0..i-1 are too, exactly like the engine's admission walk."""
+        with self._lock:
+            keys = self._keys.get(replica_name)
+        if not keys:
+            return 0
+        n = 0
+        for k in chain_keys:
+            if k not in keys:
+                break
+            n += 1
+        return n
+
+    def route(self, prompt_ids, replicas):
+        """Pick a replica for ``prompt_ids`` among ``replicas`` (objects with
+        ``.name`` and ``.load()``).  Deterministic: equal (overlap, load)
+        resolves by replica name."""
+        if not replicas:
+            raise ValueError("no replicas to route to")
+        chain = prefix_page_keys(prompt_ids, self.page)
+        scored = sorted(
+            ((-self.overlap(r.name, chain), r.load(), r.name, r)
+             for r in replicas),
+            key=lambda t: t[:3])
+        neg_overlap, _, _, best = scored[0]
+        if neg_overlap < 0:
+            _obs.FRONTEND_AFFINITY.inc(event="hit")
+            return RouteDecision(best, "affinity", overlap=-neg_overlap)
+        _obs.FRONTEND_AFFINITY.inc(event="miss")
+        return RouteDecision(best, "least_loaded", overlap=0)
+
+
+class RoundRobinRouter:
+    """Affinity-blind baseline: cycle through the replica list in order.
+    Used by the bench comparison and as the control in the affinity tests."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._i = 0
+
+    def note_event(self, replica_name, event, key):
+        """Accepted and ignored — keeps the router interface uniform."""
+
+    def forget(self, replica_name):
+        """Accepted and ignored — keeps the router interface uniform."""
+
+    def route(self, prompt_ids, replicas):
+        if not replicas:
+            raise ValueError("no replicas to route to")
+        with self._lock:
+            r = replicas[self._i % len(replicas)]
+            self._i += 1
+        return RouteDecision(r, "round_robin")

@@ -56,9 +56,29 @@ from .. import observability as _obs
 from ..core.retry import RetryError, RetryPolicy, retry_call
 from ..testing.faults import FAULTS as _faults
 
-__all__ = ["LLMEngine", "Request", "RequestStatus", "SpecConfig"]
+__all__ = ["LLMEngine", "Request", "RequestStatus", "SpecConfig",
+           "prefix_page_keys"]
 
 _MAXK = 64        # static cap for per-slot dynamic top-k filtering
+
+
+def prefix_page_keys(tokens, page_size):
+    """Chain key per FULL page: key_i = hash(key_{i-1}, page_i tokens).
+
+    The prefix-cache radix lookup collapsed to one dict probe per page — a
+    page is shareable only as the tail of an identical-from-position-0
+    prefix (RoPE bakes absolute positions into cached K, so content alone
+    is not enough).  Public because the serving front door computes the
+    SAME keys to route a request to the replica whose cache already holds
+    its prefix (frontend/router.py); the engine's own radix index uses
+    this function too, so router affinity and engine hits can never
+    disagree on hashing."""
+    page_size = int(page_size)
+    keys, h = [], None
+    for i in range(0, (len(tokens) // page_size) * page_size, page_size):
+        h = hash((h,) + tuple(int(t) for t in tokens[i:i + page_size]))
+        keys.append(h)
+    return keys
 
 
 class RequestStatus(enum.Enum):
@@ -160,6 +180,7 @@ class Request:
         self.prefill_dispatches = 0  # prefill programs dispatched for us
         self.cached_tokens = 0       # prompt tokens served from prefix cache
         self.cache_keys = ()         # chain keys of the prompt's full pages
+        self.stream_pos = 0          # tokens already handed to new_tokens()
 
 
 def _rope(x, pos, theta):
@@ -480,6 +501,12 @@ class LLMEngine:
         # With prefix_cache=False nothing is ever hashed, so every released
         # page goes straight back to _free_pages (legacy behavior).
         self.prefix_cache = bool(prefix_cache)
+        # optional (event, chain_key) callback — the frontend router
+        # subscribes here to mirror this engine's radix index ("register" on
+        # page registration, "evict" on LRU reclaim) into its per-replica
+        # affinity index.  Called from inside step(); must be cheap and
+        # must not raise.
+        self.cache_event_listener = None
         self._page_ref = np.zeros(self.n_pages, np.int64)
         self._page_key: dict = {}          # physical page -> chain key
         self._key_page: dict = {}          # chain key -> physical page
@@ -862,16 +889,9 @@ class LLMEngine:
 
     # ------------------------------------------------------ page accounting
     def _page_keys(self, tokens):
-        """Chain key per FULL page: key_i = hash(key_{i-1}, page_i tokens).
-        A page is shareable only as the tail of an identical-from-position-0
-        prefix — RoPE bakes absolute positions into cached K, so content
-        alone is not enough. This is the radix-trie prefix lookup collapsed
-        to one dict probe per page."""
-        keys, h = [], None
-        for i in range(0, (len(tokens) // self.page) * self.page, self.page):
-            h = hash((h,) + tuple(tokens[i:i + self.page]))
-            keys.append(h)
-        return keys
+        """Chain keys of ``tokens``' full pages (see
+        :func:`prefix_page_keys` — shared with the frontend router)."""
+        return prefix_page_keys(tokens, self.page)
 
     def _ref_page(self, p):
         self._page_ref[p] += 1
@@ -897,9 +917,12 @@ class LLMEngine:
             p = self._free_pages.popleft()
         elif self._lru:
             p, _ = self._lru.popitem(last=False)
-            self._key_page.pop(self._page_key.pop(p), None)
+            key = self._page_key.pop(p)
+            self._key_page.pop(key, None)
             self.cache_evictions += 1
             self._m.evictions.inc()
+            if self.cache_event_listener is not None:
+                self.cache_event_listener("evict", key)
         else:
             return None
         self._page_ref[p] = 1
@@ -955,6 +978,8 @@ class LLMEngine:
                 continue
             self._page_key[p] = key
             self._key_page[key] = p
+            if self.cache_event_listener is not None:
+                self.cache_event_listener("register", key)
 
     def _admit(self):
         for slot in range(self.max_batch):
@@ -1692,16 +1717,61 @@ class LLMEngine:
         """Seconds from add_request to the first generated token."""
         return self._finished[rid].ttft
 
+    def _lookup(self, rid):
+        """The live or terminal :class:`Request` for ``rid`` wherever it
+        is — waiting, in a slot, or finished.  KeyError when unknown."""
+        for r in self._waiting:
+            if r.rid == rid:
+                return r
+        for r in self._slots:
+            if r is not None and r.rid == rid:
+                return r
+        return self._finished[rid]
+
+    def new_tokens(self, rid):
+        """Incremental stream accessor: the tokens ``rid`` generated since
+        the previous ``new_tokens(rid)`` call (empty list when none yet).
+        Output is append-only across the whole lifecycle — preemption
+        re-folds the *prompt*, never the emitted stream — so concatenating
+        every batch reproduces :meth:`result` exactly.  This is the public
+        surface the streaming gateway reads; it never touches slot state."""
+        r = self._lookup(rid)
+        toks = [int(t) for t in r.out[r.stream_pos:]]
+        r.stream_pos += len(toks)
+        return toks
+
+    def stream(self, rid, max_steps=100000):
+        """Generator driving the engine until ``rid`` is terminal, yielding
+        its tokens one by one as they are emitted (other in-flight requests
+        keep being served by the same steps).  Single-caller convenience —
+        a multi-replica front door runs the step loop elsewhere and polls
+        :meth:`new_tokens` instead."""
+        steps = 0
+        while True:
+            yield from self.new_tokens(rid)
+            if self._lookup(rid).status.terminal:
+                return
+            if steps >= max_steps:
+                raise RuntimeError(f"stream({rid}) exceeded {max_steps} steps")
+            self.step()
+            steps += 1
+
+    def fail_all(self, error):
+        """Finalize EVERY live request (waiting and running) as FAILED with
+        ``error`` recorded — the front door calls this when a replica's
+        step loop dies, so inflight requests end with a typed terminal
+        status instead of hanging their streams forever."""
+        while self._waiting:
+            self._finalize(self._waiting.popleft(), RequestStatus.FAILED,
+                           error=error)
+        for slot, r in enumerate(self._slots):
+            if r is not None:
+                self._release(slot, RequestStatus.FAILED, error=error)
+
     def status(self, rid):
         """The request's :class:`RequestStatus` wherever it lives — waiting,
         in a slot, or terminal.  KeyError for an unknown rid."""
-        for r in self._waiting:
-            if r.rid == rid:
-                return r.status
-        for r in self._slots:
-            if r is not None and r.rid == rid:
-                return r.status
-        return self._finished[rid].status
+        return self._lookup(rid).status
 
     def error(self, rid):
         """The recorded ``ExceptionType: message`` string for a FAILED

@@ -127,6 +127,31 @@ SERVING_QUARANTINE_PROBES = REGISTRY.counter(
     "single-slot isolation probes dispatched after a batched-step failure",
     ("engine",))
 
+# serving front door (inference/frontend/); replica labels name the engine
+# replica a request was routed to, reason says why the router picked it
+FRONTEND_REQUESTS = REGISTRY.counter(
+    "frontend_requests_total",
+    "gateway requests by terminal outcome "
+    "(finished/eos/timeout/cancelled/shed/failed)", ("outcome",))
+FRONTEND_ROUTED = REGISTRY.counter(
+    "frontend_routed_total",
+    "requests dispatched to a replica, by routing reason "
+    "(affinity/least_loaded/round_robin)", ("replica", "reason"))
+FRONTEND_AFFINITY = REGISTRY.counter(
+    "frontend_affinity_events_total",
+    "router prefix-affinity decisions (hit: scored prefix overlap won; "
+    "miss: no replica held any prefix page)", ("event",))
+FRONTEND_SHED = REGISTRY.counter(
+    "frontend_shed_total",
+    "requests rejected before reaching a replica, by admission reason",
+    ("reason",))
+FRONTEND_INFLIGHT = REGISTRY.gauge(
+    "frontend_inflight_requests",
+    "requests admitted by the gateway and not yet terminal")
+FRONTEND_STREAM_SECONDS = REGISTRY.histogram(
+    "frontend_stream_seconds",
+    "submit-to-terminal wall time per gateway request")
+
 # shared retry helper (core/retry.py); op labels the retried operation
 RETRY_ATTEMPTS = REGISTRY.histogram(
     "retry_attempts", "attempts consumed per retried operation", ("op",),

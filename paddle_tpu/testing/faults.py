@@ -24,8 +24,12 @@ Schedules are pure functions of their own call counter (plus a seeded RNG for
 every run.  Known points today: ``serving.page_alloc`` (allocation returns
 dry), ``serving.step`` (dispatch raises :class:`InjectedFault`),
 ``serving.slow_step`` (dispatch stalls ``delay`` seconds), ``store.connect``
-(client connect raises).  The registry is name-keyed and open: new subsystems
-add points without touching this module.
+(client connect raises); in the serving front door, ``frontend.route``
+(gateway submit fails before routing), ``frontend.submit`` (fails after a
+replica is chosen; ctx has ``replica``), and ``frontend.step`` (a replica's
+step loop dies — the chaos tests kill a replica mid-stream with this; ctx
+has ``replica``).  The registry is name-keyed and open: new subsystems add
+points without touching this module.
 """
 from __future__ import annotations
 

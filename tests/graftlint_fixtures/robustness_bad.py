@@ -38,3 +38,35 @@ def ellipsis_swallow(fn):
         return fn()
     except Exception:
         ...
+
+
+def loop_swallow(items):
+    out = []
+    for it in items:
+        try:
+            out.append(it())
+        except Exception:     # RB102: the item's failure AND work vanish
+            continue
+    return out
+
+
+def loop_break_swallow(items):
+    for it in items:
+        try:
+            it()
+        except Exception:     # RB102: break variant
+            break
+
+
+def return_swallow(fn):
+    try:
+        return fn()
+    except Exception:         # RB102: bare return
+        return
+
+
+def return_none_swallow(fn):
+    try:
+        return fn()
+    except Exception:         # RB102: explicit None is still nothing
+        return None

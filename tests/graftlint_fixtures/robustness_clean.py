@@ -33,3 +33,31 @@ def deliberate(fn):
         return fn()
     except Exception:  # graftlint: disable=robustness — shutdown cleanup
         pass
+
+
+def narrow_continue(items):
+    out = []
+    for it in items:
+        try:
+            out.append(it())
+        except ValueError:    # narrow escape: expected per-item failure
+            continue
+    return out
+
+
+def broad_counted_continue(items, stats):
+    out = []
+    for it in items:
+        try:
+            out.append(it())
+        except Exception as e:  # broad, but the failure is recorded
+            stats.append(e)
+            continue
+    return out
+
+
+def return_value_after_broad(fn):
+    try:
+        return fn()
+    except Exception:
+        return -1             # sentinel communicates the failure

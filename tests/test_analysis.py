@@ -386,11 +386,17 @@ def test_jit_shardings_use_mesh_spelling(tmp_path):
 
 def test_robustness_flags_swallowed_exceptions():
     res = run([str(FIXTURES / "robustness_bad.py")], select=["robustness"])
-    assert _codes(res) == {"RB101"}
-    assert len(res.findings) == 5
+    assert _codes(res) == {"RB101", "RB102"}
+    by_code = {}
+    for f in res.findings:
+        by_code.setdefault(f.code, []).append(f)
+    assert len(by_code["RB101"]) == 5
+    assert len(by_code["RB102"]) == 4        # continue, break, return, None
     assert all(f.severity == "warning" for f in res.findings)
     msgs = " | ".join(f.message for f in res.findings)
     assert "bare except" in msgs and "except BaseException" in msgs
+    rb102 = " | ".join(f.message for f in by_code["RB102"])
+    assert "continue" in rb102 and "break" in rb102 and "return" in rb102
     assert all(f.hint for f in res.findings)
 
 

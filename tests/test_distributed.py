@@ -546,6 +546,41 @@ class TestDistributedCheckpoint:
         load_state_dict(dst, str(tmp_path / "ck3"))
         np.testing.assert_allclose(np.asarray(dst["w"]._data), expect)
 
+    def test_async_save_inplace_mutation_cannot_corrupt(self, tmp_path):
+        """The hard case: a plain np.ndarray param mutated IN PLACE right
+        after async_save returns.  Rebinding (above) leaves the old buffer
+        alive, so it passes even with reference-queueing; in-place writes
+        reach the queued buffer unless the snapshot is a forced copy."""
+        from paddle_tpu.distributed.checkpoint import (save_state_dict,
+                                                       load_state_dict,
+                                                       wait_async_save)
+        w = rng.rand(8, 4).astype(np.float32)
+        expect = w.copy()
+        save_state_dict({"w": w}, str(tmp_path / "ck4"), async_save=True)
+        w[:] = -1.0                      # in-place clobber, same buffer
+        wait_async_save()
+        dst = {"w": pt.zeros([8, 4])}
+        load_state_dict(dst, str(tmp_path / "ck4"))
+        np.testing.assert_allclose(np.asarray(dst["w"]._data), expect)
+
+    def test_async_save_snapshots_sharded_arrays(self, tmp_path):
+        """Multi-device arrays used to be queued by live reference (only
+        single-device ones were host-copied); the snapshot must rebuild them
+        from per-shard host copies, preserving the sharding for the
+        shard-wise write, so the checkpoint survives later rebinds."""
+        from paddle_tpu.distributed.checkpoint import (save_state_dict,
+                                                       load_state_dict,
+                                                       wait_async_save)
+        mesh = _mesh_1d()
+        w = rng.rand(16, 8).astype(np.float32)
+        t = shard_tensor(pt.to_tensor(w), mesh, [Shard(0)])
+        save_state_dict({"w": t}, str(tmp_path / "ck5"), async_save=True)
+        t._data = t._data * 0.0
+        wait_async_save()
+        dst = {"w": shard_tensor(pt.zeros([16, 8]), mesh, [Shard(1)])}
+        load_state_dict(dst, str(tmp_path / "ck5"))
+        np.testing.assert_allclose(np.asarray(dst["w"]._data), w)
+
 
 class TestUlyssesAttention:
     def teardown_method(self, m):

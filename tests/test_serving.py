@@ -241,6 +241,47 @@ class TestLLMEngine:
         assert all(len(eng.result(r)) == 4 for r in rids)
         assert len(eng._free_pages) == eng.n_pages - 1  # all pages recycled
 
+    def test_streaming_accessor_parity(self):
+        """new_tokens(rid) is incremental and lossless: concatenating every
+        increment reproduces result(rid) exactly, across continuous
+        batching with slot churn (the public surface the gateway streams
+        from — it never reads slot state)."""
+        import numpy as np
+        from paddle_tpu.inference.serving import LLMEngine
+        m = self._model()
+        rng = np.random.RandomState(3)
+        eng = LLMEngine(m, max_batch=2, max_len=32, page_size=8)
+        rids = [eng.add_request(rng.randint(1, 128, (4 + i,)),
+                                max_new_tokens=5) for i in range(4)]
+        seen = {r: [] for r in rids}
+        while eng._waiting or any(s is not None for s in eng._slots):
+            eng.step()
+            for r in rids:
+                inc = eng.new_tokens(r)
+                assert all(type(t) is int for t in inc)
+                seen[r].extend(inc)
+        for r in rids:
+            seen[r].extend(eng.new_tokens(r))      # final drain
+            assert seen[r] == list(eng.result(r))
+            assert eng.new_tokens(r) == []         # cursor fully consumed
+
+    def test_stream_generator_parity(self):
+        """stream(rid) drives the engine itself and yields exactly the
+        batch-path result, ending on the terminal status."""
+        import numpy as np
+        from paddle_tpu.inference.serving import LLMEngine
+        m = self._model()
+        rng = np.random.RandomState(4)
+        prompt = rng.randint(1, 128, (6,))
+        ref_eng = LLMEngine(m, max_batch=1, max_len=32, page_size=8)
+        rid0 = ref_eng.add_request(prompt, max_new_tokens=5)
+        ref_eng.run_until_done()
+        eng = LLMEngine(m, max_batch=1, max_len=32, page_size=8)
+        rid = eng.add_request(prompt, max_new_tokens=5)
+        toks = list(eng.stream(rid))
+        assert toks == list(ref_eng.result(rid0))
+        assert eng.status(rid).terminal
+
     def test_engine_on_pp_mp_mesh(self):
         import numpy as np
         import jax
