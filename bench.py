@@ -693,6 +693,83 @@ def _serving_bench(paddle, on_tpu, budget_left_s=None):
         except Exception as e:  # noqa: BLE001
             print(f"degradation serving extra failed: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
+        # disaggregated prefill/decode: the SAME mixed trace (decode-heavy
+        # short requests + long prompts arriving mid-stream) served by the
+        # colocated engine vs DisaggEngine.  The colocated step loop is
+        # prefill-first, so each arriving prompt stalls every in-flight
+        # decode — the disaggregated engine steps the decode slice every
+        # round, which should show up as a lower p95 inter-token gap (TPOT)
+        # at a comparable TTFT.
+        try:
+            if not _room(2.0, "disagg"):
+                raise _SkipExtra
+            from paddle_tpu.inference.serving import DisaggEngine
+            SHORT = max(2, CHUNK // 4)
+            rng3 = np.random.RandomState(3)
+            arrivals = [(i, rng3.randint(1, cfg.vocab_size, (SHORT,))
+                         .astype(np.int32), NEW) for i in range(6)]
+            arrivals += [(2 + 4 * j, prompt, 4) for j in range(3)]
+            arrivals.sort(key=lambda t: t[0])
+
+            def _drive(e):
+                # warm both phases' programs so the trace is compile-free
+                e.add_request(prompt, max_new_tokens=NEW)
+                e.run_until_done()
+                pend = list(arrivals)
+                rids, shorts = [], set()
+                last, gaps, step = {}, [], 0
+                while pend or any(not e.status(r).terminal for r in rids):
+                    while pend and pend[0][0] <= step:
+                        _, p, new = pend.pop(0)
+                        rid = e.add_request(p, max_new_tokens=new)
+                        if len(p) == SHORT:
+                            shorts.add(rid)
+                        rids.append(rid)
+                    e.step()
+                    now = time.perf_counter()
+                    for rid in rids:
+                        for _ in e.new_tokens(rid):
+                            if rid in last and rid in shorts:
+                                gaps.append(now - last[rid])
+                            last[rid] = now
+                    step += 1
+                    if step > 5000:
+                        raise RuntimeError("mixed trace did not drain")
+                ttfts = [e.ttft(r) for r in rids if e.ttft(r) is not None]
+                return gaps, ttfts
+
+            def _pct(xs, q):
+                return round(float(np.percentile(xs, q)) * 1e3, 2)
+
+            # decode_block pinned to 1 on both engines: per-step polling is
+            # then per-token, so the gap series IS the TPOT series
+            engd = LLMEngine(m, max_batch=4, max_len=P + NEW + 8,
+                             page_size=16, prefill_chunk=CHUNK,
+                             decode_block=1)
+            cg, ct = _drive(engd)
+            del engd
+            dis = DisaggEngine(m, max_batch=4, max_len=P + NEW + 8,
+                               page_size=16, prefill_chunk=CHUNK,
+                               decode_block=1)
+            dg, dt_ = _drive(dis)
+            out["disagg"] = {
+                "colocated": {
+                    "tpot_ms_p50": _pct(cg, 50), "tpot_ms_p95": _pct(cg, 95),
+                    "ttft_ms_p50": _pct(ct, 50), "ttft_ms_p95": _pct(ct, 95)},
+                "disagg": {
+                    "tpot_ms_p50": _pct(dg, 50), "tpot_ms_p95": _pct(dg, 95),
+                    "ttft_ms_p50": _pct(dt_, 50),
+                    "ttft_ms_p95": _pct(dt_, 95),
+                    "handoffs": dis.handoff_stats()["handoffs"]},
+                "p95_tpot_improvement_pct": round(
+                    (float(np.percentile(cg, 95))
+                     / max(float(np.percentile(dg, 95)), 1e-9) - 1.0) * 100,
+                    1)}
+        except _SkipExtra:
+            pass
+        except Exception as e:  # noqa: BLE001
+            print(f"disagg serving extra failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
         return out
     except Exception as e:  # noqa: BLE001 — extras must not kill the bench
         print(f"serving bench failed: {type(e).__name__}: {e}",

@@ -1,0 +1,424 @@
+"""Disaggregated prefill/decode serving: two engine cores on disjoint mesh
+slices with KV-page handoff between them.
+
+The phase-separation argument (DistServe OSDI'24, Splitwise ISCA'24): in a
+colocated engine every chunked prefill that lands in a step stalls ALL
+co-resident decode slots — the step loop is prefill-first, so a long prompt
+arriving mid-stream inflates every other request's inter-token latency.
+:class:`DisaggEngine` runs a PREFILL engine on one mesh slice and a DECODE
+engine on another; each :meth:`step` always dispatches the decode side and
+only additionally dispatches a prefill chunk when the handoff queue has
+room, so decode token cadence is never blocked behind a prompt — even on a
+single device, where "slices" are just two independent buffer sets.
+
+The seam is the KV-page handoff: when a prompt finishes prefilling, the
+prefill engine's ``prefill_sink`` detaches the request WITH its page
+refcounts into a bounded queue; the drain loop allocates destination pages
+on the decode pool, moves the page contents device-to-device (a jitted
+gather → ``jax.device_put`` onto the decode slice's sharding → jitted
+scatter; the device_put collapses to a no-op when both engines share one
+device set), seats the request via ``admit_prefilled``, and releases the
+source pages (content-registered prompt pages park in the prefill LRU, so
+prefix-cache hits survive disaggregation).  A full queue back-pressures
+admission: the prefill engine stops stepping, its waiting queue grows, and
+the ordinary ``max_waiting`` / page-pressure shedding applies.
+
+Fault surface: each handoff fires the ``serving.kv_handoff`` point —
+transient faults retry under the shared :class:`RetryPolicy`; a poisoned
+handoff quarantines ONLY that request (terminal FAILED, pages released on
+both slices).
+
+Parity: greedy and fixed-seed requests are token-exact with a colocated
+:class:`~.core.LLMEngine` — the copied pages are bit-identical to what the
+decode slice would have written (same program, same absolute RoPE
+positions; int8 pages and scales copy verbatim), and per-request sampling
+seeds do not depend on dispatch structure.  (Seedless sampling draws from a
+per-engine global counter and is not parity-stable, exactly as with the
+colocated prefix cache.)
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from ... import observability as _obs
+from ...core.retry import RetryError, RetryPolicy, retry_call
+from ...testing.faults import FAULTS as _faults
+from .core import LLMEngine
+from .request import RequestStatus
+
+__all__ = ["DisaggEngine", "split_mesh"]
+
+
+def split_mesh(mesh, axis=None):
+    """Split ``mesh`` into ``(prefill_mesh, decode_mesh)`` halves along
+    ``axis`` (default: the first axis with even size >= 2).  Both halves
+    keep every axis name, so the engines' pp×mp shardings apply unchanged
+    to their slice."""
+    from jax.sharding import Mesh
+    names = mesh.axis_names
+    if axis is None:
+        axis = next((n for n in names
+                     if mesh.shape[n] >= 2 and mesh.shape[n] % 2 == 0), None)
+        if axis is None:
+            raise ValueError(
+                f"no mesh axis with even size >= 2 to split (shape "
+                f"{dict(mesh.shape)}); pass prefill_mesh/decode_mesh "
+                "explicitly")
+    ai = list(names).index(axis)
+    devs = mesh.devices
+    half = devs.shape[ai] // 2
+    sl = [slice(None)] * devs.ndim
+    sl[ai] = slice(0, half)
+    pre = devs[tuple(sl)]
+    sl[ai] = slice(half, None)
+    dec = devs[tuple(sl)]
+    return Mesh(pre, names), Mesh(dec, names)
+
+
+class _TransientHandoff(Exception):
+    """Wrapper so :func:`retry_call` retries exactly the transient handoff
+    faults; non-transient errors escape unwrapped into quarantine."""
+
+    def __init__(self, err):
+        super().__init__(str(err))
+        self.err = err
+
+
+class _Handoff:
+    """One queued prefill→decode transfer: the detached request plus the
+    prefill-side pages whose refcounts the queue now owns."""
+
+    __slots__ = ("r", "pages", "n_tokens")
+
+    def __init__(self, r, pages, n_tokens):
+        self.r = r
+        self.pages = pages
+        self.n_tokens = n_tokens
+
+
+class DisaggEngine:
+    """Prefill engine + decode engine + bounded KV-page handoff queue.
+
+    Accepts the colocated :class:`LLMEngine` knobs and applies them to both
+    sides; ``prefill_mesh`` / ``decode_mesh`` pin each phase to its slice
+    (both None = two buffer sets on the local device — functionally
+    disaggregated, used by the parity tests).  ``prefix_cache`` lives on the
+    PREFILL side only (that is where prompts are computed; a decode-side
+    cache would share the partially-filled last prompt page that decode
+    writes into).  ``spec_decode`` lives on the DECODE side only.
+    ``handoff_depth`` bounds the queue; ``handoff_retry`` is the
+    :class:`RetryPolicy` for transient ``serving.kv_handoff`` faults."""
+
+    def __init__(self, model, prefill_mesh=None, decode_mesh=None,
+                 mp_axis="mp", pp_axis="pp", max_batch=4, max_len=256,
+                 page_size=16, prefill_chunk=32, page_pool=None,
+                 decode_block=1, use_kernel=None, seed=0,
+                 kv_cache_dtype="auto", decode_block_max=32,
+                 prefix_cache=False, spec_decode=None, max_waiting=None,
+                 shed_min_free_ratio=0.0, default_deadline=None,
+                 step_retry=None, debug_refcount_audit=False,
+                 handoff_depth=4, handoff_retry=None):
+        self.max_batch = max_batch
+        self.max_len = max_len
+        self.page = page_size
+        self.debug_refcount_audit = bool(debug_refcount_audit)
+        self.handoff_depth = int(handoff_depth)
+        self._handoff_retry = (handoff_retry if handoff_retry is not None
+                               else RetryPolicy(max_attempts=3,
+                                                base_delay=0.01,
+                                                max_delay=0.25, seed=seed))
+        common = dict(mp_axis=mp_axis, pp_axis=pp_axis, max_batch=max_batch,
+                      max_len=max_len, page_size=page_size,
+                      prefill_chunk=prefill_chunk, page_pool=page_pool,
+                      use_kernel=use_kernel, seed=seed,
+                      kv_cache_dtype=kv_cache_dtype,
+                      default_deadline=default_deadline,
+                      step_retry=step_retry)
+        # internal engines run with their own audits off — handoff-held
+        # pages are invisible to a single engine's slot tables, so only the
+        # combined audit_refcounts() below knows the full expected counts
+        self.pre = LLMEngine(model, mesh=prefill_mesh,
+                             prefix_cache=prefix_cache,
+                             max_waiting=max_waiting,
+                             shed_min_free_ratio=shed_min_free_ratio,
+                             debug_refcount_audit=False, **common)
+        self.dec = LLMEngine(model, mesh=decode_mesh,
+                             decode_block=decode_block,
+                             decode_block_max=decode_block_max,
+                             spec_decode=spec_decode,
+                             debug_refcount_audit=False, **common)
+        self.pre.prefill_sink = self._sink
+        # one hop or zero: device_put only when the slices really differ
+        self._cross_device = (set(self.pre.runner.devices)
+                              != set(self.dec.runner.devices))
+        from collections import deque
+        self._queue: deque = deque()
+        self.handoffs = 0               # completed page transfers
+        self.handoff_retries = 0        # transient kv_handoff retries
+        self.handoff_failures = 0       # handoffs quarantined as poison
+        self.prefix_cache = self.pre.prefix_cache
+
+    # --------------------------------------------------------------- intake
+    def add_request(self, *args, **kwargs):
+        """Submit a request (colocated signature).  Admission control runs
+        on the prefill side; a full handoff queue back-pressures it by
+        pausing prefill steps, which grows the waiting queue into the
+        ``max_waiting`` / page-pressure shed rules."""
+        return self.pre.add_request(*args, **kwargs)
+
+    def cancel(self, rid):
+        """Cancel wherever the request lives: prefill side, handoff queue,
+        or decode side."""
+        if self.pre.cancel(rid):
+            return True
+        for i, h in enumerate(self._queue):
+            if h.r.rid == rid:
+                del self._queue[i]
+                self._drop_prefill_pages(h.pages)
+                self.dec.sched.finalize(h.r, RequestStatus.CANCELLED)
+                return True
+        return self.dec.cancel(rid)
+
+    # -------------------------------------------------------------- handoff
+    def _sink(self, slot, token):
+        """``prefill_sink`` for the prefill engine: emit the first token
+        there (TTFT is a prefill-side responsibility), then — unless that
+        token already finished the request — detach the slot with its page
+        refcounts into the handoff queue."""
+        pre = self.pre
+        r = pre.sched.slots[slot]
+        pre.sched.emit(slot, token)
+        if pre.sched.slots[slot] is not r:
+            return                 # max_new==1 / eos at first token: done
+        entry = _Handoff(*pre.sched.detach(slot))
+        self._queue.append(entry)
+
+    def _drop_prefill_pages(self, pages):
+        for p in pages:
+            self.pre.pool.unref_page(p)
+
+    def _transfer(self, r, src_pages, dst_pages):
+        """Move page contents prefill slice → decode slice.  Jitted gather
+        and scatter per block size; the device_put between them is the only
+        cross-slice hop and disappears when both engines share a device
+        set."""
+        if _faults.active:
+            _faults.raise_if("serving.kv_handoff", rids=[r.rid])
+        with _obs.trace_span("serving.kv_handoff"):
+            block = self.pre.runner.gather_pages(src_pages)
+            if self._cross_device:
+                sh = self.dec.runner.cache_sharding
+                if sh is not None:
+                    block = tuple(jax.device_put(a, sh) for a in block)
+                else:
+                    dev = self.dec.runner.devices[0]
+                    block = tuple(jax.device_put(a, dev) for a in block)
+            self.dec.runner.scatter_pages(dst_pages, block)
+
+    def _drain(self):
+        """Move every ready handoff into a decode slot.  An entry waits (the
+        queue is FIFO — order preserves fairness) until the decode side has
+        a free slot AND enough free pages; transient transfer faults retry,
+        poison quarantines only that request with pages released on both
+        slices."""
+        dec = self.dec
+        while self._queue:
+            h = self._queue[0]
+            if h.r.status.terminal:       # cancelled/expired while queued
+                self._queue.popleft()
+                self._drop_prefill_pages(h.pages)
+                continue
+            slot = dec.sched.free_slot()
+            if slot is None:
+                break
+            if dec.pool.n_available() < len(h.pages):
+                break
+            self._queue.popleft()
+            dst = []
+            for _ in h.pages:
+                p = dec.pool.alloc_page()
+                if p is None:             # raced below n_available: requeue
+                    break
+                dst.append(p)
+            if len(dst) < len(h.pages):
+                for p in dst:
+                    dec.pool.unref_page(p)
+                self._queue.appendleft(h)
+                break
+
+            def xfer():
+                try:
+                    self._transfer(h.r, h.pages, dst)
+                except Exception as err:
+                    if getattr(err, "transient", False):
+                        self.handoff_retries += 1
+                        raise _TransientHandoff(err) from err
+                    raise
+
+            try:
+                retry_call(xfer, policy=self._handoff_retry,
+                           retry_on=(_TransientHandoff,),
+                           op="serving.kv_handoff")
+            except Exception as err:  # noqa: BLE001 — quarantine boundary
+                if isinstance(err, RetryError):
+                    err = err.__cause__.err
+                self.handoff_failures += 1
+                for p in dst:
+                    dec.pool.unref_page(p)
+                self._drop_prefill_pages(h.pages)
+                dec.sched.finalize(h.r, RequestStatus.FAILED, error=err)
+                continue
+            dec.sched.admit_prefilled(h.r, dst, h.n_tokens)
+            self._drop_prefill_pages(h.pages)
+            self.handoffs += 1
+
+    def _expire_queue(self):
+        import time
+        now = time.perf_counter()
+        expired = [h for h in self._queue
+                   if h.r.deadline is not None and now > h.r.deadline]
+        for h in expired:
+            self._queue.remove(h)
+            self._drop_prefill_pages(h.pages)
+            self.dec.sched.finalize(h.r, RequestStatus.TIMEOUT)
+
+    # ----------------------------------------------------------------- step
+    def step(self):
+        """One disaggregated scheduling round: drain ready handoffs, ALWAYS
+        step the decode engine (its token cadence never waits on a prompt),
+        and step the prefill engine only while the handoff queue has room
+        (backpressure).  Returns #slots served across both slices."""
+        if self._queue:
+            self._expire_queue()
+            self._drain()
+        served = self.dec.step()
+        if len(self._queue) < self.handoff_depth and (
+                self.pre.sched.waiting
+                or any(s is not None for s in self.pre.sched.slots)):
+            served += self.pre.step()
+            # a prompt that just finished prefilling goes straight for a
+            # decode slot — next step's decode can already carry it
+            if self._queue:
+                self._drain()
+        if self.debug_refcount_audit:
+            problems = self.audit_refcounts()
+            if problems:
+                raise RuntimeError("page-refcount audit failed:\n  "
+                                   + "\n  ".join(problems))
+        return served
+
+    def run_until_done(self, max_steps=10000):
+        steps = 0
+        while self.has_work() and steps < max_steps:
+            self.step()
+            steps += 1
+        return steps
+
+    def has_work(self):
+        return bool(self.pre.sched.waiting or self._queue
+                    or any(s is not None for s in self.pre.sched.slots)
+                    or self.dec.sched.waiting
+                    or any(s is not None for s in self.dec.sched.slots))
+
+    # ------------------------------------------------------------ accessors
+    def _lookup(self, rid):
+        for r in self.pre.sched.waiting:
+            if r.rid == rid:
+                return r
+        for r in self.pre.sched.slots:
+            if r is not None and r.rid == rid:
+                return r
+        for h in self._queue:
+            if h.r.rid == rid:
+                return h.r
+        for r in self.dec.sched.slots:
+            if r is not None and r.rid == rid:
+                return r
+        for r in self.dec.sched.waiting:    # decode-side preemption requeue
+            if r.rid == rid:
+                return r
+        if rid in self.dec.sched.finished:
+            return self.dec.sched.finished[rid]
+        return self.pre.sched.finished[rid]
+
+    def result(self, rid):
+        r = self._lookup(rid)
+        if not r.status.terminal:
+            raise KeyError(rid)
+        return r.out
+
+    def status(self, rid):
+        return self._lookup(rid).status
+
+    def error(self, rid):
+        return self._lookup(rid).error
+
+    def ttft(self, rid):
+        return self._lookup(rid).ttft
+
+    def tpot(self, rid):
+        r = self._lookup(rid)
+        if r.t_finish is None or r.ttft is None or len(r.out) < 2:
+            return None
+        return (r.t_finish - r.t_submit - r.ttft) / (len(r.out) - 1)
+
+    def new_tokens(self, rid):
+        r = self._lookup(rid)
+        toks = [int(t) for t in r.out[r.stream_pos:]]
+        r.stream_pos += len(toks)
+        return toks
+
+    def fail_all(self, error):
+        self.pre.fail_all(error)
+        while self._queue:
+            h = self._queue.popleft()
+            self._drop_prefill_pages(h.pages)
+            self.dec.sched.finalize(h.r, RequestStatus.FAILED, error=error)
+        self.dec.fail_all(error)
+
+    def audit_refcounts(self):
+        """Combined page-accounting audit across BOTH slices: the prefill
+        pool's expected refcounts include the handoff queue's holds (pages
+        detached from a slot but not yet transferred), the decode pool's
+        are its slot tables alone.  Empty list means clean."""
+        pre_expected = self.pre.sched.expected_refs(self.pre.n_pages)
+        for h in self._queue:
+            for p in h.pages:
+                pre_expected[p] += 1
+        problems = [f"prefill: {msg}"
+                    for msg in self.pre.pool.audit(pre_expected)]
+        dec_expected = self.dec.sched.expected_refs(self.dec.n_pages)
+        problems += [f"decode: {msg}"
+                     for msg in self.dec.pool.audit(dec_expected)]
+        return problems
+
+    def spec_stats(self):
+        return self.dec.spec_stats()
+
+    def prefix_cache_stats(self):
+        return self.pre.prefix_cache_stats()
+
+    def handoff_stats(self):
+        """Always-on counters for the prefill→decode seam."""
+        return {
+            "handoffs": self.handoffs,
+            "queued": len(self._queue),
+            "depth": self.handoff_depth,
+            "retries": self.handoff_retries,
+            "failures": self.handoff_failures,
+            "cross_device": self._cross_device,
+        }
+
+    def health(self):
+        """Combined liveness snapshot: per-slice engine health plus the
+        handoff seam counters."""
+        return {
+            "prefill": self.pre.health(),
+            "decode": self.dec.health(),
+            "handoff": self.handoff_stats(),
+        }
+
+    @property
+    def preemptions(self):
+        return self.pre.sched.preemptions + self.dec.sched.preemptions

@@ -1,0 +1,51 @@
+"""Per-engine registry bindings (label ``engine=<seq>``).
+
+One :class:`_EngineMetrics` is built per :class:`~.core.LLMEngine`; every
+series the engine touches on the hot path is resolved to a labelled child
+exactly once here, so the step loop never pays a registry lookup.
+"""
+from __future__ import annotations
+
+from ... import observability as _obs
+from .request import TERMINAL_STATUSES
+
+__all__ = ["_EngineMetrics"]
+
+
+class _EngineMetrics:
+    """Registry children bound once per engine (label ``engine=<seq>``).
+
+    Every mutation is a no-op while observability is disabled, so the engine
+    attributes (cache_hits, preemptions, ...) stay the always-on source of
+    truth and the registry mirrors them 1:1 whenever metrics are on — the
+    parity :meth:`LLMEngine.prefix_cache_stats` keeps by construction."""
+
+    def __init__(self, label):
+        e = {"engine": label}
+        self.label = label
+        self.ttft = _obs.SERVING_TTFT.labels(**e)
+        self.token_latency = _obs.SERVING_TOKEN_LATENCY.labels(**e)
+        self.queue_depth = _obs.SERVING_QUEUE_DEPTH.labels(**e)
+        self.active_slots = _obs.SERVING_ACTIVE_SLOTS.labels(**e)
+        self.occupancy = _obs.SERVING_OCCUPANCY.labels(**e)
+        self.prefill = _obs.SERVING_DISPATCHES.labels(kind="prefill", **e)
+        self.decode = _obs.SERVING_DISPATCHES.labels(kind="decode", **e)
+        self.tokens = _obs.SERVING_TOKENS.labels(**e)
+        self.preempt = _obs.SERVING_PREEMPTIONS.labels(**e)
+        self.hits = _obs.SERVING_CACHE_EVENTS.labels(event="hit", **e)
+        self.misses = _obs.SERVING_CACHE_EVENTS.labels(event="miss", **e)
+        self.evictions = _obs.SERVING_CACHE_EVENTS.labels(event="eviction",
+                                                          **e)
+        self.cow = _obs.SERVING_CACHE_EVENTS.labels(event="cow_copy", **e)
+        self.cached_pages = _obs.SERVING_CACHED_PAGES.labels(**e)
+        self.reclaimable = _obs.SERVING_RECLAIMABLE_PAGES.labels(**e)
+        self.free_pages = _obs.SERVING_FREE_PAGES.labels(**e)
+        self.verify = _obs.SERVING_DISPATCHES.labels(kind="verify", **e)
+        self.spec_proposed = _obs.SERVING_SPEC_PROPOSED.labels(**e)
+        self.spec_accepted = _obs.SERVING_SPEC_ACCEPTED.labels(**e)
+        self.spec_acceptance = _obs.SERVING_SPEC_ACCEPTANCE.labels(**e)
+        self.terminal = {s: _obs.SERVING_TERMINALS.labels(status=s.value, **e)
+                         for s in TERMINAL_STATUSES}
+        self.step_fail = {ph: _obs.SERVING_STEP_FAILURES.labels(phase=ph, **e)
+                          for ph in ("prefill", "decode", "verify")}
+        self.probes = _obs.SERVING_QUARANTINE_PROBES.labels(**e)

@@ -1,0 +1,171 @@
+"""Paged-KV accounting: the host half of the engine's KV memory manager.
+
+:class:`PagePool` owns every *host-side* page structure — the free list, the
+per-page refcounts, the chain-hash prefix index, and the reclaimable LRU —
+while the device arrays the pages index into live in
+:class:`~.runner.ModelRunner`.  The split is the engine-core refactor's
+contract: the pool never touches a device buffer (copy-on-write's device
+copy is a callable injected by the engine), and the runner never sees a
+refcount.
+
+Invariants (checked by :meth:`audit`):
+
+- a page's refcount equals the number of slot-table references to it (plus
+  any in-flight handoff references the caller declares),
+- free and LRU-parked pages carry refcount 0 and never overlap,
+- no page leaks (refcount 0 yet neither free nor parked),
+- LRU pages are content-registered and the prefix key index is symmetric.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+
+import numpy as np
+
+from ...testing.faults import FAULTS as _faults
+
+__all__ = ["PagePool"]
+
+
+class PagePool:
+    """Refcounted page allocator with an optional chain-hash prefix index.
+
+    ``n_pages`` INCLUDES the trash page (``n_pages - 1``), which is never
+    allocated — it absorbs the masked-out writes of inactive batch rows.
+    ``metrics`` is an optional object carrying bound registry counters
+    (``hits`` / ``misses`` / ``evictions`` / ``cow``); every metric touch is
+    guarded so the pool works metric-less (the disagg prefill/decode slices
+    each bind their own engine's metrics)."""
+
+    def __init__(self, n_pages, prefix_cache=False, metrics=None):
+        self.n_pages = int(n_pages)
+        self.trash_page = self.n_pages - 1
+        self.free_pages = deque(range(self.n_pages - 1))
+        self.page_ref = np.zeros(self.n_pages, np.int64)
+        self.prefix_cache = bool(prefix_cache)
+        # optional (event, chain_key) callback — the frontend router
+        # subscribes here to mirror this engine's radix index ("register" on
+        # page registration, "evict" on LRU reclaim) into its per-replica
+        # affinity index.  Called from inside step(); must be cheap and
+        # must not raise.
+        self.cache_event_listener = None
+        self.page_key: dict = {}          # physical page -> chain key
+        self.key_page: dict = {}          # chain key -> physical page
+        self.lru: OrderedDict = OrderedDict()  # cached, refcount==0 pages
+        self.cache_hits = 0                # pages served from cache (admit)
+        self.cache_misses = 0              # full prompt pages not cached
+        self.cache_evictions = 0           # cached pages reclaimed from LRU
+        self.cache_cow_copies = 0          # copy-on-write page copies
+        self._m = metrics
+
+    # ------------------------------------------------------------- refcounts
+    def ref_page(self, p):
+        self.page_ref[p] += 1
+        self.lru.pop(p, None)         # referenced again: not reclaimable
+
+    def unref_page(self, p):
+        self.page_ref[p] -= 1
+        if self.page_ref[p] > 0:
+            return
+        if p in self.page_key:        # content cached: park reclaimable
+            self.lru[p] = None
+            self.lru.move_to_end(p)
+        else:
+            self.free_pages.append(p)
+
+    def alloc_page(self):
+        """A writable page with refcount 1: free list first, then LRU
+        eviction of the oldest cached-but-unreferenced page. Returns None
+        when both are dry (the caller preempts — last resort)."""
+        if _faults.active and _faults.fire("serving.page_alloc") is not None:
+            return None               # injected allocation failure (dry pool)
+        if self.free_pages:
+            p = self.free_pages.popleft()
+        elif self.lru:
+            p, _ = self.lru.popitem(last=False)
+            key = self.page_key.pop(p)
+            self.key_page.pop(key, None)
+            self.cache_evictions += 1
+            if self._m is not None:
+                self._m.evictions.inc()
+            if self.cache_event_listener is not None:
+                self.cache_event_listener("evict", key)
+        else:
+            return None
+        self.page_ref[p] = 1
+        return p
+
+    # ----------------------------------------------------------- prefix index
+    def lookup(self, key):
+        """Physical page currently serving ``key``'s content, or None."""
+        return self.key_page.get(key)
+
+    def register(self, p, key):
+        """Content-register page ``p`` under chain ``key``.  First
+        registration wins; a page whose content another physical page
+        already serves stays private.  Returns True when registered."""
+        if p in self.page_key or key in self.key_page:
+            return False
+        self.page_key[p] = key
+        self.key_page[key] = p
+        if self.cache_event_listener is not None:
+            self.cache_event_listener("register", key)
+        return True
+
+    def record_admission(self, n_hits, n_misses):
+        """Admission-time hit/miss accounting (pages, not tokens)."""
+        self.cache_hits += n_hits
+        self.cache_misses += n_misses
+        if self._m is not None:
+            self._m.hits.inc(n_hits)
+            self._m.misses.inc(n_misses)
+
+    # ------------------------------------------------------------------ state
+    @property
+    def n_usable(self):
+        """Pages the budget covers (the trash page excluded)."""
+        return self.n_pages - 1
+
+    def n_available(self, reserved_lru=0):
+        """Pages admission could newly claim: free + reclaimable, minus LRU
+        pages the caller is about to re-reference (cache hits parked in the
+        LRU are NOT allocatable — they are being claimed as hits)."""
+        return len(self.free_pages) + len(self.lru) - reserved_lru
+
+    # ------------------------------------------------------------------ audit
+    def audit(self, expected_refs):
+        """Cross-check every page-accounting structure against the others;
+        returns a list of problem strings (empty means clean).
+        ``expected_refs`` is the caller-computed per-page reference count
+        (slot-table references plus any in-flight handoff holds)."""
+        problems = []
+        free = [int(p) for p in self.free_pages]
+        free_set = set(free)
+        if len(free_set) != len(free):
+            problems.append("free list holds duplicate pages")
+        lru_set = {int(p) for p in self.lru}
+        both = free_set & lru_set
+        if both:
+            problems.append(f"pages both free and LRU-parked: {sorted(both)}")
+        for p in range(self.n_pages - 1):            # trash page excluded
+            refs, exp = int(self.page_ref[p]), int(expected_refs[p])
+            if refs != exp:
+                problems.append(f"page {p}: refcount {refs} != "
+                                f"{exp} slot-table references")
+            if refs == 0 and p not in free_set and p not in lru_set:
+                problems.append(f"page {p}: leaked "
+                                "(refcount 0, neither free nor LRU-parked)")
+            if refs > 0 and (p in free_set or p in lru_set):
+                problems.append(f"page {p}: referenced but on the "
+                                "free/LRU list")
+        for p in lru_set:
+            if p not in self.page_key:
+                problems.append(f"page {p}: LRU-parked but not "
+                                "content-registered")
+        for p, key in self.page_key.items():
+            if self.key_page.get(key) != p:
+                problems.append(f"page {p}: page->key->page asymmetric")
+        for key, p in self.key_page.items():
+            if self.page_key.get(p) != key:
+                problems.append(f"page {p}: key->page->key asymmetric")
+        return problems

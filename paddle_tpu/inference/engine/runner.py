@@ -1,0 +1,485 @@
+"""Device half of the engine core: weights, paged-KV buffers, and the jitted
+prefill / decode / verify programs, all pinned to ONE mesh slice.
+
+:class:`ModelRunner` owns everything that lives on (or dispatches to) the
+accelerator: the stacked ``[L, ...]`` weight arrays with their pp×mp
+NamedShardings, the paged KV cache arrays, the per-shape jitted program
+caches, the copy-on-write device page copy, and the page gather/scatter
+primitives the disaggregated engine's KV handoff is built from.  It holds NO
+scheduling state — no queues, no refcounts, no request objects — so two
+runners over disjoint mesh slices (prefill vs decode) can serve one logical
+engine.
+
+TPU-native design (carried over from the monolithic serving engine):
+- TWO jitted programs serve a colocated engine: a PREFILL step consuming a
+  CHUNK of prompt tokens for one slot per dispatch (chunk rows ride the
+  paged-attention kernel's batch dim with per-row context lengths, so causal
+  masking falls out of ctx=pos+1), and a DECODE step feeding every in-flight
+  slot its last token — token-level continuous batching (Orca-style).  A
+  third VERIFY program scores K+1 consecutive positions per request for
+  speculative decoding.
+- Sampling happens IN-GRAPH with per-slot parameters (greedy / temperature /
+  top-k / top-p / seed), replicating models.llama._sample token-for-token.
+- KV lives in PAGES [L, n_pages, page, KVH, D]; page tables arrive from the
+  scheduler per dispatch.  Pages are just indices here — allocation policy
+  (refcounts, prefix cache, preemption) is the PagePool's business.
+- Weights are extracted from the model once, stacked [L, ...] and placed
+  with NamedShardings: layers sharded over the pp axis, head/ffn dims over
+  the mp axis. GSPMD inserts the collectives.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["ModelRunner"]
+
+_MAXK = 64        # static cap for per-slot dynamic top-k filtering
+
+
+def _rope(x, pos, theta):
+    """neox-style RoPE at integer positions pos [B] (x [B, Hn, D])."""
+    D = x.shape[-1]
+    inv = 1.0 / (theta ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+    freqs = pos.astype(jnp.float32)[:, None] * inv[None, :]      # [B, D/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)               # [B, D]
+    s, c = jnp.sin(emb)[:, None, :], jnp.cos(emb)[:, None, :]
+    xf = x.astype(jnp.float32)
+    half = D // 2
+    rot = jnp.concatenate([-xf[..., half:], xf[..., :half]], axis=-1)
+    return (xf * c + rot * s).astype(x.dtype)
+
+
+def _rms(x, w, eps):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps) * w.astype(jnp.float32)).astype(
+        x.dtype)
+
+
+def _sample_row(logits, greedy, temp, topp, topk, seed):
+    """One row of in-graph sampling, replicating models.llama._sample +
+    ops.top_p_sampling (same filter order, same sort, same categorical
+    key/shape) so a SEEDED top_p<1 engine decode == model.generate.
+    (At top_p>=1.0, generate falls through to ops.multinomial on the global
+    RNG stream, which ignores the seed — no parity is possible there by
+    construction.) logits [V] f32; scalars traced."""
+    maxk = min(_MAXK, logits.shape[-1])
+    amax = jnp.argmax(logits)
+    l = logits / jnp.where(temp > 0, temp, 1.0)
+    probs = jax.nn.softmax(l)
+    # top-k (0 = off): zero everything below the k-th largest prob
+    kvals, _ = jax.lax.top_k(probs, maxk)
+    thresh = kvals[jnp.clip(topk - 1, 0, maxk - 1)]
+    probs = jnp.where((topk > 0) & (probs < thresh), 0.0, probs)
+    probs = probs / jnp.sum(probs)
+    # top-p over the full sorted vocab (ops.top_p_sampling's formulation)
+    sort_idx = jnp.argsort(-probs)
+    sorted_p = probs[sort_idx]
+    cum = jnp.cumsum(sorted_p)
+    keep = jnp.where(topp < 1.0, (cum - sorted_p) < topp, sorted_p >= 0)
+    filtered = jnp.where(keep, sorted_p, 0.0)
+    filtered = filtered / jnp.sum(filtered)
+    key = jax.random.PRNGKey(seed)
+    # [1, V] shape matches the b=1 categorical in ops.top_p_sampling, so the
+    # gumbel draw is bit-identical at equal keys
+    choice = jax.random.categorical(
+        key, jnp.log(jnp.maximum(filtered, 1e-30))[None, :], axis=-1)[0]
+    tok = sort_idx[choice]
+    return jnp.where(greedy > 0, amax, tok).astype(jnp.int32)
+
+
+class ModelRunner:
+    """Weights + paged KV + jitted forwards over one mesh (slice)."""
+
+    def __init__(self, model, mesh=None, mp_axis="mp", pp_axis="pp",
+                 max_batch=4, page_size=16, prefill_chunk=32, n_pages=None,
+                 use_kernel=None, kv_cache_dtype="auto"):
+        cfg = model.config
+        self.cfg = cfg
+        self.mesh = mesh
+        self.max_batch = int(max_batch)
+        self.page = int(page_size)
+        self.chunk = int(prefill_chunk)
+        self.n_pages = int(n_pages)
+        self.trash_page = self.n_pages - 1
+        L = cfg.num_hidden_layers
+        H = cfg.hidden_size
+        nh, kvh = cfg.num_attention_heads, cfg.num_key_value_heads
+        D = H // nh
+        self.nh, self.kvh, self.D = nh, kvh, D
+        if use_kernel is None:
+            use_kernel = (mesh is None and
+                          jax.devices()[0].platform in ("tpu", "axon"))
+        self.use_kernel = use_kernel
+
+        def wb(lin):        # Linear stores weight [in, out]
+            return np.asarray(lin.weight._data)
+
+        lay = model.llama.layers
+        W = {
+            "embed": np.asarray(model.llama.embed_tokens.weight._data),
+            "norm": np.asarray(model.llama.norm.weight._data),
+            "wq": np.stack([wb(l.self_attn.q_proj) for l in lay]),
+            "wk": np.stack([wb(l.self_attn.k_proj) for l in lay]),
+            "wv": np.stack([wb(l.self_attn.v_proj) for l in lay]),
+            "wo": np.stack([wb(l.self_attn.o_proj) for l in lay]),
+            "ln1": np.stack([np.asarray(l.input_layernorm.weight._data)
+                             for l in lay]),
+            "ln2": np.stack([np.asarray(
+                l.post_attention_layernorm.weight._data) for l in lay]),
+            "wg": np.stack([wb(l.mlp.gate_proj) for l in lay]),
+            "wu": np.stack([wb(l.mlp.up_proj) for l in lay]),
+            "wd": np.stack([wb(l.mlp.down_proj) for l in lay]),
+        }
+        W["head"] = (np.asarray(model.lm_head.weight._data)
+                     if model.lm_head is not None else W["embed"].T)
+        dtype = W["wq"].dtype
+        if mesh is not None:
+            pp = pp_axis if pp_axis in mesh.axis_names else None
+            mp = mp_axis if mp_axis in mesh.axis_names else None
+
+            def put(name, arr, spec):
+                return jax.device_put(jnp.asarray(arr),
+                                      NamedSharding(mesh, spec))
+            specs = {
+                "embed": P(), "norm": P(), "head": P(None, mp),
+                "wq": P(pp, None, mp), "wk": P(pp, None, mp),
+                "wv": P(pp, None, mp), "wo": P(pp, mp, None),
+                "ln1": P(pp, None), "ln2": P(pp, None),
+                "wg": P(pp, None, mp), "wu": P(pp, None, mp),
+                "wd": P(pp, mp, None),
+            }
+            self.W = {k: put(k, v, specs[k]) for k, v in W.items()}
+            cache_spec = NamedSharding(mesh, P(pp))
+        else:
+            self.W = {k: jnp.asarray(v) for k, v in W.items()}
+            cache_spec = None
+        self.cache_sharding = cache_spec
+        self.kv_quant = (kv_cache_dtype == "int8")
+        page_dtype = jnp.int8 if self.kv_quant else dtype
+        kp = jnp.zeros((L, self.n_pages, page_size, kvh, D), page_dtype)
+        vp = jnp.zeros_like(kp)
+        if cache_spec is not None:
+            kp = jax.device_put(kp, cache_spec)
+            vp = jax.device_put(vp, cache_spec)
+        if self.kv_quant:
+            ks = jnp.zeros((L, self.n_pages, page_size, kvh), jnp.float32)
+            vs = jnp.zeros_like(ks)
+            if cache_spec is not None:
+                ks = jax.device_put(ks, cache_spec)
+                vs = jax.device_put(vs, cache_spec)
+            self.cache = (kp, vp, ks, vs)
+        else:
+            self.cache = (kp, vp)
+        self._prefill = self._build_prefill()
+        self._decode_programs: dict = {}
+        self._verify_programs: dict = {}
+        self._copy_page_fn = None
+        self._gather_fn = {}
+        self._scatter_fn = {}
+
+    @property
+    def devices(self):
+        """The device set this runner's buffers live on."""
+        if self.mesh is not None:
+            return tuple(self.mesh.devices.reshape(-1))
+        return (jax.devices()[0],)
+
+    # ---------------------------------------------------------------- layers
+    def _layer_fn(self, page_idx, within, tables, ctx, pos, mq=None):
+        """Shared per-layer body for decode, prefill, and speculative
+        verification (they differ only in how many rows ride the batch dim
+        and where those rows' pages are). With ``mq=(B, Q)`` the flat rows
+        are B sequences x Q consecutive query positions and attention goes
+        through the multi-query kernel (tables [B, S]; ctx [B] is row 0's
+        context length, row j sees ctx+j); KV writes stay per-flat-row."""
+        nh, kvh, D = self.nh, self.kvh, self.D
+        eps = self.cfg.rms_norm_eps
+        theta = self.cfg.rope_theta
+        use_kernel = self.use_kernel
+
+        quant = self.kv_quant
+
+        def layer(carry, wl):
+            from ...ops.pallas.paged_attention import (
+                paged_attention, paged_attention_multiquery,
+                paged_attention_multiquery_ref, paged_attention_ref,
+                quantize_kv)
+            x, = carry
+            h = _rms(x, wl["ln1"], eps)
+            q = (h @ wl["wq"]).reshape(-1, nh, D)
+            k = (h @ wl["wk"]).reshape(-1, kvh, D)
+            v = (h @ wl["wv"]).reshape(-1, kvh, D)
+            q = _rope(q, pos, theta)
+            k = _rope(k, pos, theta)
+            if mq is None:
+                attn = paged_attention if use_kernel else paged_attention_ref
+            else:
+                Bq, Q = mq
+                base = (paged_attention_multiquery if use_kernel
+                        else paged_attention_multiquery_ref)
+
+                def attn(qx, kp, vp, tb, cl, **kw):
+                    out = base(qx.reshape(Bq, Q, nh, D), kp, vp, tb, cl,
+                               **kw)
+                    return out.reshape(Bq * Q, nh, D)
+            if quant:
+                kq, ksc = quantize_kv(k)
+                vq, vsc = quantize_kv(v)
+                kpl = wl["kp"].at[page_idx, within].set(kq)
+                vpl = wl["vp"].at[page_idx, within].set(vq)
+                ksl = wl["kps"].at[page_idx, within].set(ksc)
+                vsl = wl["vps"].at[page_idx, within].set(vsc)
+                att = attn(q, kpl, vpl, tables, ctx,
+                           k_scales=ksl, v_scales=vsl)
+                new_cache = (kpl, vpl, ksl, vsl)
+            else:
+                kpl = wl["kp"].at[page_idx, within].set(k)
+                vpl = wl["vp"].at[page_idx, within].set(v)
+                att = attn(q, kpl, vpl, tables, ctx)
+                new_cache = (kpl, vpl)
+            x = x + att.reshape(-1, nh * D) @ wl["wo"]
+            h = _rms(x, wl["ln2"], eps)
+            gate = h @ wl["wg"]
+            up = h @ wl["wu"]
+            x = x + (jax.nn.silu(gate.astype(jnp.float32)).astype(
+                up.dtype) * up) @ wl["wd"]
+            return (x,), new_cache
+
+        return layer
+
+    def _scan_layers(self, W, cache, x, layer):
+        per_layer = {k: W[k] for k in
+                     ("wq", "wk", "wv", "wo", "ln1", "ln2",
+                      "wg", "wu", "wd")}
+        per_layer["kp"], per_layer["vp"] = cache[0], cache[1]
+        if len(cache) == 4:
+            per_layer["kps"], per_layer["vps"] = cache[2], cache[3]
+        (x,), new_cache = jax.lax.scan(layer, (x,), per_layer)
+        return x, new_cache
+
+    # ------------------------------------------------------------- programs
+    def _build_decode(self, K):
+        """K decode steps fused into ONE dispatch (token feedback stays
+        in-graph via lax.scan) — through a remote dispatch path each host
+        round trip costs RTT, which a per-token loop pays in full; a K-block
+        pays RTT/K. The host sees the K sampled tokens afterwards, so eos
+        requests cap K at 1 (every token must be inspected). Mirrors
+        generate()'s tokens_per_dispatch."""
+        page = self.page
+        eps = self.cfg.rms_norm_eps
+        trash = self.trash_page
+
+        def block(W, cache, tokens, lens, tables, active,
+                  greedy, temp, topp, topk, seeds, fold):
+            # tokens [B] int32; lens [B] tokens already cached; tables
+            # [B, S] page ids; active [B] 0/1; sampling params [B].
+            # fold [B]: 1 -> vary the sampling key per block step (seedless
+            # requests); 0 -> reuse it (fixed-seed generate parity).
+            def one(carry, i):
+                tokens, lens, cache = carry
+                x = W["embed"][tokens]                   # [B, H]
+                pos = lens.astype(jnp.int32)
+                page_idx = jnp.take_along_axis(
+                    tables, (pos // page)[:, None], axis=1)[:, 0]
+                # inactive slots write into the trash page, never a live one
+                page_idx = jnp.where(active > 0, page_idx, trash)
+                within = pos % page
+                ctx = jnp.where(active > 0, pos + 1, 1).astype(jnp.int32)
+                layer = self._layer_fn(page_idx, within, tables, ctx, pos)
+                x, cache = self._scan_layers(W, cache, x, layer)
+                h = _rms(x, W["norm"], eps)
+                logits = h.astype(jnp.float32) @ W["head"].astype(
+                    jnp.float32)
+                # one vmapped sampler, not B inlined sort/cumsum subgraphs
+                nxt = jax.vmap(_sample_row)(logits, greedy, temp, topp,
+                                            topk, seeds + i * fold)
+                tokens = jnp.where(active > 0, nxt, tokens)
+                lens = lens + (active > 0).astype(lens.dtype)
+                return (tokens, lens, cache), nxt
+
+            (_, _, cache2), toks = jax.lax.scan(
+                one, (tokens, lens, cache),
+                jnp.arange(K, dtype=jnp.int32))
+            return toks, cache2                          # toks [K, B]
+
+        return jax.jit(block, donate_argnums=(1,))
+
+    def _build_prefill(self):
+        page = self.page
+        eps = self.cfg.rms_norm_eps
+        trash = self.trash_page
+        C = self.chunk
+
+        def prefill(W, cache, tokens, start, table, n_valid,
+                    greedy, temp, topp, topk, seed):
+            # tokens [C] int32 (one slot's prompt chunk, zero-padded);
+            # start scalar; table [S]; n_valid scalar <= C. Chunk rows ride
+            # the paged-attention BATCH dim: row i gets ctx = start+i+1, so
+            # in-chunk causality and attention to the already-cached prefix
+            # both fall out of the per-row context length.
+            x = W["embed"][tokens]                       # [C, H]
+            offs = jnp.arange(C, dtype=jnp.int32)
+            pos = start.astype(jnp.int32) + offs
+            valid = offs < n_valid
+            page_idx = table[pos // page]
+            page_idx = jnp.where(valid, page_idx, trash)
+            within = pos % page
+            ctx = jnp.where(valid, pos + 1, 1).astype(jnp.int32)
+            tables = jnp.broadcast_to(table[None, :], (C, table.shape[0]))
+            layer = self._layer_fn(page_idx, within, tables, ctx, pos)
+            x, cache2 = self._scan_layers(W, cache, x, layer)
+            h = _rms(x, W["norm"], eps)
+            last = h[jnp.maximum(n_valid - 1, 0)]
+            logits = last.astype(jnp.float32) @ W["head"].astype(jnp.float32)
+            nxt = _sample_row(logits, greedy, temp, topp, topk, seed)
+            return nxt, cache2
+
+        return jax.jit(prefill, donate_argnums=(1,))
+
+    def _build_verify(self, Kv):
+        """ONE forward scoring Kv consecutive positions per request — the
+        speculative-decoding verifier. Row 0 carries the pending token
+        (what plain decode would feed), rows 1..n the proposed drafts;
+        sampling row j yields the target model's token AFTER draft j, so
+        the host accepts the longest draft prefix matching the sampled
+        tokens and emits accepted+1 tokens from a single dispatch. All Kv
+        KV writes land in-graph; the host rolls back pages past the
+        accepted point afterwards (attention masks by context length, so
+        stale writes beyond a slot's length are never attended)."""
+        page = self.page
+        eps = self.cfg.rms_norm_eps
+        trash = self.trash_page
+        B = self.max_batch
+
+        def verify(W, cache, tokens, lens, tables, n_rows,
+                   greedy, temp, topp, topk, seeds, fold):
+            # tokens [B, Kv] int32 (row 0 = pending, 1.. = drafts, rest
+            # padding); lens [B] tokens already cached; n_rows [B] valid
+            # rows (0 = inactive slot); sampling params [B] as in decode.
+            row_j = jnp.tile(jnp.arange(Kv, dtype=jnp.int32), B)  # [B*Kv]
+
+            def rep(a):
+                return jnp.repeat(a, Kv)
+
+            pos = rep(lens.astype(jnp.int32)) + row_j
+            valid = row_j < rep(n_rows)
+            page_idx = jnp.take_along_axis(
+                tables, (pos // page).reshape(B, Kv), axis=1).reshape(-1)
+            page_idx = jnp.where(valid, page_idx, trash)
+            within = pos % page
+            # row 0 of an active request sees lens+1 tokens (its own write
+            # included); the multi-query kernel extends by +j per row
+            cl = jnp.where(n_rows > 0, lens + 1, 1).astype(jnp.int32)
+            x = W["embed"][tokens.reshape(-1)]            # [B*Kv, H]
+            layer = self._layer_fn(page_idx, within, tables, cl, pos,
+                                   mq=(B, Kv))
+            x, cache2 = self._scan_layers(W, cache, x, layer)
+            h = _rms(x, W["norm"], eps)
+            logits = h.astype(jnp.float32) @ W["head"].astype(jnp.float32)
+            # seed schedule mirrors the decode block's `seeds + i*fold`:
+            # emitted token #j of this step draws the key step #j of a
+            # non-speculative block would have drawn, so fixed-seed
+            # (fold=0) and greedy requests stay token-exact vs spec-off
+            seeds_rep = rep(seeds) + row_j * rep(fold)
+            toks = jax.vmap(_sample_row)(
+                logits, rep(greedy), rep(temp), rep(topp), rep(topk),
+                seeds_rep)
+            return toks.reshape(B, Kv), cache2
+
+        return jax.jit(verify, donate_argnums=(1,))
+
+    # ------------------------------------------------------------- dispatch
+    def has_decode_program(self, k):
+        return k in self._decode_programs
+
+    def has_verify_program(self, kv):
+        return kv in self._verify_programs
+
+    def run_prefill(self, tokens, start, table, n_valid,
+                    greedy, temp, topp, topk, seed):
+        """Dispatch one prefill chunk; returns the sampled next token as a
+        DEVICE value (only the caller decides whether to sync on it — a
+        mid-prompt chunk's sample is never read)."""
+        nxt, self.cache = self._prefill(
+            self.W, self.cache, jnp.asarray(tokens),
+            jnp.asarray(np.int32(start)), jnp.asarray(table),
+            jnp.asarray(np.int32(n_valid)),
+            jnp.asarray(np.int32(greedy)), jnp.asarray(np.float32(temp)),
+            jnp.asarray(np.float32(topp)), jnp.asarray(np.int32(topk)),
+            jnp.asarray(np.int32(seed)))
+        return nxt
+
+    def run_decode(self, k, tokens, lens, tables, active,
+                   greedy, temp, topp, topk, seeds, fold):
+        """Dispatch one K-token decode block; returns host tokens [k, B]
+        (the np.asarray sync makes the caller's wall time a true dispatch
+        sample)."""
+        prog = self._decode_programs.get(k)
+        if prog is None:
+            prog = self._decode_programs[k] = self._build_decode(k)
+        toks, self.cache = prog(
+            self.W, self.cache, jnp.asarray(tokens), jnp.asarray(lens),
+            jnp.asarray(tables), jnp.asarray(active), jnp.asarray(greedy),
+            jnp.asarray(temp), jnp.asarray(topp), jnp.asarray(topk),
+            jnp.asarray(seeds), jnp.asarray(fold))
+        return np.asarray(toks)
+
+    def run_verify(self, kv, tokens, lens, tables, n_rows,
+                   greedy, temp, topp, topk, seeds, fold):
+        """Dispatch one speculative verify step; returns host tokens
+        [B, Kv]."""
+        prog = self._verify_programs.get(kv)
+        if prog is None:
+            prog = self._verify_programs[kv] = self._build_verify(kv)
+        toks, self.cache = prog(
+            self.W, self.cache, jnp.asarray(tokens), jnp.asarray(lens),
+            jnp.asarray(tables), jnp.asarray(n_rows), jnp.asarray(greedy),
+            jnp.asarray(temp), jnp.asarray(topp), jnp.asarray(topk),
+            jnp.asarray(seeds), jnp.asarray(fold))
+        return np.asarray(toks)
+
+    # ---------------------------------------------------------- page movement
+    def copy_page(self, src, dst):
+        """Device-side copy of one physical KV page (all layers, K and V,
+        int8 scales included) — the copy half of copy-on-write."""
+        if self._copy_page_fn is None:
+            def cp(cache, s, d):
+                return tuple(a.at[:, d].set(a[:, s]) for a in cache)
+            self._copy_page_fn = jax.jit(cp, donate_argnums=(0,))
+        self.cache = self._copy_page_fn(
+            self.cache, jnp.asarray(np.int32(src)), jnp.asarray(np.int32(dst)))
+
+    def gather_pages(self, page_idx):
+        """Pull ``page_idx`` pages out of the cache as a dense block (tuple
+        of [L, n, page, ...] arrays) — the send half of a cross-slice KV
+        handoff.  The gather is jitted per block size so repeated handoffs
+        at one size reuse the program."""
+        n = len(page_idx)
+        fn = self._gather_fn.get(n)
+        if fn is None:
+            def gather(cache, idx):
+                return tuple(a[:, idx] for a in cache)
+            fn = self._gather_fn[n] = jax.jit(gather)
+        return fn(self.cache, jnp.asarray(np.asarray(page_idx, np.int32)))
+
+    def scatter_pages(self, page_idx, block):
+        """Write a dense page block into ``page_idx`` of this runner's cache
+        — the receive half of a cross-slice KV handoff.  The cache buffers
+        are donated, so the write is in-place where XLA allows."""
+        n = len(page_idx)
+        fn = self._scatter_fn.get(n)
+        if fn is None:
+            def scatter(cache, blk, idx):
+                return tuple(a.at[:, idx].set(b) for a, b in zip(cache, blk))
+            fn = self._scatter_fn[n] = jax.jit(scatter, donate_argnums=(0,))
+        self.cache = fn(self.cache, block,
+                        jnp.asarray(np.asarray(page_idx, np.int32)))
+
+    def kv_bytes_per_page(self):
+        """HBM bytes one KV page costs across all layers (both K and V,
+        including int8 scales) — the unit of the page_pool budget."""
+        return sum(int(a.nbytes) for a in self.cache) // self.n_pages

@@ -61,6 +61,9 @@ class AlwaysAdmit:
     def observe_ttft(self, seconds):
         """Accepted and ignored — keeps the policy interface uniform."""
 
+    def observe_tpot(self, seconds):
+        """Accepted and ignored — keeps the policy interface uniform."""
+
 
 class SLOAdmission:
     """Shed when serving the request would blow the SLO rather than after.
@@ -80,20 +83,30 @@ class SLOAdmission:
                        TTFT); with no local window yet the check falls back
                        to the ``serving_ttft_seconds`` histogram when
                        observability is enabled, and otherwise admits.
+    ``tpot_slo``       the recent mean time-per-output-token exceeds
+                       ``tpot_slo`` seconds — the decode-cadence twin of the
+                       TTFT rule, so admission also backs off when decode
+                       batches are saturated even while first tokens still
+                       arrive on time.  Fed by :meth:`observe_tpot` (the
+                       ReplicaSet reports finished requests' whole-life
+                       TPOT); the no-window fallback is the
+                       ``serving_token_latency_seconds`` histogram.
 
     All thresholds are optional; an ``SLOAdmission()`` with defaults only
     enforces the queue bound.
     """
 
     def __init__(self, max_queue_per_replica=64, min_free_page_ratio=0.0,
-                 ttft_slo=None, window=64, retry_after=1.0):
+                 ttft_slo=None, tpot_slo=None, window=64, retry_after=1.0):
         self.max_queue = (None if max_queue_per_replica is None
                           else int(max_queue_per_replica))
         self.min_free_ratio = float(min_free_page_ratio)
         self.ttft_slo = None if ttft_slo is None else float(ttft_slo)
+        self.tpot_slo = None if tpot_slo is None else float(tpot_slo)
         self.retry_after = float(retry_after)
         self._lock = threading.Lock()
         self._ttfts = deque(maxlen=int(window))
+        self._tpots = deque(maxlen=int(window))
 
     def observe_ttft(self, seconds):
         """Feed one finished request's TTFT into the recent window."""
@@ -102,17 +115,33 @@ class SLOAdmission:
         with self._lock:
             self._ttfts.append(float(seconds))
 
-    def _recent_mean_ttft(self):
+    def observe_tpot(self, seconds):
+        """Feed one finished request's per-token decode latency (its
+        whole-life TPOT) into the recent window."""
+        if seconds is None:
+            return
         with self._lock:
-            if self._ttfts:
-                return sum(self._ttfts) / len(self._ttfts)
+            self._tpots.append(float(seconds))
+
+    def _window_or_histogram_mean(self, window, histogram):
+        with self._lock:
+            if window:
+                return sum(window) / len(window)
         if not _obs.enabled():
             return None
-        snap = _obs.snapshot(prefix="serving_ttft_seconds")
-        series = snap.get("serving_ttft_seconds", {}).get("series", ())
+        snap = _obs.snapshot(prefix=histogram)
+        series = snap.get(histogram, {}).get("series", ())
         total = sum(s["sum"] for s in series)
         count = sum(s["count"] for s in series)
         return (total / count) if count else None
+
+    def _recent_mean_ttft(self):
+        return self._window_or_histogram_mean(self._ttfts,
+                                              "serving_ttft_seconds")
+
+    def _recent_mean_tpot(self):
+        return self._window_or_histogram_mean(
+            self._tpots, "serving_token_latency_seconds")
 
     def decide(self, replicas):
         """One admission check against the live replicas' current state."""
@@ -134,4 +163,8 @@ class SLOAdmission:
             mean = self._recent_mean_ttft()
             if mean is not None and mean > self.ttft_slo:
                 return AdmissionDecision(False, "ttft_slo", self.retry_after)
+        if self.tpot_slo is not None:
+            mean = self._recent_mean_tpot()
+            if mean is not None and mean > self.tpot_slo:
+                return AdmissionDecision(False, "tpot_slo", self.retry_after)
         return AdmissionDecision(True)

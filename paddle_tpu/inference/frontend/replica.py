@@ -175,6 +175,13 @@ class EngineReplica:
             except KeyError:
                 return None
 
+    def tpot(self, rid):
+        with self._cv:
+            try:
+                return self.engine.tpot(rid)
+            except KeyError:
+                return None
+
     def health(self):
         with self._cv:
             h = self.engine.health()
@@ -291,8 +298,8 @@ class ReplicaSet:
 
     def _account(self, handle, status):
         """First terminal observation of a request: outcome counter, inflight
-        gauge, stream-duration histogram, and the admission policy's TTFT
-        window.  Idempotent per handle."""
+        gauge, stream-duration histogram, and the admission policy's TTFT and
+        TPOT windows.  Idempotent per handle."""
         if handle._accounted:
             return
         handle._accounted = True
@@ -300,6 +307,9 @@ class ReplicaSet:
         _obs.FRONTEND_INFLIGHT.inc(-1)
         _obs.FRONTEND_STREAM_SECONDS.observe(time.perf_counter() - handle.t0)
         self.admission.observe_ttft(handle.replica.ttft(handle.rid))
+        observe_tpot = getattr(self.admission, "observe_tpot", None)
+        if observe_tpot is not None:
+            observe_tpot(handle.replica.tpot(handle.rid))
 
     def stream(self, handle, poll_timeout=0.5):
         """Yield ``handle``'s tokens as they are emitted, one int at a time,

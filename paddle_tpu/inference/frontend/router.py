@@ -7,16 +7,24 @@ request's page keys *before* dispatch and ask: which replica already holds
 the longest prefix of those pages?  Routing there turns the replica's cached
 pages into skipped prefill work.
 
-The router keeps one key-set per replica, maintained from the engine's own
-cache events (``register`` when a page enters the index, ``evict`` when the
-LRU reclaims it) — :class:`~.replica.EngineReplica` subscribes the engine's
+The router keeps a radix-style NODE index shared across replicas: because a
+chain key already encodes its whole prefix (key_i hashes key_{i-1}), the
+radix trie collapses to one dict ``chain key -> set of replicas holding that
+node`` — the same collapse the engine applies to its own prefix index.  The
+index is maintained from the engine's own cache events (``register`` when a
+page enters the index, ``evict`` when the LRU reclaims it) —
+:class:`~.replica.EngineReplica` subscribes the engine's
 ``cache_event_listener`` hook to :meth:`PrefixAffinityRouter.note_event`, so
 the mirror can never drift from the real index except by the events in
 flight during a step (self-correcting on the next event).
 
-Scoring is ``(longest contiguous prefix-page overlap, -load, name)``: the
-deepest cached prefix wins, load breaks overlap ties, and the replica name
-breaks exact ties so routing is deterministic under equal state.  With zero
+Scoring walks the request's chain ONCE, intersecting the per-node holder
+sets — replicas drop out at the depth where their cache diverges, so the
+walk is O(prompt pages) with early exit, independent of replica count
+(the old per-replica probe loop re-walked the chain R times).  Scoring is
+``(longest contiguous prefix-page overlap, -load, name)``: the deepest
+cached prefix wins, load breaks overlap ties, and the replica name breaks
+exact ties so routing is deterministic under equal state.  With zero
 overlap everywhere the router degrades to least-loaded.
 """
 from __future__ import annotations
@@ -55,29 +63,44 @@ class PrefixAffinityRouter:
     def __init__(self, page_size):
         self.page = int(page_size)
         self._lock = threading.Lock()
-        self._keys = {}          # replica name -> set of live chain keys
+        # radix node index: a chain key names a whole prefix, so the trie
+        # is one flat dict of nodes with the set of replicas holding each
+        self._nodes = {}         # chain key -> set of replica names
+        self._by_replica = {}    # replica name -> set of live chain keys
 
     # ---- index maintenance (driven by engine cache events) ------------------
     def note_event(self, replica_name, event, key):
-        """Mirror one engine cache event into the per-replica key index.
-        ``register`` adds the chain key, ``evict`` drops it; unknown events
-        are ignored so the listener contract stays forward-compatible."""
+        """Mirror one engine cache event into the node index.  ``register``
+        adds the replica to the key's node, ``evict`` drops it; unknown
+        events are ignored so the listener contract stays
+        forward-compatible."""
         with self._lock:
-            keys = self._keys.setdefault(replica_name, set())
+            keys = self._by_replica.setdefault(replica_name, set())
             if event == "register":
                 keys.add(key)
+                self._nodes.setdefault(key, set()).add(replica_name)
             elif event == "evict":
                 keys.discard(key)
+                holders = self._nodes.get(key)
+                if holders is not None:
+                    holders.discard(replica_name)
+                    if not holders:
+                        del self._nodes[key]
 
     def forget(self, replica_name):
         """Drop a replica's whole index (its pages died with it)."""
         with self._lock:
-            self._keys.pop(replica_name, None)
+            for key in self._by_replica.pop(replica_name, ()):
+                holders = self._nodes.get(key)
+                if holders is not None:
+                    holders.discard(replica_name)
+                    if not holders:
+                        del self._nodes[key]
 
     def known_keys(self, replica_name):
         """Snapshot of the chain keys mirrored for one replica."""
         with self._lock:
-            return frozenset(self._keys.get(replica_name, ()))
+            return frozenset(self._by_replica.get(replica_name, ()))
 
     # ---- scoring -------------------------------------------------------------
     def overlap(self, replica_name, chain_keys):
@@ -85,15 +108,28 @@ class PrefixAffinityRouter:
         replica's index.  Contiguity matters: chain key i is only reusable
         when pages 0..i-1 are too, exactly like the engine's admission walk."""
         with self._lock:
-            keys = self._keys.get(replica_name)
-        if not keys:
-            return 0
-        n = 0
-        for k in chain_keys:
-            if k not in keys:
-                break
-            n += 1
-        return n
+            n = 0
+            for k in chain_keys:
+                holders = self._nodes.get(k)
+                if holders is None or replica_name not in holders:
+                    break
+                n += 1
+            return n
+
+    def _overlaps(self, chain_keys, names):
+        """One walk down the request's chain: at each node, replicas not
+        holding it drop out, and survivors' overlap deepens.  Early exit
+        when nobody survives — O(prompt pages), not O(replicas × pages)."""
+        overlaps = dict.fromkeys(names, 0)
+        with self._lock:
+            alive = set(names)
+            for k in chain_keys:
+                alive &= self._nodes.get(k, frozenset())
+                if not alive:
+                    break
+                for name in alive:
+                    overlaps[name] += 1
+        return overlaps
 
     def route(self, prompt_ids, replicas):
         """Pick a replica for ``prompt_ids`` among ``replicas`` (objects with
@@ -102,9 +138,9 @@ class PrefixAffinityRouter:
         if not replicas:
             raise ValueError("no replicas to route to")
         chain = prefix_page_keys(prompt_ids, self.page)
+        overlaps = self._overlaps(chain, [r.name for r in replicas])
         scored = sorted(
-            ((-self.overlap(r.name, chain), r.load(), r.name, r)
-             for r in replicas),
+            ((-overlaps[r.name], r.load(), r.name, r) for r in replicas),
             key=lambda t: t[:3])
         neg_overlap, _, _, best = scored[0]
         if neg_overlap < 0:

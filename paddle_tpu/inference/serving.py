@@ -6,1796 +6,47 @@ encoder/decoder split there is seq_lens_encoder vs seq_lens_decoder,
 python/paddle/incubate/nn/functional/block_multihead_attention.py:33, and
 sampling is in-op via phi top_p_sampling).
 
-TPU-native design:
-- TWO jitted programs serve the whole engine:
-  * a PREFILL step consuming a CHUNK of prompt tokens for one slot per
-    dispatch (chunk rows ride the paged-attention kernel's batch dim with
-    per-row context lengths, so causal masking falls out of ctx=pos+1), and
-  * a DECODE step feeding every in-flight slot its last token — token-level
-    continuous batching (Orca-style).
-  A P-token prompt costs ceil(P/chunk) dispatches before its first token,
-  not P (the r3 engine fed one prompt token per dispatch).
-- Sampling happens IN-GRAPH with per-slot parameters (greedy / temperature /
-  top-k / top-p / seed), replicating models.llama._sample token-for-token so
-  an engine decode with the same seed matches model.generate.
+The implementation lives in :mod:`paddle_tpu.inference.engine` — the
+monolith split into ``request`` / ``pages`` / ``runner`` / ``spec`` /
+``scheduler`` / ``core`` / ``disagg`` along the scheduler–pool–runner
+interfaces (see that package's docstring for the layering).  This module
+is the stable import surface: everything historically imported from
+``paddle_tpu.inference.serving`` keeps resolving here.
+
+TPU-native design (details in the engine modules):
+- TWO jitted programs serve the whole engine: a chunked PREFILL step and a
+  token-level continuous-batching DECODE step (Orca-style); sampling is
+  in-graph with per-slot parameters, matching ``model.generate``
+  token-for-token at equal seed.
 - KV lives in PAGES [L, n_pages, page, KVH, D] with host-managed per-slot
-  page tables. Pages are allocated ON DEMAND: admit reserves only the
-  prompt's pages and decode grows by one page at boundary crossings, so a
-  `page_pool` SMALLER than the worst case (the HBM budget knob)
-  oversubscribes safely — when the pool runs dry the youngest slot is
-  preempted back to the waiting queue (vLLM-style recompute).
-- AUTOMATIC PREFIX CACHING (`prefix_cache=True`): every FULL prompt page is
-  hashed by its prefix chain (key_i = H(key_{i-1}, page_i tokens) — the
-  radix-trie lookup collapsed to a chain-hash dict, SGLang-style), physical
-  pages are REFCOUNTED so several slots map the same page, and admission
-  skips prefill over every fully-cached page (`req.pos` jumps ahead; only
-  the tail chunk dispatches). A slot writing into a page another slot still
-  maps gets a COPY-ON-WRITE private page first; released pages whose
-  content is cached stay resident in an LRU and are reclaimed (evicted)
-  only when the free list runs dry, so preemption stays the last resort.
-  Cached KV is bit-identical to what recomputation would write (same
-  program, same absolute RoPE positions), so hits change dispatch counts,
-  never tokens.
-- Weights are extracted from the model once, stacked [L, ...] and placed
-  with NamedShardings: layers sharded over the pp axis, head/ffn dims over
-  the mp axis. GSPMD inserts the collectives.
+  page tables, on-demand growth, and youngest-slot preemption-recompute
+  when the pool runs dry (vLLM-style).
+- AUTOMATIC PREFIX CACHING (``prefix_cache=True``): chain-hashed full
+  prompt pages, refcounted sharing, copy-on-write, LRU reclaim — cached KV
+  is bit-identical to recomputation, so hits change dispatch counts, never
+  tokens.
+- Weights are stacked [L, ...] and placed with NamedShardings (layers over
+  pp, head/ffn dims over mp); GSPMD inserts the collectives.
+- DISAGGREGATED PREFILL/DECODE (:class:`DisaggEngine`): the two phases on
+  separate mesh slices with KV-page handoff, so decode token cadence never
+  stalls behind a prompt.
 """
 from __future__ import annotations
 
-import enum
-import math
-import time
-from collections import OrderedDict, deque
-
-import numpy as np
-import jax
-import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
-
-from .. import observability as _obs
-from ..core.retry import RetryError, RetryPolicy, retry_call
-from ..testing.faults import FAULTS as _faults
-
-__all__ = ["LLMEngine", "Request", "RequestStatus", "SpecConfig",
-           "prefix_page_keys"]
-
-_MAXK = 64        # static cap for per-slot dynamic top-k filtering
-
-
-def prefix_page_keys(tokens, page_size):
-    """Chain key per FULL page: key_i = hash(key_{i-1}, page_i tokens).
-
-    The prefix-cache radix lookup collapsed to one dict probe per page — a
-    page is shareable only as the tail of an identical-from-position-0
-    prefix (RoPE bakes absolute positions into cached K, so content alone
-    is not enough).  Public because the serving front door computes the
-    SAME keys to route a request to the replica whose cache already holds
-    its prefix (frontend/router.py); the engine's own radix index uses
-    this function too, so router affinity and engine hits can never
-    disagree on hashing."""
-    page_size = int(page_size)
-    keys, h = [], None
-    for i in range(0, (len(tokens) // page_size) * page_size, page_size):
-        h = hash((h,) + tuple(int(t) for t in tokens[i:i + page_size]))
-        keys.append(h)
-    return keys
-
-
-class RequestStatus(enum.Enum):
-    """Request lifecycle. Exactly one terminal status per request:
-
-    FINISHED   max_new_tokens (or engine max_len) reached
-    EOS        the eos token was sampled
-    TIMEOUT    deadline expired (waiting: shed unserved; mid-decode: the
-               partial output is kept and the slot finalized cleanly)
-    CANCELLED  ``cancel(rid)`` — pages released through the refcounts
-    SHED       admission control refused the request at add_request
-    FAILED     quarantined by step-failure isolation (``Request.error`` holds
-               the underlying exception text)
-    """
-    QUEUED = "queued"
-    RUNNING = "running"
-    FINISHED = "finished"
-    EOS = "eos"
-    TIMEOUT = "timeout"
-    CANCELLED = "cancelled"
-    SHED = "shed"
-    FAILED = "failed"
-
-    @property
-    def terminal(self):
-        return self not in (RequestStatus.QUEUED, RequestStatus.RUNNING)
-
-
-_TERMINAL = tuple(s for s in RequestStatus if s.terminal)
-
-
-class _EngineMetrics:
-    """Registry children bound once per engine (label ``engine=<seq>``).
-
-    Every mutation is a no-op while observability is disabled, so the engine
-    attributes (cache_hits, preemptions, ...) stay the always-on source of
-    truth and the registry mirrors them 1:1 whenever metrics are on — the
-    parity :meth:`LLMEngine.prefix_cache_stats` keeps by construction."""
-
-    def __init__(self, label):
-        e = {"engine": label}
-        self.label = label
-        self.ttft = _obs.SERVING_TTFT.labels(**e)
-        self.token_latency = _obs.SERVING_TOKEN_LATENCY.labels(**e)
-        self.queue_depth = _obs.SERVING_QUEUE_DEPTH.labels(**e)
-        self.active_slots = _obs.SERVING_ACTIVE_SLOTS.labels(**e)
-        self.occupancy = _obs.SERVING_OCCUPANCY.labels(**e)
-        self.prefill = _obs.SERVING_DISPATCHES.labels(kind="prefill", **e)
-        self.decode = _obs.SERVING_DISPATCHES.labels(kind="decode", **e)
-        self.tokens = _obs.SERVING_TOKENS.labels(**e)
-        self.preempt = _obs.SERVING_PREEMPTIONS.labels(**e)
-        self.hits = _obs.SERVING_CACHE_EVENTS.labels(event="hit", **e)
-        self.misses = _obs.SERVING_CACHE_EVENTS.labels(event="miss", **e)
-        self.evictions = _obs.SERVING_CACHE_EVENTS.labels(event="eviction",
-                                                          **e)
-        self.cow = _obs.SERVING_CACHE_EVENTS.labels(event="cow_copy", **e)
-        self.cached_pages = _obs.SERVING_CACHED_PAGES.labels(**e)
-        self.reclaimable = _obs.SERVING_RECLAIMABLE_PAGES.labels(**e)
-        self.free_pages = _obs.SERVING_FREE_PAGES.labels(**e)
-        self.verify = _obs.SERVING_DISPATCHES.labels(kind="verify", **e)
-        self.spec_proposed = _obs.SERVING_SPEC_PROPOSED.labels(**e)
-        self.spec_accepted = _obs.SERVING_SPEC_ACCEPTED.labels(**e)
-        self.spec_acceptance = _obs.SERVING_SPEC_ACCEPTANCE.labels(**e)
-        self.terminal = {s: _obs.SERVING_TERMINALS.labels(status=s.value, **e)
-                         for s in _TERMINAL}
-        self.step_fail = {ph: _obs.SERVING_STEP_FAILURES.labels(phase=ph, **e)
-                          for ph in ("prefill", "decode", "verify")}
-        self.probes = _obs.SERVING_QUARANTINE_PROBES.labels(**e)
-
-
-class Request:
-    def __init__(self, rid, prompt_ids, max_new_tokens, eos_token_id=None,
-                 do_sample=False, temperature=1.0, top_p=1.0, top_k=0,
-                 seed=None, deadline=None):
-        self.rid = rid
-        self.prompt = list(int(t) for t in np.asarray(prompt_ids).reshape(-1))
-        self.prompt0 = list(self.prompt)   # original; preemption re-folds
-        self.max_new = int(max_new_tokens)
-        self.eos = eos_token_id
-        self.do_sample = bool(do_sample)
-        self.temperature = float(temperature)
-        self.top_p = float(top_p)
-        self.top_k = int(top_k)
-        self.seed = seed
-        self.out: list[int] = []
-        self.pos = 0                 # prompt tokens already prefilled
-        self.slot = None
-        self.done = False
-        self.admit_seq = -1          # preemption picks the youngest
-        self.t_submit = time.perf_counter()
-        # absolute wall deadline; expiry sheds a waiting request and cleanly
-        # finalizes a decoding one (both terminal status TIMEOUT)
-        self.deadline = (None if deadline is None
-                         else self.t_submit + float(deadline))
-        self.status = RequestStatus.QUEUED
-        self.error = None            # exception text when status is FAILED
-        self.t_finish = None
-        self.ttft = None             # seconds to first generated token
-        self.prefill_dispatches = 0  # prefill programs dispatched for us
-        self.cached_tokens = 0       # prompt tokens served from prefix cache
-        self.cache_keys = ()         # chain keys of the prompt's full pages
-        self.stream_pos = 0          # tokens already handed to new_tokens()
-
-
-def _rope(x, pos, theta):
-    """neox-style RoPE at integer positions pos [B] (x [B, Hn, D])."""
-    D = x.shape[-1]
-    inv = 1.0 / (theta ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
-    freqs = pos.astype(jnp.float32)[:, None] * inv[None, :]      # [B, D/2]
-    emb = jnp.concatenate([freqs, freqs], axis=-1)               # [B, D]
-    s, c = jnp.sin(emb)[:, None, :], jnp.cos(emb)[:, None, :]
-    xf = x.astype(jnp.float32)
-    half = D // 2
-    rot = jnp.concatenate([-xf[..., half:], xf[..., :half]], axis=-1)
-    return (xf * c + rot * s).astype(x.dtype)
-
-
-def _rms(x, w, eps):
-    xf = x.astype(jnp.float32)
-    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
-    return (xf * jax.lax.rsqrt(var + eps) * w.astype(jnp.float32)).astype(
-        x.dtype)
-
-
-def _sample_row(logits, greedy, temp, topp, topk, seed):
-    """One row of in-graph sampling, replicating models.llama._sample +
-    ops.top_p_sampling (same filter order, same sort, same categorical
-    key/shape) so a SEEDED top_p<1 engine decode == model.generate.
-    (At top_p>=1.0, generate falls through to ops.multinomial on the global
-    RNG stream, which ignores the seed — no parity is possible there by
-    construction.) logits [V] f32; scalars traced."""
-    maxk = min(_MAXK, logits.shape[-1])
-    amax = jnp.argmax(logits)
-    l = logits / jnp.where(temp > 0, temp, 1.0)
-    probs = jax.nn.softmax(l)
-    # top-k (0 = off): zero everything below the k-th largest prob
-    kvals, _ = jax.lax.top_k(probs, maxk)
-    thresh = kvals[jnp.clip(topk - 1, 0, maxk - 1)]
-    probs = jnp.where((topk > 0) & (probs < thresh), 0.0, probs)
-    probs = probs / jnp.sum(probs)
-    # top-p over the full sorted vocab (ops.top_p_sampling's formulation)
-    sort_idx = jnp.argsort(-probs)
-    sorted_p = probs[sort_idx]
-    cum = jnp.cumsum(sorted_p)
-    keep = jnp.where(topp < 1.0, (cum - sorted_p) < topp, sorted_p >= 0)
-    filtered = jnp.where(keep, sorted_p, 0.0)
-    filtered = filtered / jnp.sum(filtered)
-    key = jax.random.PRNGKey(seed)
-    # [1, V] shape matches the b=1 categorical in ops.top_p_sampling, so the
-    # gumbel draw is bit-identical at equal keys
-    choice = jax.random.categorical(
-        key, jnp.log(jnp.maximum(filtered, 1e-30))[None, :], axis=-1)[0]
-    tok = sort_idx[choice]
-    return jnp.where(greedy > 0, amax, tok).astype(jnp.int32)
-
-
-def _ceil_pow2(n):
-    return 1 << max(0, int(n) - 1).bit_length() if n > 1 else 1
-
-
-class SpecConfig:
-    """Speculative-decoding knob (``LLMEngine(spec_decode=SpecConfig())``).
-
-    max_draft: most draft tokens proposed per request per verify step.
-    ngram_max / ngram_min: window bounds for the self-drafting n-gram
-        proposer — the request's current n-token suffix (longest n first)
-        is matched against its own earlier prompt+generated tokens, and
-        the tokens that followed the most recent match become the draft.
-        Free (no extra weights); wins on repetitive structure (code,
-        retrieved context, templated text).
-    draft_model: optional small LlamaForCausalLM replacing the n-gram
-        proposer — greedy continuation of the request's token history.
-    adaptive: learn the verify dispatch's cost curve t(rows) = RTT+rows*c
-        (separately from the decode-block auto-fit: a verify step consumes
-        a VARIABLE number of tokens) and pick the draft length maximizing
-        expected accepted tokens per second under the observed acceptance
-        rate; False always proposes max_draft."""
-
-    def __init__(self, max_draft=4, ngram_max=3, ngram_min=1,
-                 draft_model=None, adaptive=True):
-        if int(max_draft) < 1:
-            raise ValueError("max_draft must be >= 1")
-        if int(ngram_min) < 1 or int(ngram_max) < int(ngram_min):
-            raise ValueError("need 1 <= ngram_min <= ngram_max")
-        self.max_draft = int(max_draft)
-        self.ngram_max = int(ngram_max)
-        self.ngram_min = int(ngram_min)
-        self.draft_model = draft_model
-        self.adaptive = bool(adaptive)
-
-
-class _NgramProposer:
-    """Self-drafting proposer: find the most recent earlier occurrence of
-    the sequence's current suffix (longest n in [ngram_min, ngram_max]
-    wins) and propose the tokens that followed that occurrence."""
-
-    def __init__(self, cfg):
-        self.cfg = cfg
-
-    def propose(self, tokens, k):
-        n_tok = len(tokens)
-        hi = min(self.cfg.ngram_max, n_tok - 1)
-        for n in range(hi, self.cfg.ngram_min - 1, -1):
-            suffix = tokens[n_tok - n:]
-            for i in range(n_tok - n - 1, -1, -1):
-                if tokens[i:i + n] == suffix:
-                    cont = tokens[i + n:i + n + k]
-                    if cont:
-                        return list(cont)
-        return []
-
-
-class _DraftModelProposer:
-    """Draft-model proposer: greedy continuation from a small model. The
-    draft recomputes from the full token history each call (no persistent
-    draft KV) — drafts are short and the draft model is small, so clarity
-    beats cache bookkeeping here."""
-
-    def __init__(self, model):
-        self.model = model
-
-    def propose(self, tokens, k):
-        from .. import to_tensor
-        ids = to_tensor(np.asarray([tokens], np.int64))
-        out = self.model.generate(ids, max_new_tokens=k, do_sample=False)
-        seq = np.asarray(out._data).reshape(-1)
-        return [int(t) for t in seq[len(tokens):]]
-
-
-class _TransientStep(Exception):
-    """Private wrapper around a transient step error so :func:`retry_call`
-    retries exactly those — any non-transient error escapes the retry loop
-    unwrapped and falls through to quarantine isolation."""
-
-    def __init__(self, err):
-        super().__init__(str(err))
-        self.err = err
-
-
-class LLMEngine:
-    """Continuous-batching paged-KV engine over a LlamaForCausalLM."""
-
-    _engine_seq = 0   # observability label: one series set per engine
-
-    def __init__(self, model, mesh=None, mp_axis="mp", pp_axis="pp",
-                 max_batch=4, max_len=256, page_size=16, prefill_chunk=32,
-                 page_pool=None, decode_block=1, use_kernel=None, seed=0,
-                 kv_cache_dtype="auto", decode_block_max=32,
-                 prefix_cache=False, spec_decode=None, max_waiting=None,
-                 shed_min_free_ratio=0.0, default_deadline=None,
-                 step_retry=None, debug_refcount_audit=False):
-        """page_pool: usable KV pages (the HBM budget). Defaults to the
-        worst case (max_batch * ceil(max_len/page)); set it SMALLER to
-        oversubscribe — on-demand growth means slots only claim what they
-        use, and a dry pool preempts the youngest slot (recompute).
-
-        prefix_cache: automatic prefix caching (vLLM shared pages + CoW,
-        SGLang-style chain-hash lookup). Full prompt pages are hashed by
-        (prefix chain, page tokens) and refcounted; a later request whose
-        prompt starts with a cached page chain maps those physical pages
-        into its table and skips their prefill entirely (at least the final
-        prompt token always re-prefills — its logits sample the first output
-        token, and when that token's page is still shared the write goes
-        through a copy-on-write private page). Released-but-cached pages
-        park in an LRU and are evicted only when the free list runs dry.
-        Counters: ``cache_hits`` / ``cache_misses`` (pages, at admission),
-        ``cache_evictions``, ``cache_cow_copies`` — see
-        :meth:`prefix_cache_stats`. Token streams are byte-identical to a
-        ``prefix_cache=False`` engine at the same seeds; only dispatch
-        counts and TTFT change. (One caveat shared with generate(): a
-        do_sample request WITHOUT a fixed seed draws from the engine's
-        global seed counter, which advances once per prefill dispatch —
-        fewer dispatches shift later seedless draws. Seeded and greedy
-        requests are unaffected.)
-
-        decode_block: max decode steps fused into one dispatch (power-of-two
-        blocks are chosen per step, shrinking near max_new; eos-bearing
-        requests force 1). Raise it when dispatch latency, not throughput,
-        dominates (e.g. a remote/tunneled runtime) — or pass "auto": the
-        engine then samples wall time at two block sizes, solves the
-        dispatch model t(k) = RTT + k*c for the session's actual round-trip
-        latency and per-token device time, and picks the power-of-two block
-        where RTT costs <= ~25% of device time (re-estimated as timing
-        samples accumulate, capped at decode_block_max).
-
-        kv_cache_dtype: "auto" stores pages in the weight dtype; "int8"
-        quantizes K/V pages per-(token, kv-head) with f32 scales (reference:
-        incubate block_multihead_attention cache_*_quant_scales, dynamic
-        mode) — pages cost (D + 4)/(2*D) of bf16 bytes (~0.52 at
-        head_dim=128), so the same HBM budget holds ~2x the tokens /
-        concurrent slots.
-
-        spec_decode: a :class:`SpecConfig` enables speculative decoding —
-        each step a proposer drafts up to max_draft continuation tokens per
-        request (self-drafting n-gram suffix match by default, or a small
-        draft model) and ONE target-model forward scores the pending token
-        plus every draft at consecutive positions (multi-query paged
-        attention). Acceptance is the standard token-match rule — the
-        longest draft prefix that equals what the target would have
-        sampled — which for the deterministic proposers here is exact
-        rejection sampling, so greedy and fixed-seed sampled outputs are
-        token-identical to a spec-off engine. Accepted tokens all land in
-        one dispatch (up to max_draft+1 tokens/step); rejected drafts roll
-        their provisional KV pages back through the page-pool refcounts
-        (a partially-filled page is truncated, never shared). Steps where
-        no request has a draft fall through to the normal decode-block
-        path. Counters: :meth:`spec_stats`, plus ``spec_proposed_total`` /
-        ``spec_accepted_total`` / acceptance histogram in the registry.
-
-        Fault tolerance (see :meth:`health` for the counter snapshot):
-
-        max_waiting: admission-control queue bound — add_request beyond it
-        returns a request already terminal with status SHED (None keeps the
-        legacy unbounded queue).
-        shed_min_free_ratio: page-pressure watermark — while the backlog is
-        non-empty and (free + reclaimable) pages fall below this fraction of
-        the pool, new requests are shed.
-        default_deadline: seconds each request may spend end-to-end unless
-        add_request overrides; expiry sheds waiting requests and cleanly
-        finalizes decoding ones (status TIMEOUT, partial output kept).
-        step_retry: :class:`~paddle_tpu.core.retry.RetryPolicy` for
-        TRANSIENT step errors (an exception with a truthy ``transient``
-        attribute, e.g. an injected transient fault) — the step is retried
-        with backoff before failure isolation kicks in. Default: 3 attempts,
-        10ms base.  Non-transient step errors never crash the loop: the
-        failing dispatch is re-run one slot at a time and the slot that
-        fails alone is quarantined (terminal FAILED, pages freed through the
-        refcounts) while the rest keep serving.
-        debug_refcount_audit: run :meth:`audit_refcounts` after every step
-        and raise on any page-accounting violation (tier-1 chaos tests keep
-        this on to prove no failure path leaks pages)."""
-        cfg = model.config
-        self.cfg = cfg
-        self.max_batch = max_batch
-        self.max_len = max_len
-        self.page = page_size
-        self.chunk = int(prefill_chunk)
-        self.pages_per_slot = math.ceil(max_len / page_size)
-        if page_pool is None:
-            page_pool = max_batch * self.pages_per_slot
-        if page_pool < self.pages_per_slot:
-            raise ValueError("page_pool must cover at least one max_len "
-                             f"request ({self.pages_per_slot} pages)")
-        # +1: a trash page absorbing the (masked-out) writes of inactive slots
-        self.n_pages = int(page_pool) + 1
-        self.trash_page = self.n_pages - 1
-        self.mesh = mesh
-        L = cfg.num_hidden_layers
-        H = cfg.hidden_size
-        nh, kvh = cfg.num_attention_heads, cfg.num_key_value_heads
-        D = H // nh
-        self.nh, self.kvh, self.D = nh, kvh, D
-        if use_kernel is None:
-            use_kernel = (mesh is None and
-                          jax.devices()[0].platform in ("tpu", "axon"))
-        self.use_kernel = use_kernel
-
-        def wb(lin):        # Linear stores weight [in, out]
-            return np.asarray(lin.weight._data)
-
-        lay = model.llama.layers
-        W = {
-            "embed": np.asarray(model.llama.embed_tokens.weight._data),
-            "norm": np.asarray(model.llama.norm.weight._data),
-            "wq": np.stack([wb(l.self_attn.q_proj) for l in lay]),
-            "wk": np.stack([wb(l.self_attn.k_proj) for l in lay]),
-            "wv": np.stack([wb(l.self_attn.v_proj) for l in lay]),
-            "wo": np.stack([wb(l.self_attn.o_proj) for l in lay]),
-            "ln1": np.stack([np.asarray(l.input_layernorm.weight._data)
-                             for l in lay]),
-            "ln2": np.stack([np.asarray(
-                l.post_attention_layernorm.weight._data) for l in lay]),
-            "wg": np.stack([wb(l.mlp.gate_proj) for l in lay]),
-            "wu": np.stack([wb(l.mlp.up_proj) for l in lay]),
-            "wd": np.stack([wb(l.mlp.down_proj) for l in lay]),
-        }
-        W["head"] = (np.asarray(model.lm_head.weight._data)
-                     if model.lm_head is not None else W["embed"].T)
-        dtype = W["wq"].dtype
-        if mesh is not None:
-            pp = pp_axis if pp_axis in mesh.axis_names else None
-            mp = mp_axis if mp_axis in mesh.axis_names else None
-
-            def put(name, arr, spec):
-                return jax.device_put(jnp.asarray(arr),
-                                      NamedSharding(mesh, spec))
-            specs = {
-                "embed": P(), "norm": P(), "head": P(None, mp),
-                "wq": P(pp, None, mp), "wk": P(pp, None, mp),
-                "wv": P(pp, None, mp), "wo": P(pp, mp, None),
-                "ln1": P(pp, None), "ln2": P(pp, None),
-                "wg": P(pp, None, mp), "wu": P(pp, None, mp),
-                "wd": P(pp, mp, None),
-            }
-            self.W = {k: put(k, v, specs[k]) for k, v in W.items()}
-            cache_spec = NamedSharding(mesh, P(pp))
-        else:
-            self.W = {k: jnp.asarray(v) for k, v in W.items()}
-            cache_spec = None
-        self.kv_quant = (kv_cache_dtype == "int8")
-        page_dtype = jnp.int8 if self.kv_quant else dtype
-        kp = jnp.zeros((L, self.n_pages, page_size, kvh, D), page_dtype)
-        vp = jnp.zeros_like(kp)
-        if cache_spec is not None:
-            kp = jax.device_put(kp, cache_spec)
-            vp = jax.device_put(vp, cache_spec)
-        if self.kv_quant:
-            ks = jnp.zeros((L, self.n_pages, page_size, kvh), jnp.float32)
-            vs = jnp.zeros_like(ks)
-            if cache_spec is not None:
-                ks = jax.device_put(ks, cache_spec)
-                vs = jax.device_put(vs, cache_spec)
-            self.cache = (kp, vp, ks, vs)
-        else:
-            self.cache = (kp, vp)
-
-        # host scheduler state (trash page is never allocated)
-        self._free_pages = deque(range(self.n_pages - 1))
-        # prefix cache: refcounts + chain-hash index + reclaimable LRU.
-        # With prefix_cache=False nothing is ever hashed, so every released
-        # page goes straight back to _free_pages (legacy behavior).
-        self.prefix_cache = bool(prefix_cache)
-        # optional (event, chain_key) callback — the frontend router
-        # subscribes here to mirror this engine's radix index ("register" on
-        # page registration, "evict" on LRU reclaim) into its per-replica
-        # affinity index.  Called from inside step(); must be cheap and
-        # must not raise.
-        self.cache_event_listener = None
-        self._page_ref = np.zeros(self.n_pages, np.int64)
-        self._page_key: dict = {}          # physical page -> chain key
-        self._key_page: dict = {}          # chain key -> physical page
-        self._lru: OrderedDict = OrderedDict()  # cached, refcount==0 pages
-        self.cache_hits = 0                # pages served from cache (admit)
-        self.cache_misses = 0              # full prompt pages not cached
-        self.cache_evictions = 0           # cached pages reclaimed from LRU
-        self.cache_cow_copies = 0          # copy-on-write page copies
-        self.prefill_dispatches = 0        # total prefill programs run
-        self._copy_page_fn = None
-        self._slots: list = [None] * max_batch
-        self._slot_tables = np.zeros((max_batch, self.pages_per_slot),
-                                     np.int32)
-        self._lens = np.zeros((max_batch,), np.int32)
-        self._n_alloc = np.zeros((max_batch,), np.int32)
-        self._waiting: deque = deque()
-        self._finished: dict = {}
-        self._next_rid = 0
-        self._admit_seq = 0
-        self._seed_counter = np.int64(seed) * 1_000_003
-        self.preemptions = 0
-        self._auto_block = decode_block == "auto"
-        if self._auto_block:
-            self.decode_block = max(1, int(decode_block_max))
-            self._block_target = 1          # sample k=1 first, then k=2
-            self._block_samples: dict = {}  # k -> recent wall dts
-            self._block_n = 0               # total samples recorded
-        else:
-            self.decode_block = max(1, int(decode_block))
-        self._decode_programs: dict = {}
-        # speculative decoding (off unless spec_decode is a SpecConfig)
-        self._spec = spec_decode
-        if self._spec is not None:
-            self._proposer = (
-                _DraftModelProposer(self._spec.draft_model)
-                if self._spec.draft_model is not None
-                else _NgramProposer(self._spec))
-        self._verify_programs: dict = {}
-        self._spec_samples: dict = {}   # verify rows -> recent wall dts
-        self._spec_accept_ema = None    # EMA of per-step acceptance ratio
-        self.spec_proposed = 0          # draft tokens sent to verification
-        self.spec_accepted = 0          # draft tokens that matched
-        self.spec_emitted = 0           # tokens emitted by verify steps
-        self.spec_dispatches = 0        # verify programs dispatched
-        # fault tolerance: admission control, deadlines, failure isolation
-        self.max_waiting = None if max_waiting is None else int(max_waiting)
-        self.shed_min_free_ratio = float(shed_min_free_ratio)
-        self.default_deadline = default_deadline
-        self.debug_refcount_audit = bool(debug_refcount_audit)
-        self._step_retry = (step_retry if step_retry is not None else
-                            RetryPolicy(max_attempts=3, base_delay=0.01,
-                                        max_delay=0.25, seed=seed))
-        self._any_deadline = default_deadline is not None
-        self._step_phase = ("admit", ())
-        self.shed_requests = 0          # refused by admission control
-        self.timeouts = 0               # deadline expiries (waiting + active)
-        self.cancels = 0                # cancel(rid) that found the request
-        self.quarantined = 0            # requests isolated as FAILED
-        self.step_failures = 0          # step dispatches that raised
-        self.step_retries = 0           # transient-path retry invocations
-        self.quarantine_probes = 0      # single-slot isolation probes run
-        self._m = _EngineMetrics(str(LLMEngine._engine_seq))
-        LLMEngine._engine_seq += 1
-        self._prefill = self._build_prefill()
-
-    # ---------------------------------------------------------------- layers
-    def _layer_fn(self, page_idx, within, tables, ctx, pos, mq=None):
-        """Shared per-layer body for decode, prefill, and speculative
-        verification (they differ only in how many rows ride the batch dim
-        and where those rows' pages are). With ``mq=(B, Q)`` the flat rows
-        are B sequences x Q consecutive query positions and attention goes
-        through the multi-query kernel (tables [B, S]; ctx [B] is row 0's
-        context length, row j sees ctx+j); KV writes stay per-flat-row."""
-        nh, kvh, D = self.nh, self.kvh, self.D
-        eps = self.cfg.rms_norm_eps
-        theta = self.cfg.rope_theta
-        use_kernel = self.use_kernel
-
-        quant = self.kv_quant
-
-        def layer(carry, wl):
-            from ..ops.pallas.paged_attention import (
-                paged_attention, paged_attention_multiquery,
-                paged_attention_multiquery_ref, paged_attention_ref,
-                quantize_kv)
-            x, = carry
-            h = _rms(x, wl["ln1"], eps)
-            q = (h @ wl["wq"]).reshape(-1, nh, D)
-            k = (h @ wl["wk"]).reshape(-1, kvh, D)
-            v = (h @ wl["wv"]).reshape(-1, kvh, D)
-            q = _rope(q, pos, theta)
-            k = _rope(k, pos, theta)
-            if mq is None:
-                attn = paged_attention if use_kernel else paged_attention_ref
-            else:
-                Bq, Q = mq
-                base = (paged_attention_multiquery if use_kernel
-                        else paged_attention_multiquery_ref)
-
-                def attn(qx, kp, vp, tb, cl, **kw):
-                    out = base(qx.reshape(Bq, Q, nh, D), kp, vp, tb, cl,
-                               **kw)
-                    return out.reshape(Bq * Q, nh, D)
-            if quant:
-                kq, ksc = quantize_kv(k)
-                vq, vsc = quantize_kv(v)
-                kpl = wl["kp"].at[page_idx, within].set(kq)
-                vpl = wl["vp"].at[page_idx, within].set(vq)
-                ksl = wl["kps"].at[page_idx, within].set(ksc)
-                vsl = wl["vps"].at[page_idx, within].set(vsc)
-                att = attn(q, kpl, vpl, tables, ctx,
-                           k_scales=ksl, v_scales=vsl)
-                new_cache = (kpl, vpl, ksl, vsl)
-            else:
-                kpl = wl["kp"].at[page_idx, within].set(k)
-                vpl = wl["vp"].at[page_idx, within].set(v)
-                att = attn(q, kpl, vpl, tables, ctx)
-                new_cache = (kpl, vpl)
-            x = x + att.reshape(-1, nh * D) @ wl["wo"]
-            h = _rms(x, wl["ln2"], eps)
-            gate = h @ wl["wg"]
-            up = h @ wl["wu"]
-            x = x + (jax.nn.silu(gate.astype(jnp.float32)).astype(
-                up.dtype) * up) @ wl["wd"]
-            return (x,), new_cache
-
-        return layer
-
-    def _scan_layers(self, W, cache, x, layer):
-        per_layer = {k: W[k] for k in
-                     ("wq", "wk", "wv", "wo", "ln1", "ln2",
-                      "wg", "wu", "wd")}
-        per_layer["kp"], per_layer["vp"] = cache[0], cache[1]
-        if len(cache) == 4:
-            per_layer["kps"], per_layer["vps"] = cache[2], cache[3]
-        (x,), new_cache = jax.lax.scan(layer, (x,), per_layer)
-        return x, new_cache
-
-    # ------------------------------------------------------------------ step
-    def _build_decode(self, K):
-        """K decode steps fused into ONE dispatch (token feedback stays
-        in-graph via lax.scan) — through a remote dispatch path each host
-        round trip costs RTT, which a per-token loop pays in full; a K-block
-        pays RTT/K. The host sees the K sampled tokens afterwards, so eos
-        requests cap K at 1 (every token must be inspected). Mirrors
-        generate()'s tokens_per_dispatch."""
-        cfg = self.cfg
-        page = self.page
-        eps = cfg.rms_norm_eps
-        trash = self.trash_page
-
-        def block(W, cache, tokens, lens, tables, active,
-                  greedy, temp, topp, topk, seeds, fold):
-            # tokens [B] int32; lens [B] tokens already cached; tables
-            # [B, S] page ids; active [B] 0/1; sampling params [B].
-            # fold [B]: 1 -> vary the sampling key per block step (seedless
-            # requests); 0 -> reuse it (fixed-seed generate parity).
-            def one(carry, i):
-                tokens, lens, cache = carry
-                x = W["embed"][tokens]                   # [B, H]
-                pos = lens.astype(jnp.int32)
-                page_idx = jnp.take_along_axis(
-                    tables, (pos // page)[:, None], axis=1)[:, 0]
-                # inactive slots write into the trash page, never a live one
-                page_idx = jnp.where(active > 0, page_idx, trash)
-                within = pos % page
-                ctx = jnp.where(active > 0, pos + 1, 1).astype(jnp.int32)
-                layer = self._layer_fn(page_idx, within, tables, ctx, pos)
-                x, cache = self._scan_layers(W, cache, x, layer)
-                h = _rms(x, W["norm"], eps)
-                logits = h.astype(jnp.float32) @ W["head"].astype(
-                    jnp.float32)
-                # one vmapped sampler, not B inlined sort/cumsum subgraphs
-                nxt = jax.vmap(_sample_row)(logits, greedy, temp, topp,
-                                            topk, seeds + i * fold)
-                tokens = jnp.where(active > 0, nxt, tokens)
-                lens = lens + (active > 0).astype(lens.dtype)
-                return (tokens, lens, cache), nxt
-
-            (_, _, cache2), toks = jax.lax.scan(
-                one, (tokens, lens, cache),
-                jnp.arange(K, dtype=jnp.int32))
-            return toks, cache2                          # toks [K, B]
-
-        return jax.jit(block, donate_argnums=(1,))
-
-    def _build_prefill(self):
-        cfg = self.cfg
-        page = self.page
-        eps = cfg.rms_norm_eps
-        trash = self.trash_page
-        C = self.chunk
-
-        def prefill(W, cache, tokens, start, table, n_valid,
-                    greedy, temp, topp, topk, seed):
-            # tokens [C] int32 (one slot's prompt chunk, zero-padded);
-            # start scalar; table [S]; n_valid scalar <= C. Chunk rows ride
-            # the paged-attention BATCH dim: row i gets ctx = start+i+1, so
-            # in-chunk causality and attention to the already-cached prefix
-            # both fall out of the per-row context length.
-            x = W["embed"][tokens]                       # [C, H]
-            offs = jnp.arange(C, dtype=jnp.int32)
-            pos = start.astype(jnp.int32) + offs
-            valid = offs < n_valid
-            page_idx = table[pos // page]
-            page_idx = jnp.where(valid, page_idx, trash)
-            within = pos % page
-            ctx = jnp.where(valid, pos + 1, 1).astype(jnp.int32)
-            tables = jnp.broadcast_to(table[None, :], (C, table.shape[0]))
-            layer = self._layer_fn(page_idx, within, tables, ctx, pos)
-            x, cache2 = self._scan_layers(W, cache, x, layer)
-            h = _rms(x, W["norm"], eps)
-            last = h[jnp.maximum(n_valid - 1, 0)]
-            logits = last.astype(jnp.float32) @ W["head"].astype(jnp.float32)
-            nxt = _sample_row(logits, greedy, temp, topp, topk, seed)
-            return nxt, cache2
-
-        return jax.jit(prefill, donate_argnums=(1,))
-
-    def _build_verify(self, Kv):
-        """ONE forward scoring Kv consecutive positions per request — the
-        speculative-decoding verifier. Row 0 carries the pending token
-        (what plain decode would feed), rows 1..n the proposed drafts;
-        sampling row j yields the target model's token AFTER draft j, so
-        the host accepts the longest draft prefix matching the sampled
-        tokens and emits accepted+1 tokens from a single dispatch. All Kv
-        KV writes land in-graph; the host rolls back pages past the
-        accepted point afterwards (attention masks by context length, so
-        stale writes beyond a slot's length are never attended)."""
-        cfg = self.cfg
-        page = self.page
-        eps = cfg.rms_norm_eps
-        trash = self.trash_page
-        B = self.max_batch
-
-        def verify(W, cache, tokens, lens, tables, n_rows,
-                   greedy, temp, topp, topk, seeds, fold):
-            # tokens [B, Kv] int32 (row 0 = pending, 1.. = drafts, rest
-            # padding); lens [B] tokens already cached; n_rows [B] valid
-            # rows (0 = inactive slot); sampling params [B] as in decode.
-            row_j = jnp.tile(jnp.arange(Kv, dtype=jnp.int32), B)  # [B*Kv]
-
-            def rep(a):
-                return jnp.repeat(a, Kv)
-
-            pos = rep(lens.astype(jnp.int32)) + row_j
-            valid = row_j < rep(n_rows)
-            page_idx = jnp.take_along_axis(
-                tables, (pos // page).reshape(B, Kv), axis=1).reshape(-1)
-            page_idx = jnp.where(valid, page_idx, trash)
-            within = pos % page
-            # row 0 of an active request sees lens+1 tokens (its own write
-            # included); the multi-query kernel extends by +j per row
-            cl = jnp.where(n_rows > 0, lens + 1, 1).astype(jnp.int32)
-            x = W["embed"][tokens.reshape(-1)]            # [B*Kv, H]
-            layer = self._layer_fn(page_idx, within, tables, cl, pos,
-                                   mq=(B, Kv))
-            x, cache2 = self._scan_layers(W, cache, x, layer)
-            h = _rms(x, W["norm"], eps)
-            logits = h.astype(jnp.float32) @ W["head"].astype(jnp.float32)
-            # seed schedule mirrors the decode block's `seeds + i*fold`:
-            # emitted token #j of this step draws the key step #j of a
-            # non-speculative block would have drawn, so fixed-seed
-            # (fold=0) and greedy requests stay token-exact vs spec-off
-            seeds_rep = rep(seeds) + row_j * rep(fold)
-            toks = jax.vmap(_sample_row)(
-                logits, rep(greedy), rep(temp), rep(topp), rep(topk),
-                seeds_rep)
-            return toks.reshape(B, Kv), cache2
-
-        return jax.jit(verify, donate_argnums=(1,))
-
-    # ------------------------------------------------------------- scheduling
-    def add_request(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
-                    do_sample=False, temperature=1.0, top_p=1.0, top_k=0,
-                    seed=None, deadline=None):
-        """Submit a request; returns its rid.  ``deadline`` (seconds,
-        default ``default_deadline``) bounds its total wall time.  Admission
-        control may refuse it: the rid is still returned, but the request is
-        already terminal with :attr:`RequestStatus.SHED` (check
-        :meth:`status`) — malformed arguments still raise."""
-        n_prompt = int(np.asarray(prompt_ids).reshape(-1).shape[0])
-        if n_prompt == 0:
-            raise ValueError("empty prompt")
-        if int(max_new_tokens) < 1:
-            raise ValueError("max_new_tokens must be >= 1")
-        if n_prompt + int(max_new_tokens) > self.max_len:
-            # admitting would silently truncate at max_len (ADVICE r3): the
-            # caller must choose — raise max_len or shrink the request
-            raise ValueError(
-                f"prompt ({n_prompt}) + max_new_tokens ({max_new_tokens}) "
-                f"> engine max_len ({self.max_len})")
-        vocab = self.cfg.vocab_size
-        if int(top_k) > min(_MAXK, vocab):
-            raise ValueError(
-                f"top_k={top_k} exceeds the engine's in-graph cap "
-                f"{min(_MAXK, vocab)} (static top-k window)")
-        if deadline is None:
-            deadline = self.default_deadline
-        r = Request(self._next_rid, prompt_ids, max_new_tokens, eos_token_id,
-                    do_sample=do_sample, temperature=temperature,
-                    top_p=top_p, top_k=top_k, seed=seed, deadline=deadline)
-        self._next_rid += 1
-        if deadline is not None:
-            self._any_deadline = True
-        if self._should_shed():
-            self._finalize(r, RequestStatus.SHED)
-        else:
-            self._waiting.append(r)
-        return r.rid
-
-    # ----------------------------------------------------- request lifecycle
-    def _should_shed(self):
-        """Watermark admission control over the same gauges metrics()
-        exports: a bounded waiting queue, plus a page-pressure floor that
-        sheds while a backlog already exists (an idle engine always admits —
-        a single fresh request can still run via preemption)."""
-        if self.max_waiting is not None \
-                and len(self._waiting) >= self.max_waiting:
-            return True
-        if self.shed_min_free_ratio > 0.0 and self._waiting:
-            avail = len(self._free_pages) + len(self._lru)
-            if avail < self.shed_min_free_ratio * (self.n_pages - 1):
-                return True
-        return False
-
-    def _finalize(self, r, status, error=None):
-        """Move ``r`` to its typed terminal status (the ONLY path into
-        ``_finished``), mirroring the terminal counters."""
-        r.status = status
-        r.done = True
-        r.slot = None
-        if error is not None:
-            r.error = f"{type(error).__name__}: {error}"
-        r.t_finish = time.perf_counter()
-        self._finished[r.rid] = r
-        if status is RequestStatus.SHED:
-            self.shed_requests += 1
-        elif status is RequestStatus.TIMEOUT:
-            self.timeouts += 1
-        elif status is RequestStatus.CANCELLED:
-            self.cancels += 1
-        elif status is RequestStatus.FAILED:
-            self.quarantined += 1
-        self._m.terminal[status].inc()
-
-    def cancel(self, rid):
-        """Cancel a request wherever it is: waiting (dequeued) or mid-serve
-        (slot released — pages return through the refcount machinery, so
-        prefix-cache pages other slots share stay live).  Returns True if
-        the request was found live; False if unknown or already terminal."""
-        for i, r in enumerate(self._waiting):
-            if r.rid == rid:
-                del self._waiting[i]
-                self._finalize(r, RequestStatus.CANCELLED)
-                return True
-        for slot, r in enumerate(self._slots):
-            if r is not None and r.rid == rid:
-                self._release(slot, RequestStatus.CANCELLED)
-                return True
-        return False
-
-    def _expire_deadlines(self):
-        """Deadline sweep at step entry: expired waiting requests are shed
-        unserved; an expired in-flight request finalizes cleanly (partial
-        output kept, pages released).  Both end TIMEOUT."""
-        now = time.perf_counter()
-        if self._waiting:
-            expired = [r for r in self._waiting
-                       if r.deadline is not None and now > r.deadline]
-            if expired:
-                self._waiting = deque(r for r in self._waiting
-                                      if not (r.deadline is not None
-                                              and now > r.deadline))
-                for r in expired:
-                    self._finalize(r, RequestStatus.TIMEOUT)
-        for slot, r in enumerate(self._slots):
-            if r is not None and r.deadline is not None and now > r.deadline:
-                self._release(slot, RequestStatus.TIMEOUT)
-
-    # ------------------------------------------------------ page accounting
-    def _page_keys(self, tokens):
-        """Chain keys of ``tokens``' full pages (see
-        :func:`prefix_page_keys` — shared with the frontend router)."""
-        return prefix_page_keys(tokens, self.page)
-
-    def _ref_page(self, p):
-        self._page_ref[p] += 1
-        self._lru.pop(p, None)        # referenced again: not reclaimable
-
-    def _unref_page(self, p):
-        self._page_ref[p] -= 1
-        if self._page_ref[p] > 0:
-            return
-        if p in self._page_key:       # content cached: park reclaimable
-            self._lru[p] = None
-            self._lru.move_to_end(p)
-        else:
-            self._free_pages.append(p)
-
-    def _alloc_page(self):
-        """A writable page with refcount 1: free list first, then LRU
-        eviction of the oldest cached-but-unreferenced page. Returns None
-        when both are dry (the caller preempts — last resort)."""
-        if _faults.active and _faults.fire("serving.page_alloc") is not None:
-            return None               # injected allocation failure (dry pool)
-        if self._free_pages:
-            p = self._free_pages.popleft()
-        elif self._lru:
-            p, _ = self._lru.popitem(last=False)
-            key = self._page_key.pop(p)
-            self._key_page.pop(key, None)
-            self.cache_evictions += 1
-            self._m.evictions.inc()
-            if self.cache_event_listener is not None:
-                self.cache_event_listener("evict", key)
-        else:
-            return None
-        self._page_ref[p] = 1
-        return p
-
-    def _copy_page(self, src, dst):
-        """Device-side copy of one physical KV page (all layers, K and V,
-        int8 scales included) — the copy half of copy-on-write."""
-        if self._copy_page_fn is None:
-            def cp(cache, s, d):
-                return tuple(a.at[:, d].set(a[:, s]) for a in cache)
-            self._copy_page_fn = jax.jit(cp, donate_argnums=(0,))
-        self.cache = self._copy_page_fn(
-            self.cache, jnp.asarray(np.int32(src)), jnp.asarray(np.int32(dst)))
-        self.cache_cow_copies += 1
-        self._m.cow.inc()
-
-    def _cow_unshare(self, slot, start, n):
-        """Copy-on-write before a prefill write into [start, start+n): any
-        touched page another slot still maps (refcount > 1) gets a private
-        copy so the write can't clobber the shared prefix. Hit on exactly
-        one path: a fully-cached prompt re-prefills its final token into the
-        last shared page."""
-        for j in range(start // self.page, (start + n - 1) // self.page + 1):
-            p = int(self._slot_tables[slot, j])
-            while int(self._page_ref[p]) > 1:
-                q = self._alloc_page()
-                if q is None:
-                    # preemption may release the OTHER reference, making the
-                    # copy unnecessary — the while re-checks
-                    if not self._preempt_youngest(excluding=slot):
-                        raise RuntimeError(
-                            "page pool exhausted during copy-on-write — "
-                            "engine misconfigured (max_len vs page pool)")
-                    continue
-                self._copy_page(p, q)
-                self._page_ref[p] -= 1
-                self._slot_tables[slot, j] = q
-                if j == int(self._n_alloc[slot]) - 1:
-                    self._slot_tables[slot, j + 1:] = q   # repoint padding
-                p = q
-
-    def _register_pages(self, slot, r):
-        """Hash-register every completed full prompt page of this slot so
-        later requests can hit it. First registration wins; a page whose
-        content another physical page already serves stays private."""
-        for j in range(int(self._lens[slot]) // self.page):
-            p = int(self._slot_tables[slot, j])
-            if p in self._page_key:
-                continue                  # hit page / already registered
-            key = r.cache_keys[j]
-            if key in self._key_page:
-                continue
-            self._page_key[p] = key
-            self._key_page[key] = p
-            if self.cache_event_listener is not None:
-                self.cache_event_listener("register", key)
-
-    def _admit(self):
-        for slot in range(self.max_batch):
-            if self._slots[slot] is not None or not self._waiting:
-                continue
-            r = self._waiting[0]
-            # on-demand paging: reserve only the PROMPT's pages; decode
-            # grows page-by-page (cf. the r3 engine's worst-case
-            # prompt+max_new reservation, which gave paging no benefit)
-            need = math.ceil(len(r.prompt) / self.page)
-            keys = self._page_keys(r.prompt) if self.prefix_cache else []
-            hits = []
-            for key in keys:
-                p = self._key_page.get(key)
-                if p is None:
-                    break
-                hits.append(p)
-            # pages admission must newly claim; hit pages sitting in the LRU
-            # are about to be re-referenced, so they are NOT allocatable
-            fresh = need - len(hits)
-            avail = (len(self._free_pages) + len(self._lru)
-                     - sum(1 for p in hits if p in self._lru))
-            if avail < fresh:
-                break
-            self._waiting.popleft()
-            pages = []
-            for p in hits:                # ref hits BEFORE allocating fresh
-                self._ref_page(p)         # pages so eviction can't take them
-                pages.append(p)
-            aborted = False
-            for _ in range(fresh):
-                p = self._alloc_page()
-                if p is None:
-                    # allocation failed mid-admission (injected fault, or a
-                    # racing claim): roll the claimed pages back and requeue
-                    # the request at the front — never a half-built table
-                    for q in pages:
-                        self._unref_page(q)
-                    self._waiting.appendleft(r)
-                    aborted = True
-                    break
-                pages.append(p)
-            if aborted:
-                break
-            self._slot_tables[slot, :need] = pages
-            self._slot_tables[slot, need:] = pages[-1]
-            self._n_alloc[slot] = need
-            # skip prefill over fully-cached pages. At least the prompt's
-            # FINAL token always re-prefills: its logits sample the first
-            # output token (a 100%-cached prompt therefore re-enters its
-            # last shared page, which is the copy-on-write path).
-            skip = min(len(hits) * self.page, len(r.prompt) - 1)
-            self.cache_hits += len(hits)
-            self.cache_misses += len(keys) - len(hits)
-            self._m.hits.inc(len(hits))
-            self._m.misses.inc(len(keys) - len(hits))
-            r.cache_keys = keys
-            r.cached_tokens = skip
-            r.pos = skip
-            self._lens[slot] = skip
-            r.slot = slot
-            r.status = RequestStatus.RUNNING
-            r.admit_seq = self._admit_seq
-            self._admit_seq += 1
-            self._slots[slot] = r
-
-    def _release(self, slot, status=None, error=None):
-        """Free the slot's pages through the refcounts; ``status`` None is
-        the requeue path (preemption — the request is NOT finalized), any
-        terminal status finalizes the request."""
-        r = self._slots[slot]
-        for p in self._slot_tables[slot, :int(self._n_alloc[slot])]:
-            self._unref_page(int(p))
-        self._slots[slot] = None
-        self._lens[slot] = 0
-        self._n_alloc[slot] = 0
-        if status is not None:
-            self._finalize(r, status, error=error)
-
-    def _preempt_youngest(self, excluding):
-        """Free the youngest slot's pages, requeueing it for recompute
-        (prompt := prompt + generated so far). Returns True if one was
-        preempted."""
-        victims = [(r.admit_seq, s) for s, r in enumerate(self._slots)
-                   if r is not None and s != excluding]
-        if not victims:
-            return False
-        _, slot = max(victims)
-        r = self._slots[slot]
-        # recompute prompt = ORIGINAL prompt + everything generated so far —
-        # folding the current (possibly already-folded) prompt would
-        # duplicate earlier output on a second preemption
-        r.prompt = r.prompt0 + r.out
-        self._release(slot, status=None)
-        r.slot = None
-        r.status = RequestStatus.QUEUED
-        self._waiting.appendleft(r)
-        self.preemptions += 1
-        self._m.preempt.inc()
-        return True
-
-    def _ensure_page(self, slot, ahead=1):
-        """Grow slot's page table to cover `ahead` more tokens; preempt the
-        youngest other slot if the pool is dry."""
-        needed = (int(self._lens[slot]) + ahead + self.page - 1) // self.page
-        while int(self._n_alloc[slot]) < needed:
-            p = self._alloc_page()
-            if p is None:
-                if not self._preempt_youngest(excluding=slot):
-                    raise RuntimeError(
-                        "page pool exhausted with a single slot — engine "
-                        "misconfigured (max_len vs page pool)")
-                continue
-            na = int(self._n_alloc[slot])
-            self._slot_tables[slot, na] = p
-            self._slot_tables[slot, na + 1:] = p
-            self._n_alloc[slot] = na + 1
-
-    def _next_seed(self, r):
-        if r.seed is not None:
-            return int(r.seed)       # fixed seed: matches model.generate
-        self._seed_counter += 1
-        return int(self._seed_counter % (2 ** 31 - 1))
-
-    def _emit(self, slot, token):
-        """Record one generated token; release the slot when finished."""
-        r = self._slots[slot]
-        r.out.append(int(token))
-        self._m.tokens.inc()
-        if r.ttft is None:
-            r.ttft = time.perf_counter() - r.t_submit
-            self._m.ttft.observe(r.ttft)
-        hit_eos = (r.eos is not None and r.out[-1] == r.eos)
-        if (len(r.out) >= r.max_new or hit_eos
-                or int(self._lens[slot]) >= self.max_len):
-            self._release(slot, RequestStatus.EOS if hit_eos
-                          else RequestStatus.FINISHED)
-
-    def _prefill_chunk(self, slot):
-        r = self._slots[slot]
-        self._step_phase = ("prefill", (slot,))
-        if _faults.active:
-            _faults.raise_if("serving.step", rids=[r.rid], phase="prefill")
-        start = r.pos
-        n = min(self.chunk, len(r.prompt) - start)
-        if self.prefix_cache:
-            # about to write [start, start+n): un-share any page another
-            # slot still maps (a fully-cached prompt re-prefilling its
-            # final token into the last shared page lands here)
-            self._cow_unshare(slot, start, n)
-        toks = np.zeros((self.chunk,), np.int32)
-        toks[:n] = r.prompt[start:start + n]
-        finishes = (start + n) == len(r.prompt)
-        r.prefill_dispatches += 1
-        self.prefill_dispatches += 1
-        self._m.prefill.inc()
-        with _obs.trace_span("serving.prefill"):
-            nxt, self.cache = self._prefill(
-                self.W, self.cache, jnp.asarray(toks),
-                jnp.asarray(np.int32(start)),
-                jnp.asarray(self._slot_tables[slot]),
-                jnp.asarray(np.int32(n)),
-                jnp.asarray(np.int32(0 if r.do_sample else 1)),
-                jnp.asarray(np.float32(r.temperature)),
-                jnp.asarray(np.float32(r.top_p)),
-                jnp.asarray(np.int32(r.top_k)),
-                jnp.asarray(np.int32(self._next_seed(r))))
-        r.pos += n
-        self._lens[slot] = start + n
-        if self.prefix_cache:
-            self._register_pages(slot, r)
-        if finishes:
-            self._emit(slot, int(np.asarray(nxt)))
-
-    def step(self):
-        """One engine dispatch: a prefill chunk if any slot is mid-prompt,
-        else one decode token for every active slot. Returns #slots served.
-
-        This is the failure-isolation boundary: a step that raises never
-        kills the engine.  Transient errors (``err.transient`` truthy) are
-        retried with backoff; anything else triggers a quarantine sweep —
-        the failing dispatch is re-run one slot at a time and the slot that
-        still fails alone is finalized FAILED (pages freed), the rest keep
-        serving.  Isolation is exact for host-side failures; a fault inside
-        an already-dispatched XLA program is best-effort (the donated cache
-        buffer may be unrecoverable) — the engine still degrades per-request
-        instead of crashing the loop."""
-        if self._any_deadline:
-            self._expire_deadlines()
-        self._step_phase = ("admit", ())
-        try:
-            served = self._step_impl()
-        except Exception as e:  # noqa: BLE001 — the isolation boundary
-            served = self._survive_step_failure(e)
-        if self.debug_refcount_audit:
-            problems = self.audit_refcounts()
-            if problems:
-                raise RuntimeError("page-refcount audit failed:\n  "
-                                   + "\n  ".join(problems))
-        return served
-
-    def _step_impl(self):
-        self._admit()
-        if _obs.enabled():
-            self._refresh_gauges()
-        if _faults.active:
-            point = _faults.fire("serving.slow_step")
-            if point is not None and point.delay:
-                time.sleep(point.delay)
-        for slot, r in enumerate(self._slots):
-            if r is not None and r.pos < len(r.prompt):
-                self._prefill_chunk(slot)
-                return 1
-        live = [(s, r) for s, r in enumerate(self._slots) if r is not None]
-        if not live:
-            return 0
-        if self._spec is not None:
-            props = self._propose_drafts(live)
-            if any(props.values()):
-                return self._spec_step(live, props)
-            # no slot has a draft this step: the plain decode block below
-            # amortizes dispatch cost better than a 1-row verify would
-        # block size: largest power of two <= every slot's remaining budget,
-        # capped by decode_block (or the RTT-adapted target in auto mode);
-        # any eos request needs per-token host inspection -> 1
-        cap = self._block_target if self._auto_block else self.decode_block
-        k = min(cap, min(r.max_new - len(r.out) for _, r in live))
-        if any(r.eos is not None for _, r in live):
-            k = 1
-        k = 1 << max(0, k.bit_length() - 1)              # floor to pow2
-        active = np.zeros((self.max_batch,), np.int32)
-        tokens = np.zeros((self.max_batch,), np.int32)
-        greedy = np.ones((self.max_batch,), np.int32)
-        temp = np.ones((self.max_batch,), np.float32)
-        topp = np.ones((self.max_batch,), np.float32)
-        topk = np.zeros((self.max_batch,), np.int32)
-        seeds = np.zeros((self.max_batch,), np.int32)
-        fold = np.zeros((self.max_batch,), np.int32)
-        for slot, r in live:
-            if self._slots[slot] is not r:
-                continue        # preempted by an earlier slot's growth
-            self._ensure_page(slot, ahead=k)
-        # growth may have preempted members of `live` — drop them before
-        # building the batch (a stale entry would re-allocate pages to an
-        # empty slot and decode a request that is back in the queue)
-        live = [(s, r) for s, r in live if self._slots[s] is r]
-        if not live:
-            return 0
-        for slot, r in live:
-            active[slot] = 1
-            tokens[slot] = r.out[-1]
-            greedy[slot] = 0 if r.do_sample else 1
-            temp[slot] = r.temperature
-            topp[slot] = r.top_p
-            topk[slot] = r.top_k
-            seeds[slot] = self._next_seed(r)
-            fold[slot] = 1 if r.seed is None else 0
-        self._step_phase = ("decode", tuple(s for s, _ in live))
-        if _faults.active:
-            _faults.raise_if("serving.step", rids=[r.rid for _, r in live],
-                             phase="decode")
-        prog = self._decode_programs.get(k)
-        compile_call = prog is None
-        if compile_call:
-            prog = self._decode_programs[k] = self._build_decode(k)
-        self._m.decode.inc()
-        t0 = time.perf_counter()
-        with _obs.trace_span("serving.decode"):
-            toks, self.cache = prog(
-                self.W, self.cache, jnp.asarray(tokens),
-                jnp.asarray(self._lens), jnp.asarray(self._slot_tables),
-                jnp.asarray(active), jnp.asarray(greedy), jnp.asarray(temp),
-                jnp.asarray(topp), jnp.asarray(topk), jnp.asarray(seeds),
-                jnp.asarray(fold))
-            toks = np.asarray(toks)                      # [k, B]
-        dt = time.perf_counter() - t0
-        if self._auto_block and not compile_call:
-            # host sync above makes the wall time a true dispatch sample
-            self._record_block_sample(k, dt)
-        if not compile_call and _obs.enabled():
-            # dispatch served k tokens for each live slot; exclude the
-            # compile call so the histogram reflects steady-state latency
-            for _ in live:
-                self._m.token_latency.observe(dt / k)
-        for j in range(k):
-            for slot, r in live:
-                if self._slots[slot] is not r:           # released mid-block
-                    continue
-                self._lens[slot] += 1
-                self._emit(slot, int(toks[j, slot]))
-        return len(live)
-
-    # ----------------------------------------------------- failure isolation
-    def _survive_step_failure(self, e):
-        """Handle an exception that escaped :meth:`_step_impl`.  Transient
-        errors re-dispatch through the shared backoff policy; everything
-        else is attributed to a request and quarantined.  Returns the #slots
-        the recovery path ended up serving."""
-        phase, slots = self._step_phase
-        if phase == "admit":
-            # failed outside any dispatch — host-side bookkeeping, an
-            # engine bug rather than a poison request: surface it
-            raise e
-        self.step_failures += 1
-        self._m.step_fail[phase].inc()
-        if getattr(e, "transient", False):
-            ok, served, e = self._retry_step()
-            if ok:
-                return served
-            phase, slots = self._step_phase   # the failing retry's phase
-            if phase == "admit":
-                raise e
-        return self._isolate(phase, slots, e)
-
-    def _retry_step(self):
-        """Re-dispatch through the shared backoff policy.  Returns ``(True,
-        served, None)`` when a retry lands, ``(False, 0, err)`` when the
-        attempts run out — or a NON-transient error interrupts the retry
-        run; either way isolation takes over from whatever phase the final
-        error left in ``_step_phase``."""
-        def attempt():
-            try:
-                return self._step_impl()
-            except Exception as err:
-                if getattr(err, "transient", False):
-                    raise _TransientStep(err) from err
-                raise
-
-        def note(n, err, delay):
-            self.step_retries += 1
-
-        self.step_retries += 1        # the re-dispatch itself is a retry
-        try:
-            served = retry_call(attempt, policy=self._step_retry,
-                                retry_on=(_TransientStep,),
-                                op="serving.step", on_retry=note)
-        except RetryError as err:
-            return False, 0, err.__cause__.err
-        except Exception as err:  # noqa: BLE001 — non-transient mid-retry
-            return False, 0, err
-        return True, served, None
-
-    def _isolate(self, phase, slots, e):
-        """Quarantine the poison request(s) behind a failed dispatch: a
-        single-slot failure (prefill, or a 1-wide batch) is attributed
-        directly; a batched decode/verify failure is bisected by re-running
-        every member slot as a one-slot decode probe and quarantining
-        exactly those that still fail alone."""
-        todo = [s for s in slots if self._slots[s] is not None]
-        if len(todo) <= 1:
-            for s in todo:
-                self._quarantine(s, e)
-            return 0
-        served = 0
-        for s in todo:
-            if self._slots[s] is None:
-                continue          # released/preempted by an earlier probe
-            self.quarantine_probes += 1
-            self._m.probes.inc()
-            try:
-                self._decode_probe(s)
-                served += 1
-            except Exception as pe:  # noqa: BLE001 — probe attributes blame
-                self._quarantine(s, pe)
-        return served
-
-    def _quarantine(self, slot, err):
-        """Finalize the slot's request FAILED — the error is recorded on the
-        request, its pages return through the refcounts (shared prefix-cache
-        pages other slots map stay live) — and keep serving everyone else."""
-        self._release(slot, RequestStatus.FAILED, error=err)
-
-    def _decode_probe(self, slot):
-        """One-slot k=1 decode dispatch — the isolation probe run for each
-        member of a failed batch.  A raise here pins the failure on this
-        slot; success emits the token the probe decoded anyway, so a
-        surviving request loses no work to the sweep."""
-        r = self._slots[slot]
-        self._step_phase = ("decode", (slot,))
-        if _faults.active:
-            _faults.raise_if("serving.step", rids=[r.rid], phase="decode")
-        self._ensure_page(slot, ahead=1)
-        if self._slots[slot] is not r:
-            return                # growth preempted the probe target
-        active = np.zeros((self.max_batch,), np.int32)
-        tokens = np.zeros((self.max_batch,), np.int32)
-        greedy = np.ones((self.max_batch,), np.int32)
-        temp = np.ones((self.max_batch,), np.float32)
-        topp = np.ones((self.max_batch,), np.float32)
-        topk = np.zeros((self.max_batch,), np.int32)
-        seeds = np.zeros((self.max_batch,), np.int32)
-        fold = np.zeros((self.max_batch,), np.int32)
-        active[slot] = 1
-        tokens[slot] = r.out[-1]
-        greedy[slot] = 0 if r.do_sample else 1
-        temp[slot] = r.temperature
-        topp[slot] = r.top_p
-        topk[slot] = r.top_k
-        seeds[slot] = self._next_seed(r)
-        fold[slot] = 1 if r.seed is None else 0
-        prog = self._decode_programs.get(1)
-        if prog is None:
-            prog = self._decode_programs[1] = self._build_decode(1)
-        self._m.decode.inc()
-        with _obs.trace_span("serving.decode_probe"):
-            toks, self.cache = prog(
-                self.W, self.cache, jnp.asarray(tokens),
-                jnp.asarray(self._lens), jnp.asarray(self._slot_tables),
-                jnp.asarray(active), jnp.asarray(greedy), jnp.asarray(temp),
-                jnp.asarray(topp), jnp.asarray(topk), jnp.asarray(seeds),
-                jnp.asarray(fold))
-            toks = np.asarray(toks)
-        self._lens[slot] += 1
-        self._emit(slot, int(toks[0, slot]))
-
-    def audit_refcounts(self):
-        """Cross-check every page-accounting structure against the others;
-        returns a list of problem strings (empty means clean).  Invariants:
-        each page's refcount equals its slot-table references; free and
-        LRU-parked pages carry refcount 0 and never overlap; no page leaks
-        (refcount 0 yet neither free nor parked); LRU pages are
-        content-registered; the prefix key index is symmetric.  O(pages +
-        slots·pages_per_slot); runs after every step under
-        ``debug_refcount_audit``."""
-        problems = []
-        expected = np.zeros(self.n_pages, np.int64)
-        for slot, r in enumerate(self._slots):
-            if r is None:
-                continue
-            for j in range(int(self._n_alloc[slot])):
-                expected[int(self._slot_tables[slot, j])] += 1
-        free = [int(p) for p in self._free_pages]
-        free_set = set(free)
-        if len(free_set) != len(free):
-            problems.append("free list holds duplicate pages")
-        lru_set = {int(p) for p in self._lru}
-        both = free_set & lru_set
-        if both:
-            problems.append(f"pages both free and LRU-parked: {sorted(both)}")
-        for p in range(self.n_pages - 1):            # trash page excluded
-            refs, exp = int(self._page_ref[p]), int(expected[p])
-            if refs != exp:
-                problems.append(f"page {p}: refcount {refs} != "
-                                f"{exp} slot-table references")
-            if refs == 0 and p not in free_set and p not in lru_set:
-                problems.append(f"page {p}: leaked "
-                                "(refcount 0, neither free nor LRU-parked)")
-            if refs > 0 and (p in free_set or p in lru_set):
-                problems.append(f"page {p}: referenced but on the "
-                                "free/LRU list")
-        for p in lru_set:
-            if p not in self._page_key:
-                problems.append(f"page {p}: LRU-parked but not "
-                                "content-registered")
-        for p, key in self._page_key.items():
-            if self._key_page.get(key) != p:
-                problems.append(f"page {p}: page->key->page asymmetric")
-        for key, p in self._key_page.items():
-            if self._page_key.get(p) != key:
-                problems.append(f"page {p}: key->page->key asymmetric")
-        return problems
-
-    # ---------------------------------------------------- speculative decode
-    def _propose_drafts(self, live):
-        """Draft continuation tokens per live slot, capped so that drafts+1
-        emitted tokens can neither exceed the request's remaining budget nor
-        run past max_len."""
-        props = {}
-        target = self._spec_draft_target()
-        for slot, r in live:
-            cap = min(target, r.max_new - len(r.out) - 1,
-                      self.max_len - int(self._lens[slot]) - 1)
-            if cap < 1:
-                props[slot] = []
-                continue
-            # full token history (prompt0+out survives preemption re-folds)
-            props[slot] = self._proposer.propose(r.prompt0 + r.out, cap)[:cap]
-        return props
-
-    def _spec_step(self, live, props):
-        """One speculative step: verify every live slot's pending token plus
-        its drafts in a single multi-query dispatch, emit the accepted run,
-        roll rejected pages back. Slots without a proposal ride along with
-        one row (their pending token advances normally)."""
-        for slot, r in live:
-            if self._slots[slot] is not r:
-                continue        # preempted by an earlier slot's growth
-            self._ensure_page(slot, ahead=len(props.get(slot, ())) + 1)
-        live = [(s, r) for s, r in live if self._slots[s] is r]
-        if not live:
-            return 0
-        Kv = _ceil_pow2(max(len(props.get(s, ())) + 1 for s, _ in live))
-        tokens = np.zeros((self.max_batch, Kv), np.int32)
-        n_rows = np.zeros((self.max_batch,), np.int32)
-        greedy = np.ones((self.max_batch,), np.int32)
-        temp = np.ones((self.max_batch,), np.float32)
-        topp = np.ones((self.max_batch,), np.float32)
-        topk = np.zeros((self.max_batch,), np.int32)
-        seeds = np.zeros((self.max_batch,), np.int32)
-        fold = np.zeros((self.max_batch,), np.int32)
-        for slot, r in live:
-            drafts = props.get(slot, [])
-            n_rows[slot] = 1 + len(drafts)
-            tokens[slot, 0] = r.out[-1]
-            tokens[slot, 1:1 + len(drafts)] = drafts
-            greedy[slot] = 0 if r.do_sample else 1
-            temp[slot] = r.temperature
-            topp[slot] = r.top_p
-            topk[slot] = r.top_k
-            seeds[slot] = self._next_seed(r)
-            fold[slot] = 1 if r.seed is None else 0
-        self._step_phase = ("verify", tuple(s for s, _ in live))
-        if _faults.active:
-            _faults.raise_if("serving.step", rids=[r.rid for _, r in live],
-                             phase="verify")
-        prog = self._verify_programs.get(Kv)
-        compile_call = prog is None
-        if compile_call:
-            prog = self._verify_programs[Kv] = self._build_verify(Kv)
-        self.spec_dispatches += 1
-        self._m.verify.inc()
-        t0 = time.perf_counter()
-        with _obs.trace_span("serving.verify"):
-            toks, self.cache = prog(
-                self.W, self.cache, jnp.asarray(tokens),
-                jnp.asarray(self._lens), jnp.asarray(self._slot_tables),
-                jnp.asarray(n_rows), jnp.asarray(greedy), jnp.asarray(temp),
-                jnp.asarray(topp), jnp.asarray(topk), jnp.asarray(seeds),
-                jnp.asarray(fold))
-            toks = np.asarray(toks)                      # [B, Kv]
-        dt = time.perf_counter() - t0
-        if self._spec.adaptive and not compile_call:
-            self._record_verify_sample(Kv, dt)
-        proposed = accepted = 0
-        for slot, r in live:
-            drafts = props.get(slot, [])
-            n = len(drafts)
-            t = toks[slot]
-            # accept the longest draft prefix the target would have sampled
-            # itself: draft j+1 (fed at row j+1) survives iff it equals the
-            # token sampled from row j's logits
-            a = 0
-            while a < n and drafts[a] == int(t[a]):
-                a += 1
-            proposed += n
-            accepted += a
-            m = a + 1                                    # tokens to emit
-            for j in range(m):
-                if self._slots[slot] is not r:
-                    break        # eos / max_new released the slot mid-run
-                self._lens[slot] += 1
-                self._emit(slot, int(t[j]))
-                self.spec_emitted += 1
-            if self._slots[slot] is r:
-                # roll back KV pages provisioned for rejected drafts
-                self._truncate_pages(slot)
-            if not compile_call and _obs.enabled():
-                self._m.token_latency.observe(dt / m)
-        self.spec_proposed += proposed
-        self.spec_accepted += accepted
-        self._m.spec_proposed.inc(proposed)
-        self._m.spec_accepted.inc(accepted)
-        if proposed:
-            ratio = accepted / proposed
-            self._m.spec_acceptance.observe(ratio)
-            self._spec_accept_ema = (
-                ratio if self._spec_accept_ema is None
-                else 0.9 * self._spec_accept_ema + 0.1 * ratio)
-        return len(live)
-
-    def _truncate_pages(self, slot):
-        """Free pages past ceil(lens/page) back to the pool — the rollback
-        half of speculative decoding. Safe by construction: pages past the
-        prompt are always privately allocated (refcount 1) and never
-        registered in the prefix index, so a partially-filled page is
-        truncated, never shared; the stale KV beyond lens is unreachable
-        because attention masks by context length."""
-        lens = int(self._lens[slot])
-        needed = max(1, (lens + self.page - 1) // self.page)
-        na = int(self._n_alloc[slot])
-        if na <= needed:
-            return
-        for j in range(needed, na):
-            self._unref_page(int(self._slot_tables[slot, j]))
-        self._slot_tables[slot, needed:] = self._slot_tables[slot, needed - 1]
-        self._n_alloc[slot] = needed
-
-    def _record_verify_sample(self, rows, wall_dt):
-        samples = self._spec_samples.setdefault(rows, [])
-        samples.append(wall_dt)
-        del samples[:-8]
-
-    def _spec_draft_target(self):
-        """Draft length maximizing expected emitted tokens per second,
-        E(k) / t(rows(k)), from the verify step's OWN cost fit (decode
-        blocks consume exactly k tokens; a verify step consumes a variable
-        1..k+1, so it gets a separate t(rows) = RTT + rows*c model) and the
-        acceptance-rate EMA: E(k) = 1 + a + a^2 + ... + a^k."""
-        cfg = self._spec
-        if not cfg.adaptive:
-            return cfg.max_draft
-        sampled = {kk: sorted(v)[len(v) // 2]
-                   for kk, v in self._spec_samples.items() if v}
-        if len(sampled) < 2:
-            return cfg.max_draft      # not solvable yet: be optimistic
-        ks = sorted(sampled)
-        c, rtt = np.polyfit(np.asarray(ks, np.float64),
-                            np.asarray([sampled[kk] for kk in ks],
-                                       np.float64), 1)
-        if c <= 0 or rtt < 0:
-            return cfg.max_draft
-        alpha = min(0.99, max(0.0, self._spec_accept_ema
-                              if self._spec_accept_ema is not None else 0.5))
-        best_k, best_rate = 1, -1.0
-        for k in range(1, cfg.max_draft + 1):
-            e = (k + 1 if alpha == 1.0
-                 else (1 - alpha ** (k + 1)) / (1 - alpha))
-            rate = e / (rtt + _ceil_pow2(k + 1) * c)
-            if rate > best_rate:
-                best_rate, best_k = rate, k
-        return best_k
-
-    def spec_stats(self):
-        """Always-on speculative-decoding counters (zero when the
-        ``spec_decode`` knob is off). ``tokens_per_step`` is tokens emitted
-        per VERIFY dispatch — the speculative speedup factor (> 1.0 means
-        drafts are being accepted); the registry mirrors proposed/accepted
-        as ``serving_spec_*_total`` plus the acceptance histogram."""
-        return {
-            "proposed": self.spec_proposed,
-            "accepted": self.spec_accepted,
-            "emitted": self.spec_emitted,
-            "verify_dispatches": self.spec_dispatches,
-            "acceptance_rate": (self.spec_accepted / self.spec_proposed
-                                if self.spec_proposed else 0.0),
-            "tokens_per_step": (self.spec_emitted / self.spec_dispatches
-                                if self.spec_dispatches else 0.0),
-            "draft_target": (self._spec_draft_target()
-                             if self._spec is not None else 0),
-        }
-
-    def _record_block_sample(self, k, wall_dt):
-        """Auto decode-block: least-squares fit of t(k) = RTT + k*c over
-        the per-size medians of EVERY sampled block size, targeting the
-        power-of-two k where per-dispatch constant costs <= ~25% of device
-        time (k >= 3*RTT/c). Fitting all sizes (instead of the two
-        earliest medians) lets late samples at large k keep correcting the
-        model, and every 64th sample the target drops back to a small k
-        for one dispatch so the intercept estimate can't go stale."""
-        samples = self._block_samples.setdefault(k, [])
-        samples.append(wall_dt)
-        del samples[:-8]
-        self._block_n += 1
-        sampled = {kk: sorted(v)[len(v) // 2]
-                   for kk, v in self._block_samples.items() if v}
-        if len(sampled) < 2:
-            # force a second sample size next step so the model is solvable
-            self._block_target = min(2, self.decode_block) \
-                if 1 in sampled else 1
-            return
-        ks = sorted(sampled)
-        c, rtt = np.polyfit(np.asarray(ks, np.float64),
-                            np.asarray([sampled[kk] for kk in ks],
-                                       np.float64), 1)
-        if c <= 0 or rtt <= 0:       # noise/local runtime: RTT negligible
-            self._block_target = min(2, self.decode_block)
-            return
-        want = max(1, int(3 * rtt / c))
-        want = 1 << (want.bit_length() - 1)              # floor to pow2
-        self._block_target = min(want, self.decode_block)
-        if self._block_n % 64 == 0:
-            # periodic small-k re-sample refreshes the RTT intercept
-            self._block_target = min(2, self.decode_block)
-
-    @property
-    def auto_decode_block(self):
-        """Current RTT-adapted block target (auto mode only)."""
-        return self._block_target if self._auto_block else self.decode_block
-
-    def run_until_done(self, max_steps=10000):
-        steps = 0
-        while (self._waiting or any(s is not None for s in self._slots)) \
-                and steps < max_steps:
-            self.step()
-            steps += 1
-        return steps
-
-    def _refresh_gauges(self):
-        """Mirror instantaneous engine state into the registry gauges."""
-        n_active = sum(1 for s in self._slots if s is not None)
-        self._m.queue_depth.set(len(self._waiting))
-        self._m.active_slots.set(n_active)
-        self._m.occupancy.set(n_active / self.max_batch)
-        self._m.cached_pages.set(len(self._key_page))
-        self._m.reclaimable.set(len(self._lru))
-        self._m.free_pages.set(len(self._free_pages))
-
-    def metrics(self):
-        """This engine's telemetry series from the process-wide registry.
-
-        Values accumulate only while ``paddle_tpu.observability.enable()``
-        is on; :meth:`prefix_cache_stats` stays the always-on plain-dict
-        view of the same counters."""
-        if _obs.enabled():
-            self._refresh_gauges()
-        return _obs.snapshot(prefix="serving_",
-                             labels={"engine": self._m.label})
-
-    def prefix_cache_stats(self):
-        """Counters for the automatic prefix cache (all zero when the
-        `prefix_cache` knob is off).
-
-        The same counters are exported through the observability registry
-        (``serving_prefix_cache_events_total{engine=...}``); this dict is
-        the always-on thin compatibility view."""
-        return {
-            "hits": self.cache_hits,
-            "misses": self.cache_misses,
-            "evictions": self.cache_evictions,
-            "cow_copies": self.cache_cow_copies,
-            "prefill_dispatches": self.prefill_dispatches,
-            "cached_pages": len(self._key_page),
-            "reclaimable_pages": len(self._lru),
-        }
-
-    def kv_bytes_per_page(self):
-        """HBM bytes one KV page costs across all layers (both K and V,
-        including int8 scales) — the unit of the page_pool budget."""
-        return sum(int(a.nbytes) for a in self.cache) // self.n_pages
-
-    def result(self, rid):
-        return self._finished[rid].out
-
-    def ttft(self, rid):
-        """Seconds from add_request to the first generated token."""
-        return self._finished[rid].ttft
-
-    def _lookup(self, rid):
-        """The live or terminal :class:`Request` for ``rid`` wherever it
-        is — waiting, in a slot, or finished.  KeyError when unknown."""
-        for r in self._waiting:
-            if r.rid == rid:
-                return r
-        for r in self._slots:
-            if r is not None and r.rid == rid:
-                return r
-        return self._finished[rid]
-
-    def new_tokens(self, rid):
-        """Incremental stream accessor: the tokens ``rid`` generated since
-        the previous ``new_tokens(rid)`` call (empty list when none yet).
-        Output is append-only across the whole lifecycle — preemption
-        re-folds the *prompt*, never the emitted stream — so concatenating
-        every batch reproduces :meth:`result` exactly.  This is the public
-        surface the streaming gateway reads; it never touches slot state."""
-        r = self._lookup(rid)
-        toks = [int(t) for t in r.out[r.stream_pos:]]
-        r.stream_pos += len(toks)
-        return toks
-
-    def stream(self, rid, max_steps=100000):
-        """Generator driving the engine until ``rid`` is terminal, yielding
-        its tokens one by one as they are emitted (other in-flight requests
-        keep being served by the same steps).  Single-caller convenience —
-        a multi-replica front door runs the step loop elsewhere and polls
-        :meth:`new_tokens` instead."""
-        steps = 0
-        while True:
-            yield from self.new_tokens(rid)
-            if self._lookup(rid).status.terminal:
-                return
-            if steps >= max_steps:
-                raise RuntimeError(f"stream({rid}) exceeded {max_steps} steps")
-            self.step()
-            steps += 1
-
-    def fail_all(self, error):
-        """Finalize EVERY live request (waiting and running) as FAILED with
-        ``error`` recorded — the front door calls this when a replica's
-        step loop dies, so inflight requests end with a typed terminal
-        status instead of hanging their streams forever."""
-        while self._waiting:
-            self._finalize(self._waiting.popleft(), RequestStatus.FAILED,
-                           error=error)
-        for slot, r in enumerate(self._slots):
-            if r is not None:
-                self._release(slot, RequestStatus.FAILED, error=error)
-
-    def status(self, rid):
-        """The request's :class:`RequestStatus` wherever it lives — waiting,
-        in a slot, or terminal.  KeyError for an unknown rid."""
-        return self._lookup(rid).status
-
-    def error(self, rid):
-        """The recorded ``ExceptionType: message`` string for a FAILED
-        request; None for every other terminal status."""
-        return self._finished[rid].error
-
-    def health(self):
-        """One JSON-able liveness snapshot for external monitors — plain
-        counters, available whether or not observability is enabled."""
-        n_active = sum(1 for s in self._slots if s is not None)
-        return {
-            "active_slots": n_active,
-            "max_batch": self.max_batch,
-            "waiting": len(self._waiting),
-            "finished": len(self._finished),
-            "free_pages": len(self._free_pages),
-            "reclaimable_pages": len(self._lru),
-            "total_pages": self.n_pages - 1,
-            "shed_requests": self.shed_requests,
-            "timeouts": self.timeouts,
-            "cancels": self.cancels,
-            "quarantined": self.quarantined,
-            "step_failures": self.step_failures,
-            "step_retries": self.step_retries,
-            "quarantine_probes": self.quarantine_probes,
-            "preemptions": self.preemptions,
-        }
+from .engine import (  # noqa: F401
+    DisaggEngine,
+    LLMEngine,
+    ModelRunner,
+    PagePool,
+    Request,
+    RequestStatus,
+    Scheduler,
+    SpecConfig,
+    prefix_page_keys,
+    split_mesh,
+)
+from .engine.spec import _NgramProposer  # noqa: F401  (test/bench import)
+
+__all__ = ["LLMEngine", "DisaggEngine", "split_mesh", "Request",
+           "RequestStatus", "SpecConfig", "prefix_page_keys",
+           "Scheduler", "PagePool", "ModelRunner"]

@@ -23,7 +23,10 @@ Schedules are pure functions of their own call counter (plus a seeded RNG for
 :class:`FailProb`), so a chaos test replays the exact same failure sequence
 every run.  Known points today: ``serving.page_alloc`` (allocation returns
 dry), ``serving.step`` (dispatch raises :class:`InjectedFault`),
-``serving.slow_step`` (dispatch stalls ``delay`` seconds), ``store.connect``
+``serving.slow_step`` (dispatch stalls ``delay`` seconds),
+``serving.kv_handoff`` (disaggregated prefill→decode page transfer raises
+before any page is copied, so a transient retry is idempotent; ctx has
+``rids``), ``store.connect``
 (client connect raises); in the serving front door, ``frontend.route``
 (gateway submit fails before routing), ``frontend.submit`` (fails after a
 replica is chosen; ctx has ``replica``), and ``frontend.step`` (a replica's

@@ -717,3 +717,45 @@ def test_repo_tree_is_clean(tmp_path):
     assert {"sharding-spec-coverage", "dtype-rules"} <= set(res.passes)
     assert not res.findings, "\n" + "\n".join(
         f.render() for f in res.findings)
+
+
+# ------------------------------------------- engine package layering guard
+
+def test_engine_package_has_no_import_cycles():
+    """The engine package's layering (request < pages/runner/spec <
+    scheduler < core < disagg) must stay acyclic, and ``request`` must stay
+    at the bottom importing no siblings — a cycle here means the interface
+    split regressed back toward the monolith."""
+    import ast
+
+    pkg = REPO / "paddle_tpu" / "inference" / "engine"
+    deps = {}
+    for path in sorted(pkg.glob("*.py")):
+        mod = path.stem
+        tree = ast.parse(path.read_text())
+        sibs = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.level == 1:
+                if node.module:                      # "from .x import y"
+                    sibs.add(node.module.split(".")[0])
+                else:                                # "from . import x"
+                    sibs.update(a.name for a in node.names)
+        deps[mod] = sibs - {mod}
+
+    assert deps.get("request") == set(), (
+        "engine.request must import no siblings (it is the layering floor)")
+
+    state = {}   # mod -> "visiting" | "done"
+
+    def visit(mod, stack):
+        if state.get(mod) == "done" or mod not in deps:
+            return
+        assert state.get(mod) != "visiting", (
+            f"import cycle in inference.engine: {' -> '.join(stack + [mod])}")
+        state[mod] = "visiting"
+        for dep in sorted(deps[mod]):
+            visit(dep, stack + [mod])
+        state[mod] = "done"
+
+    for mod in sorted(deps):
+        visit(mod, [])

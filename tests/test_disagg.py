@@ -1,0 +1,233 @@
+"""Disaggregated prefill/decode (ISSUE 9 tentpole): prefill and decode
+engines on separate mesh slices with KV-page handoff between their pools.
+
+Correctness bar everywhere: token-identical output vs the colocated
+:class:`LLMEngine` for greedy and fixed-seed sampled requests — the copied
+KV pages are bit-identical to what the decode slice would have computed, so
+disaggregation may change dispatch structure and latency, never tokens.
+
+The tiny 2-layer model is module-shared (engines build compiled programs);
+the cross-slice test shards it over halves of the 8-virtual-device CPU
+mesh."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.inference.serving import (DisaggEngine, LLMEngine,
+                                          RequestStatus, SpecConfig,
+                                          split_mesh)
+from paddle_tpu.testing import FAULTS, FailNth, injected
+from paddle_tpu.testing.faults import Always
+
+
+@pytest.fixture(scope="module")
+def model():
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=176,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=256)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+_KW = dict(max_batch=3, max_len=64, page_size=8, page_pool=48)
+
+
+def _prompts(n, seed=0, lo=4, step=5):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, 128, (lo + step * i,)).astype(np.int32)
+            for i in range(n)]
+
+
+def _serve(eng, prompts, **req_kw):
+    rids = [eng.add_request(p, **req_kw) for p in prompts]
+    eng.run_until_done()
+    return [eng.result(r) for r in rids]
+
+
+class TestDisaggParity:
+    def test_greedy_token_exact(self, model):
+        prompts = _prompts(4)
+        ref = _serve(LLMEngine(model, debug_refcount_audit=True, **_KW),
+                     prompts, max_new_tokens=7)
+        deng = DisaggEngine(model, debug_refcount_audit=True, **_KW)
+        got = _serve(deng, prompts, max_new_tokens=7)
+        assert got == ref
+        assert deng.handoff_stats()["handoffs"] == len(prompts)
+        assert deng.audit_refcounts() == []
+
+    def test_fixed_seed_sampling_token_exact(self, model):
+        prompts = _prompts(3, seed=1)
+        kw = dict(max_new_tokens=6, do_sample=True, temperature=0.8,
+                  top_p=0.9, top_k=20)
+        ref_eng = LLMEngine(model, **_KW)
+        ref = [ref_eng.add_request(p, seed=100 + i, **kw)
+               for i, p in enumerate(prompts)]
+        ref_eng.run_until_done()
+        deng = DisaggEngine(model, debug_refcount_audit=True, **_KW)
+        rids = [deng.add_request(p, seed=100 + i, **kw)
+                for i, p in enumerate(prompts)]
+        deng.run_until_done()
+        assert [deng.result(r) for r in rids] == \
+            [ref_eng.result(r) for r in ref]
+
+    def test_prefix_cache_on_token_exact(self, model):
+        # shared 24-token prefix: the prefill slice's cache serves the
+        # later prompts' full pages; tokens must not move
+        rng = np.random.RandomState(2)
+        base = rng.randint(1, 128, (24,)).astype(np.int32)
+        prompts = [np.concatenate([base, rng.randint(1, 128, (k,))
+                                   .astype(np.int32)]) for k in (3, 5, 7)]
+        ref_eng = LLMEngine(model, prefix_cache=True, **_KW)
+        deng = DisaggEngine(model, prefix_cache=True,
+                            debug_refcount_audit=True, **_KW)
+        # two waves: wave 2 reuses the pages wave 1 registered (wave 1's
+        # slots all admit before any key exists, so only wave 2 can hit)
+        for wave in range(2):
+            ref = _serve(ref_eng, prompts, max_new_tokens=6)
+            got = _serve(deng, prompts, max_new_tokens=6)
+            assert got == ref, wave
+        # cache hits happen on the prefill slice (that is where prompts run)
+        assert deng.prefix_cache_stats()["hits"] > 0
+        assert deng.audit_refcounts() == []
+
+    def test_spec_decode_on_token_exact(self, model):
+        # repetitive prompt so the n-gram proposer actually drafts
+        pat = np.tile(np.arange(1, 9, dtype=np.int32), 4)
+        prompts = [pat, _prompts(1, seed=3)[0]]
+        ref = _serve(LLMEngine(model, **_KW), prompts, max_new_tokens=8)
+        deng = DisaggEngine(model, spec_decode=SpecConfig(max_draft=4),
+                            debug_refcount_audit=True, **_KW)
+        got = _serve(deng, prompts, max_new_tokens=8)
+        assert got == ref
+        assert deng.spec_stats()["verify_dispatches"] >= 1
+        assert deng.audit_refcounts() == []
+
+    def test_single_token_requests_skip_handoff(self, model):
+        # max_new_tokens=1 finishes at the prefill slice's first emit:
+        # nothing to decode, nothing to hand off
+        prompts = _prompts(2, seed=4)
+        ref = _serve(LLMEngine(model, **_KW), prompts, max_new_tokens=1)
+        deng = DisaggEngine(model, debug_refcount_audit=True, **_KW)
+        got = _serve(deng, prompts, max_new_tokens=1)
+        assert got == ref
+        assert deng.handoff_stats()["handoffs"] == 0
+        assert deng.audit_refcounts() == []
+
+
+class TestDisaggMesh:
+    def test_split_mesh_halves(self):
+        import jax
+        from jax.sharding import Mesh
+        if len(jax.devices()) < 4:
+            pytest.skip("needs 4 virtual devices")
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("pp", "mp"))
+        pre, dec = split_mesh(mesh, axis="mp")
+        assert pre.axis_names == dec.axis_names == ("pp", "mp")
+        assert pre.shape["mp"] == dec.shape["mp"] == 1
+        assert not (set(pre.devices.flat) & set(dec.devices.flat))
+        with pytest.raises(ValueError):
+            split_mesh(Mesh(np.array(jax.devices()[:1]).reshape(1, 1),
+                            ("pp", "mp")))
+
+    def test_cross_slice_handoff_token_exact(self, model):
+        import jax
+        from jax.sharding import Mesh
+        if len(jax.devices()) < 4:
+            pytest.skip("needs 4 virtual devices")
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("pp", "mp"))
+        pre_mesh, dec_mesh = split_mesh(mesh, axis="mp")
+        prompts = _prompts(3, seed=5)
+        ref = _serve(LLMEngine(model, **_KW), prompts, max_new_tokens=6)
+        deng = DisaggEngine(model, prefill_mesh=pre_mesh,
+                            decode_mesh=dec_mesh,
+                            debug_refcount_audit=True, **_KW)
+        assert deng.handoff_stats()["cross_device"]
+        got = _serve(deng, prompts, max_new_tokens=6)
+        assert got == ref
+        assert deng.handoff_stats()["handoffs"] == len(prompts)
+        assert deng.audit_refcounts() == []
+
+
+class TestDisaggChaos:
+    def test_transient_handoff_faults_retried(self, model):
+        prompts = _prompts(3, seed=6)
+        ref = _serve(LLMEngine(model, **_KW), prompts, max_new_tokens=6)
+        deng = DisaggEngine(model, debug_refcount_audit=True, **_KW)
+        rids = [deng.add_request(p, max_new_tokens=6) for p in prompts]
+        with injected("serving.kv_handoff", FailNth({1, 3}),
+                      transient=True):
+            deng.run_until_done()
+        assert [deng.result(r) for r in rids] == ref
+        stats = deng.handoff_stats()
+        assert stats["retries"] >= 2 and stats["failures"] == 0
+        assert deng.audit_refcounts() == []
+
+    def test_poisoned_handoff_quarantines_only_that_request(self, model):
+        prompts = _prompts(4, seed=7)
+        ref = _serve(LLMEngine(model, **_KW), prompts, max_new_tokens=6)
+        deng = DisaggEngine(model, debug_refcount_audit=True, **_KW)
+        rids = [deng.add_request(p, max_new_tokens=6) for p in prompts]
+        poison = rids[1]
+        FAULTS.install("serving.kv_handoff", Always(),
+                       match=lambda ctx: poison in ctx.get("rids", ()))
+        try:
+            deng.run_until_done()
+        finally:
+            FAULTS.reset()
+        assert deng.status(poison) == RequestStatus.FAILED
+        assert "InjectedFault" in deng.error(poison)
+        for i in (0, 2, 3):
+            assert deng.status(rids[i]) == RequestStatus.FINISHED
+            assert deng.result(rids[i]) == ref[i], i
+        stats = deng.handoff_stats()
+        assert stats["failures"] == 1
+        assert stats["handoffs"] == len(prompts) - 1
+        # pages released on BOTH slices for the quarantined request
+        assert deng.audit_refcounts() == []
+
+
+class TestDisaggBackpressure:
+    def test_handoff_queue_stays_bounded(self, model):
+        # depth=1 and a decode side kept full: prefill must pause (no new
+        # sink appends) instead of growing the queue without bound
+        deng = DisaggEngine(model, handoff_depth=1,
+                            debug_refcount_audit=True, **_KW)
+        for p in _prompts(6, seed=8, lo=4, step=2):
+            deng.add_request(p, max_new_tokens=8)
+        steps = 0
+        while deng.has_work() and steps < 500:
+            deng.step()
+            assert len(deng._queue) <= deng.handoff_depth
+            steps += 1
+        assert not deng.has_work()
+        assert deng.handoff_stats()["handoffs"] == 6
+
+    def test_cancel_in_handoff_queue_releases_pages(self, model):
+        deng = DisaggEngine(model, handoff_depth=4,
+                            debug_refcount_audit=True, **_KW)
+        rids = [deng.add_request(p, max_new_tokens=6)
+                for p in _prompts(2, seed=9)]
+        # step until something sits in the handoff queue, then cancel it
+        steps = 0
+        while not deng._queue and steps < 200:
+            served = deng.dec.step()
+            if len(deng._queue) < deng.handoff_depth:
+                served += deng.pre.step()
+            steps += 1
+        if deng._queue:
+            rid = deng._queue[0].r.rid
+            assert deng.cancel(rid)
+            assert deng.status(rid) == RequestStatus.CANCELLED
+        deng.run_until_done()
+        assert deng.audit_refcounts() == []
+
+    def test_tpot_reported_after_finish(self, model):
+        deng = DisaggEngine(model, **_KW)
+        [rid] = [deng.add_request(_prompts(1, seed=10)[0],
+                                  max_new_tokens=6)]
+        deng.run_until_done()
+        assert deng.ttft(rid) is not None
+        assert deng.tpot(rid) is not None and deng.tpot(rid) >= 0.0

@@ -140,6 +140,24 @@ class TestPrefixAffinityRouter:
         with pytest.raises(ValueError):
             PrefixAffinityRouter(8).route([1, 2], [])
 
+    def test_node_index_shared_across_replicas(self):
+        # the radix node index maps each chain key to its holder set: one
+        # walk scores every replica, and a node with no holders left is
+        # dropped from the index entirely
+        r = PrefixAffinityRouter(page_size=8)
+        prompt = list(range(24))
+        self._register_prefix(r, "a", prompt)
+        self._register_prefix(r, "b", prompt[:16])
+        keys = prefix_page_keys(prompt, 8)
+        assert r._nodes[keys[0]] == {"a", "b"}
+        assert r._nodes[keys[2]] == {"a"}
+        overlaps = r._overlaps(keys, ["a", "b", "c"])
+        assert overlaps == {"a": 3, "b": 2, "c": 0}
+        r.note_event("b", "evict", keys[1])
+        r.forget("a")
+        assert keys[1] not in r._nodes and keys[2] not in r._nodes
+        assert r.known_keys("b") == {keys[0]}
+
 
 class TestRoundRobinRouter:
     def test_cycles_in_order(self):
@@ -196,6 +214,27 @@ class TestSLOAdmission:
         for _ in range(64):
             pol.observe_ttft(0.01)                       # window recovers
         assert pol.decide([rep]).admit
+
+    def test_tpot_slo_uses_observed_window(self):
+        pol = SLOAdmission(max_queue_per_replica=None, tpot_slo=0.05)
+        rep = _StubHealthReplica("a")
+        assert pol.decide([rep]).admit                   # no data -> admit
+        pol.observe_tpot(None)                           # ignored
+        for _ in range(4):
+            pol.observe_tpot(0.2)                        # decode saturated
+        d = pol.decide([rep])
+        assert not d.admit and d.reason == "tpot_slo"
+        for _ in range(64):
+            pol.observe_tpot(0.001)                      # window recovers
+        assert pol.decide([rep]).admit
+
+    def test_ttft_slo_checked_before_tpot_slo(self):
+        pol = SLOAdmission(max_queue_per_replica=None, ttft_slo=0.5,
+                           tpot_slo=0.05)
+        pol.observe_ttft(2.0)
+        pol.observe_tpot(0.2)
+        d = pol.decide([_StubHealthReplica("a")])
+        assert not d.admit and d.reason == "ttft_slo"
 
     def test_decision_repr_and_shed_error(self):
         d = AdmissionDecision(False, "queue_full", 2.0)
