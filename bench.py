@@ -784,7 +784,12 @@ def _frontend_bench(paddle, on_tpu, budget_left_s=None):
     p50/p95 TTFT per (N, router) plus the prefix-cache hit ratio the router
     earned.  Best-effort: returns a dict or None; each N level is clamped
     up front by the wall-budget projection (same discipline as the serving
-    extra)."""
+    extra).
+
+    A final ``degraded`` sub-run (same clamp) replays the trace against a
+    2-worker self-healing fleet (RPC workers + lease membership) and kills
+    one worker at t=50% of the clean wall — reporting recovery time,
+    transparent-requeue count, and p95 TTFT clean vs faulted."""
     try:
         from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
         from paddle_tpu.inference.serving import LLMEngine
@@ -855,11 +860,159 @@ def _frontend_bench(paddle, on_tpu, budget_left_s=None):
                 # first level's wall (includes compile warmup) calibrates
                 # the projections for the bigger levels
                 sect0 = time.perf_counter() - t0
+
+        # ---- degradation sub-run: kill one worker mid-trace ---------------
+        # sect0 covered 16 requests in-process; two 16-request fleet runs
+        # plus fleet boot + lease-expiry recovery add a flat allowance.
+        run_deg = True
+        if budget_left_s is not None and sect0 is not None:
+            spent = time.perf_counter() - t_enter
+            projected = sect0 * 2 + 12.0
+            if spent + projected > budget_left_s:
+                out.setdefault("skipped", []).append("degraded")
+                print(f"frontend extra 'degraded' skipped: projected "
+                      f"{projected:.0f}s would overrun the "
+                      f"{budget_left_s - spent:.0f}s left in the wall "
+                      f"budget", file=sys.stderr)
+                run_deg = False
+        if run_deg:
+            out["degraded"] = _frontend_degraded(
+                m, max_len, PAGE, PREFIX_PAGES, SUFFIX, NEW)
         return out
     except Exception as e:  # noqa: BLE001 — extras must not kill the bench
         print(f"frontend bench failed: {type(e).__name__}: {e}",
               file=sys.stderr)
         return None
+
+
+def _frontend_degraded(m, max_len, page, prefix_pages, suffix, new):
+    """Self-healing fleet under fire.  Boots 2 leased RPC workers (threads
+    of this process — same harness as the tier-1 chaos tests), replays the
+    deterministic trace clean, then replays it again killing worker ``w0``
+    at t=50% of the clean wall: heartbeats stop and the RPC socket drops,
+    which is a crash/`kill -9` as the fleet observes it.  Reports recovery
+    time (kill → dead replica evicted from routing), how many inflight
+    requests were transparently requeued onto the survivor, and p95 TTFT
+    clean vs faulted."""
+    import threading
+
+    from paddle_tpu.distributed.store import TCPStore
+    from paddle_tpu.inference.frontend import FleetReplicaSet, WorkerServer
+    from paddle_tpu.inference.frontend.admission import ShedError
+    from paddle_tpu.inference.frontend.loadgen import make_trace, percentile
+    from paddle_tpu.inference.frontend.replica import ReplicaDeadError
+    from paddle_tpu.inference.frontend.router import PrefixAffinityRouter
+    from paddle_tpu.inference.serving import LLMEngine
+
+    TTL = 1.0
+    n_requests, conc = 16, 8
+    trace = make_trace(7, n_requests, groups=4, prefix_pages=prefix_pages,
+                       page_size=page, suffix_tokens=suffix,
+                       max_new_tokens=new, group_major=True)
+
+    def _run(kill_at=None):
+        master = TCPStore(is_master=True, timeout=20)
+        workers = {}
+        for wname in ("w0", "w1"):
+            eng = LLMEngine(m, max_batch=4, max_len=max_len, page_size=page,
+                            prefix_cache=True)
+            workers[wname] = WorkerServer(
+                wname, eng, TCPStore(port=master.port, timeout=20),
+                group="bench", ttl=TTL).start()
+        fleet = FleetReplicaSet(TCPStore(port=master.port, timeout=20),
+                                group="bench", ttl=TTL,
+                                router=PrefixAffinityRouter(page_size=page))
+        fleet.start()
+        boot_deadline = time.perf_counter() + 15
+        while (len(fleet.alive_replicas()) < 2
+               and time.perf_counter() < boot_deadline):
+            time.sleep(0.02)
+
+        records = [None] * len(trace)
+        handles = []
+        cursor = {"i": 0}
+        lock = threading.Lock()
+        recovery = {}
+
+        def _kill():
+            w = workers["w0"]
+            t_kill = time.perf_counter()
+            w.lease.stop_heartbeat()    # renewals stop...
+            w.rpc.close()               # ...the socket drops...
+            w.replica.close()           # ...and the engine dies — no release
+            while ("w0" in (r.name for r in fleet.alive_replicas())
+                   and time.perf_counter() - t_kill < TTL * 20):
+                time.sleep(0.01)
+            recovery["s"] = round(time.perf_counter() - t_kill, 3)
+
+        def _client():
+            while True:
+                with lock:
+                    i = cursor["i"]
+                    if i >= len(trace):
+                        return
+                    cursor["i"] = i + 1
+                req = trace[i]
+                try:
+                    h = fleet.submit(req["prompt"],
+                                     max_new_tokens=req["max_tokens"])
+                except (ShedError, ReplicaDeadError):
+                    records[i] = {"status": "shed", "tokens": 0,
+                                  "ttft": None}
+                    continue
+                with lock:
+                    handles.append(h)
+                toks, status = fleet.result(h)
+                records[i] = {"status": status.value, "tokens": len(toks),
+                              "ttft": h.replica.ttft(h.rid)}
+
+        killer = None
+        if kill_at is not None:
+            killer = threading.Timer(kill_at, _kill)
+            killer.daemon = True
+            killer.start()
+        t0 = time.perf_counter()
+        clients = [threading.Thread(target=_client, name=f"deg-{k}",
+                                    daemon=True) for k in range(conc)]
+        try:
+            for t in clients:
+                t.start()
+            for t in clients:
+                t.join()
+            wall = time.perf_counter() - t0
+        finally:
+            if killer is not None:
+                killer.cancel()
+                killer.join(timeout=TTL * 25)
+            fleet.close()
+            for w in workers.values():
+                try:
+                    w.close(drain=False)
+                except Exception:  # noqa: BLE001 — the killed worker
+                    pass
+
+        done = [r for r in records if r is not None]
+        ttfts = [r["ttft"] for r in done if r["ttft"] is not None]
+        res = {
+            "requests": len(done),
+            "ok": sum(1 for r in done
+                      if r["status"] in ("finished", "eos")),
+            "failed": sum(1 for r in done if r["status"] == "failed"),
+            "shed": sum(1 for r in done if r["status"] == "shed"),
+            "total_tokens": sum(r["tokens"] for r in done),
+            "wall_s": round(wall, 4),
+            "ttft_p95_s": round(percentile(ttfts, 95), 4) if ttfts
+            else None,
+            "requeued": sum(1 for h in handles if h.requeued),
+        }
+        if kill_at is not None:
+            res["recovery_s"] = recovery.get("s")
+        return res
+
+    clean = _run()
+    faulted = _run(kill_at=max(0.05, clean["wall_s"] * 0.5))
+    return {"replicas": 2, "lease_ttl_s": TTL, "clean": clean,
+            "faulted": faulted}
 
 
 def _decode_bench(paddle, on_tpu):

@@ -1,4 +1,4 @@
-"""robustness — swallowed-exception hygiene.
+"""robustness — swallowed-exception and orphan-thread hygiene.
 
 A broad handler whose whole body is ``pass`` discards every failure — the
 archetypal fault-tolerance anti-pattern this PR's serving work is built to
@@ -11,12 +11,18 @@ expiries; the retry helper re-raises after backoff).  Flagged:
     ``continue``, ``break``, ``return`` / ``return None`` — the loop-shaped
     variant of the same swallow: the failure vanishes AND the iteration's
     work silently disappears with it.  (RB102)
+  * a non-daemon ``threading.Thread(...)`` that is never ``join()``ed (nor
+    later marked daemon): library code that starts one leaks a thread that
+    blocks interpreter exit and outlives every ``close()``.  The fleet's
+    worker/supervisor/heartbeat threads are the motivating consumers: each
+    is ``daemon=True`` AND joined on its shutdown path.  (RB103)
 
 Narrow handlers (``except KeyError: continue``) are idiomatic probing and
 stay silent, as are broad handlers that do anything observable (log, count,
-record) before escaping.  Deliberate broad swallows — shutdown paths where
-any cleanup error is acceptable, best-effort per-item scans — carry a line
-pragma or a baseline entry stating so.
+record) before escaping.  A thread constructed with ``daemon=True`` (or a
+non-literal ``daemon=`` the pass can't evaluate) passes RB103, as does any
+thread whose storage target is joined somewhere in its enclosing class or
+function.  Deliberate exceptions carry a line pragma or a baseline entry.
 """
 from __future__ import annotations
 
@@ -27,6 +33,10 @@ from ..framework import AnalysisPass, Finding, register_pass
 _HINT = ("handle the error, re-raise, or log it (module logger / "
          "observability registry); a deliberate swallow names the narrow "
          "exception it expects or carries a pragma")
+
+_THREAD_HINT = ("pass daemon=True at construction, or join() the thread on "
+                "the owner's shutdown path (close/stop); do both for "
+                "threads that must not outlive their owner")
 
 _BROAD = ("Exception", "BaseException")
 
@@ -70,34 +80,130 @@ def _escapes(handler):
     return False
 
 
+def _is_thread_ctor(call):
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id == "Thread"
+    return isinstance(f, ast.Attribute) and f.attr == "Thread"
+
+
+def _daemon_safe(call):
+    """True when the constructor itself settles the question: an explicit
+    ``daemon=True``, or a non-literal ``daemon=`` expression the pass gives
+    the benefit of the doubt."""
+    for kw in call.keywords:
+        if kw.arg == "daemon":
+            if isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+            return True
+    return False
+
+
+def _assign_target(parents, call):
+    """The storage target string (``self._thread``, ``t``) when the Thread
+    call is the whole right-hand side of a simple assignment, else None."""
+    node, parent = call, parents.get(call)
+    while parent is not None and not isinstance(parent, ast.stmt):
+        node, parent = parent, parents.get(parent)
+    if (isinstance(parent, ast.Assign) and parent.value is node
+            and len(parent.targets) == 1
+            and isinstance(parent.targets[0], (ast.Name, ast.Attribute))):
+        return ast.unparse(parent.targets[0])
+    return None
+
+
+def _owner_scope(parents, call, target):
+    """Where a matching join() may legitimately live: the enclosing class
+    for ``self.*`` targets (shutdown lives in a sibling method), else the
+    enclosing function, else the module."""
+    want_class = target is not None and target.startswith("self.")
+    node = parents.get(call)
+    fallback = None
+    while node is not None:
+        if want_class and isinstance(node, ast.ClassDef):
+            return node
+        if not want_class and isinstance(node,
+                                         (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+            return node
+        if isinstance(node, ast.Module):
+            fallback = node
+        node = parents.get(node)
+    return fallback
+
+
+def _target_released(scope, target):
+    """True when ``target`` is joined (``target.join(...)``) or daemonized
+    after the fact (``target.daemon = True``) anywhere in ``scope``."""
+    for node in ast.walk(scope):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"
+                and ast.unparse(node.func.value) == target):
+            return True
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Attribute)
+                and node.targets[0].attr == "daemon"
+                and ast.unparse(node.targets[0].value) == target
+                and isinstance(node.value, ast.Constant)
+                and bool(node.value.value)):
+            return True
+    return False
+
+
 @register_pass
 class RobustnessPass(AnalysisPass):
     name = "robustness"
-    version = 2
+    version = 3
     description = ("swallowed exceptions: broad except handlers whose "
                    "whole body is pass (RB101) or a bare "
-                   "continue/break/return (RB102)")
+                   "continue/break/return (RB102); orphan threads: "
+                   "non-daemon Thread never joined (RB103)")
 
     def check_file(self, src) -> list[Finding]:
         findings: list[Finding] = []
+        parents = {}
         for node in ast.walk(src.tree):
-            if not isinstance(node, ast.ExceptHandler):
-                continue
-            if not _is_broad(node):
-                continue
-            what = ("bare except" if node.type is None
-                    else f"except {ast.unparse(node.type)}")
-            if _swallows(node):
-                findings.append(Finding(
-                    self.name, "RB101", src.path, node.lineno,
-                    f"{what}: pass — swallows every failure silently",
-                    _HINT, severity="warning"))
-                continue
-            esc = _escapes(node)
-            if esc:
-                findings.append(Finding(
-                    self.name, "RB102", src.path, node.lineno,
-                    f"{what}: {esc} — swallows the failure and silently "
-                    f"drops the iteration's work",
-                    _HINT, severity="warning"))
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ExceptHandler):
+                findings.extend(self._check_handler(src, node))
+            elif isinstance(node, ast.Call) and _is_thread_ctor(node):
+                findings.extend(self._check_thread(src, node, parents))
         return findings
+
+    def _check_handler(self, src, node):
+        if not _is_broad(node):
+            return []
+        what = ("bare except" if node.type is None
+                else f"except {ast.unparse(node.type)}")
+        if _swallows(node):
+            return [Finding(
+                self.name, "RB101", src.path, node.lineno,
+                f"{what}: pass — swallows every failure silently",
+                _HINT, severity="warning")]
+        esc = _escapes(node)
+        if esc:
+            return [Finding(
+                self.name, "RB102", src.path, node.lineno,
+                f"{what}: {esc} — swallows the failure and silently "
+                f"drops the iteration's work",
+                _HINT, severity="warning")]
+        return []
+
+    def _check_thread(self, src, call, parents):
+        if _daemon_safe(call):
+            return []
+        target = _assign_target(parents, call)
+        if target is not None:
+            scope = _owner_scope(parents, call, target)
+            if scope is not None and _target_released(scope, target):
+                return []
+        what = (f"thread stored in {target!r}" if target is not None
+                else "anonymous thread")
+        return [Finding(
+            self.name, "RB103", src.path, call.lineno,
+            f"non-daemon Thread without a matching join(): {what} "
+            f"outlives its owner and blocks interpreter exit",
+            _THREAD_HINT, severity="warning")]

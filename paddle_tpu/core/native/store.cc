@@ -5,9 +5,12 @@
 //
 // Wire protocol (shared with the Python client/fallback server):
 //   request : u8 cmd | u32 klen | key | u32 vlen | val | f64 timeout   (BE)
-//   response: u8 status (0 ok, 1 timeout, 2 bad) | u32 vlen | val
-//   cmds: 1 SET  2 GET(blocking until key or timeout)  3 ADD(val=i64 BE)
+//   response: u8 status (0 ok, 1 timeout, 2 bad, 3 deleted-miss) | u32 vlen | val
+//   cmds: 1 SET  2 GET(blocking until key or timeout; a DELETE processed
+//           mid-wait answers status 3 instead of stalling)  3 ADD(val=i64 BE)
 //         4 DELETE  5 WAIT(key = '\n'-joined key list)
+//         6 CAS(val = u32 elen | expected | desired; elen 0 = expect-absent;
+//           reply val = u8 swapped | current bytes)
 //
 // Threading mirrors tcp_store.cc: accept loop + thread per connection over
 // one mutex/condvar-protected map. Exposed flat C API for ctypes.
@@ -30,6 +33,7 @@ namespace {
 
 struct Store {
   std::map<std::string, std::string> kv;
+  std::map<std::string, uint64_t> dels;  // key -> deletion generation
   std::mutex mu;
   std::condition_variable cv;
   int listen_fd = -1;
@@ -110,16 +114,19 @@ void serve(Store* st, int fd) {
         ok = send_reply(fd, 0, "");
         break;
       }
-      case 2: {  // GET (blocking)
+      case 2: {  // GET (blocking; DELETE mid-wait -> typed miss, status 3)
         std::unique_lock<std::mutex> lk(st->mu);
-        bool have = st->cv.wait_until(lk, deadline, [&] {
-          return st->stopping || st->kv.count(key) != 0;
+        uint64_t gen0 = st->dels.count(key) ? st->dels[key] : 0;
+        st->cv.wait_until(lk, deadline, [&] {
+          return st->stopping || st->kv.count(key) != 0 ||
+                 (st->dels.count(key) ? st->dels[key] : 0) != gen0;
         });
-        if (have && st->kv.count(key)) {
+        if (st->kv.count(key)) {
           ok = send_reply(fd, 0, st->kv[key]);
         } else {
+          bool deleted = (st->dels.count(key) ? st->dels[key] : 0) != gen0;
           lk.unlock();
-          ok = send_reply(fd, 1, "");
+          ok = send_reply(fd, deleted ? 3 : 1, "");
         }
         break;
       }
@@ -149,9 +156,39 @@ void serve(Store* st, int fd) {
         {
           std::lock_guard<std::mutex> lk(st->mu);
           existed = st->kv.erase(key) != 0;
+          st->dels[key]++;
         }
         st->cv.notify_all();
         ok = send_reply(fd, 0, existed ? "1" : "0");
+        break;
+      }
+      case 6: {  // CAS: expected raw bytes (elen 0 = expect-absent) -> desired
+        if (val.size() < 4) {
+          ok = send_reply(fd, 2, "");
+          break;
+        }
+        uint32_t elen_be;
+        std::memcpy(&elen_be, val.data(), 4);
+        uint32_t elen = ntohl(elen_be);
+        if (val.size() < 4 + static_cast<size_t>(elen)) {
+          ok = send_reply(fd, 2, "");
+          break;
+        }
+        std::string expected = val.substr(4, elen);
+        std::string desired = val.substr(4 + elen);
+        std::string reply;
+        {
+          std::lock_guard<std::mutex> lk(st->mu);
+          auto it = st->kv.find(key);
+          bool swapped = (elen == 0) ? it == st->kv.end()
+                                     : (it != st->kv.end() && it->second == expected);
+          if (swapped) st->kv[key] = desired;
+          reply.push_back(swapped ? '\x01' : '\x00');
+          auto cur = st->kv.find(key);
+          if (cur != st->kv.end()) reply.append(cur->second);
+        }
+        st->cv.notify_all();
+        ok = send_reply(fd, 0, reply);
         break;
       }
       case 5: {  // WAIT on '\n'-joined keys

@@ -13,8 +13,22 @@ traffic rides XLA collectives, not this store.
 
 Wire protocol (see store.cc):
   request : u8 cmd | u32 klen | key | u32 vlen | val | f64 timeout   (BE)
-  response: u8 status (0 ok, 1 timeout, 2 bad) | u32 vlen | val
+  response: u8 status (0 ok, 1 timeout, 2 bad, 3 deleted-miss) | u32 vlen | val
   cmds: 1 SET  2 GET  3 ADD (val = i64 BE)  4 DELETE  5 WAIT ('\n'-joined)
+        6 CAS (val = u32 elen | expected | desired; elen 0 = expect-absent;
+               reply val = u8 swapped | current bytes)
+
+Lease-grade primitives (membership.py is the consumer):
+
+- ``compare_and_set`` is an atomic read-modify-write on one key — the
+  index-set updates of the membership plane ride it instead of a racy
+  get+set.  The ``expected`` side compares RAW stored bytes (what
+  ``get_raw`` returned), never a re-pickle: pickling a ``set`` is not
+  byte-stable across processes, so value-level comparison would livelock.
+- A blocking GET that observes the key being DELETEd mid-wait returns a
+  typed miss (status 3 -> :class:`StoreKeyDeleted`) immediately instead of
+  hanging until its timeout: a watcher reading a member key that the member
+  just released sees a clean "gone", not a stall.
 """
 from __future__ import annotations
 
@@ -29,7 +43,17 @@ from ..core.retry import RetryError, RetryPolicy, retry_call
 from ..testing.faults import FAULTS as _faults
 from ..testing.faults import InjectedFault as _InjectedFault
 
-_SET, _GET, _ADD, _DELETE, _WAIT = 1, 2, 3, 4, 5
+_SET, _GET, _ADD, _DELETE, _WAIT, _CAS = 1, 2, 3, 4, 5, 6
+
+
+class StoreKeyDeleted(KeyError):
+    """A blocking read observed its key being deleted mid-wait (server
+    status 3) — typed so callers can distinguish "released cleanly" from
+    "never appeared" (:class:`TimeoutError`)."""
+
+    def __init__(self, key):
+        super().__init__(key)
+        self.key = key
 
 
 def _pack_req(cmd, key, val, timeout):
@@ -61,6 +85,7 @@ class _PyStoreServer(threading.Thread):
     def __init__(self, host, port):
         super().__init__(daemon=True)
         self._kv = {}
+        self._dels = {}        # key -> deletion generation (see GET/DELETE)
         self._cv = threading.Condition()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -98,7 +123,15 @@ class _PyStoreServer(threading.Thread):
                 elif cmd == _GET:
                     deadline = time.monotonic() + timeout
                     with self._cv:
+                        # a DELETE processed while we wait bumps the key's
+                        # deletion generation: reply a typed miss (status 3)
+                        # immediately instead of stalling to the timeout
+                        gen0 = self._dels.get(key, 0)
+                        deleted = False
                         while key not in self._kv:
+                            if self._dels.get(key, 0) != gen0:
+                                deleted = True
+                                break
                             left = deadline - time.monotonic()
                             if left <= 0:
                                 break
@@ -106,7 +139,7 @@ class _PyStoreServer(threading.Thread):
                         if key in self._kv:
                             self._reply(conn, 0, self._kv[key])
                         else:
-                            self._reply(conn, 1)
+                            self._reply(conn, 3 if deleted else 1)
                 elif cmd == _ADD:
                     (delta,) = struct.unpack("!q", val)
                     with self._cv:
@@ -117,8 +150,21 @@ class _PyStoreServer(threading.Thread):
                 elif cmd == _DELETE:
                     with self._cv:
                         existed = self._kv.pop(key, None) is not None
+                        self._dels[key] = self._dels.get(key, 0) + 1
                         self._cv.notify_all()
                     self._reply(conn, 0, b"1" if existed else b"0")
+                elif cmd == _CAS:
+                    (en,) = struct.unpack("!I", val[:4])
+                    expected, desired = val[4:4 + en], val[4 + en:]
+                    with self._cv:
+                        cur = self._kv.get(key)
+                        swapped = (cur is None) if en == 0 else (cur == expected)
+                        if swapped:
+                            self._kv[key] = desired
+                            cur = desired
+                            self._cv.notify_all()
+                    self._reply(conn, 0, (b"\x01" if swapped else b"\x00")
+                                + (cur or b""))
                 elif cmd == _WAIT:
                     deadline = time.monotonic() + timeout
                     ok = True
@@ -205,6 +251,8 @@ class TCPStore:
             status, out = _read_reply(self._sock)
         if status == 1:
             raise TimeoutError(f"TCPStore cmd {cmd} ({key!r}) timed out")
+        if status == 3:
+            raise StoreKeyDeleted(key)
         if status != 0:
             raise RuntimeError(f"TCPStore error status {status}")
         return out
@@ -223,6 +271,33 @@ class TCPStore:
                 return int(raw)
             except ValueError:
                 return raw
+
+    def get_raw(self, key, timeout=None):
+        """Blocking read returning the EXACT stored bytes — the token
+        :meth:`compare_and_set` compares against.  Same wait semantics and
+        typed errors as :meth:`get`."""
+        return self._rpc(_GET, key, timeout=timeout)
+
+    def compare_and_set(self, key, expected, desired):
+        """Atomic swap: install ``desired`` iff the key's current raw bytes
+        equal ``expected``.  ``expected`` is the raw bytes a prior
+        :meth:`get_raw` returned, or None to mean "key must be absent" (raw
+        bytes, not a re-pickle: pickling is not byte-stable across
+        processes).  ``desired`` is pickled unless already bytes.  Returns
+        ``(swapped, current_raw)`` where ``current_raw`` is the stored bytes
+        after the operation (None when the key is absent)."""
+        if expected is not None and not isinstance(expected, bytes):
+            raise TypeError("expected must be raw bytes from get_raw(), "
+                            "or None for expect-absent")
+        want = b"" if expected is None else expected
+        if expected == b"":
+            raise ValueError("empty expected bytes are reserved for "
+                             "expect-absent (pass None)")
+        if not isinstance(desired, bytes):
+            desired = pickle.dumps(desired)
+        out = self._rpc(_CAS, key,
+                        struct.pack("!I", len(want)) + want + desired)
+        return out[:1] == b"\x01", (out[1:] or None)
 
     def add(self, key, amount=1):
         out = self._rpc(_ADD, key, struct.pack("!q", int(amount)))

@@ -634,6 +634,13 @@ class LLMEngine(_LegacyDelegation, _SpecOrchestration):
         including int8 scales) — the unit of the page_pool budget."""
         return self.runner.kv_bytes_per_page()
 
+    def prefix_keys(self):
+        """Chain keys currently resident in the prefix cache (empty when
+        the ``prefix_cache`` knob is off).  The multi-process fleet snapshots
+        this over RPC to keep the gateway's prefix-affinity router warm for
+        replicas whose cache events it cannot observe in-process."""
+        return list(self.pool.key_page)
+
     def result(self, rid):
         return self.sched.finished[rid].out
 

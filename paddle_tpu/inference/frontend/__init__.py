@@ -14,6 +14,13 @@ replicas into one service:
   (``POST /v1/completions``, ``/healthz``, ``/metrics``).
 - :mod:`.loadgen` — deterministic trace-driven load generation for tests and
   the bench frontend extra.
+- :mod:`.rpc` / :mod:`.worker` / :mod:`.supervisor` / :mod:`.fleet` — the
+  self-healing multi-process fleet: each replica runs its engine in its own
+  OS process behind a socket RPC, holds a TTL lease on the membership plane
+  (:mod:`paddle_tpu.distributed.membership`), is respawned by a
+  crash-loop-aware supervisor, and joins/leaves gateway routing via
+  membership events (:class:`FleetReplicaSet`, a ReplicaSet drop-in with
+  zero-token crash requeue).
 
 Quick start::
 
@@ -26,6 +33,7 @@ Quick start::
 """
 from .admission import (AdmissionDecision, AlwaysAdmit,  # noqa: F401
                         ShedError, SLOAdmission)
+from .fleet import FleetReplicaSet, RemoteReplica  # noqa: F401
 from .gateway import Gateway, start_gateway  # noqa: F401
 from .loadgen import (http_completion, make_trace,  # noqa: F401
                       run_closed_loop, summarize)
@@ -33,6 +41,9 @@ from .replica import (EngineReplica, ReplicaDeadError,  # noqa: F401
                       ReplicaSet, RequestHandle)
 from .router import (PrefixAffinityRouter, RouteDecision,  # noqa: F401
                      RoundRobinRouter)
+from .rpc import RpcClient, RpcError, RpcServer  # noqa: F401
+from .supervisor import WorkerSupervisor  # noqa: F401
+from .worker import WorkerServer  # noqa: F401
 
 __all__ = [
     "ReplicaSet", "EngineReplica", "RequestHandle", "ReplicaDeadError",
@@ -40,4 +51,7 @@ __all__ = [
     "SLOAdmission", "AlwaysAdmit", "AdmissionDecision", "ShedError",
     "Gateway", "start_gateway",
     "make_trace", "run_closed_loop", "summarize", "http_completion",
+    "RpcServer", "RpcClient", "RpcError",
+    "WorkerServer", "WorkerSupervisor",
+    "RemoteReplica", "FleetReplicaSet",
 ]

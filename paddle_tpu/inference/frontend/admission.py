@@ -34,6 +34,11 @@ class ShedError(RuntimeError):
         self.reason = reason
         self.retry_after = float(retry_after)
 
+    def __reduce__(self):
+        # keep reason/retry_after across pickling (the worker RPC ships
+        # sheds back to the gateway as exception objects)
+        return (ShedError, (self.reason, self.retry_after))
+
 
 class AdmissionDecision:
     """Outcome of one admission check: ``admit`` plus, when refused, the

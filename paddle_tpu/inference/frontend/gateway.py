@@ -119,9 +119,14 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
-        except (ReplicaDeadError, ValueError) as e:
-            code = 503 if isinstance(e, ReplicaDeadError) else 400
-            self._send_json(code, {"error": str(e)})
+        except ReplicaDeadError as e:
+            # dead fleet: carry Retry-After like the SHED 429 does, so
+            # clients back off instead of hot-looping on 503s
+            self._send_json(503, {"error": str(e)},
+                            headers={"Retry-After": "1"})
+            return
+        except ValueError as e:
+            self._send_json(400, {"error": str(e)})
             return
         if stream:
             self._stream_response(handle)
@@ -184,11 +189,13 @@ class _Handler(BaseHTTPRequestHandler):
                          + b"\n\n")
         self.wfile.flush()
 
-    def _send_json(self, code, obj):
+    def _send_json(self, code, obj, headers=None):
         body = json.dumps(obj).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 

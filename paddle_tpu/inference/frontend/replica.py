@@ -182,6 +182,14 @@ class EngineReplica:
             except KeyError:
                 return None
 
+    def prefix_keys(self):
+        """Chain keys resident in this replica's prefix cache — the fleet
+        layer snapshots these over RPC to warm the gateway-side router for
+        replicas whose cache events never cross the process boundary."""
+        with self._cv:
+            fn = getattr(self.engine, "prefix_keys", None)
+            return list(fn()) if fn is not None else []
+
     def health(self):
         with self._cv:
             h = self.engine.health()
@@ -197,15 +205,29 @@ class EngineReplica:
 
 class RequestHandle:
     """Where a routed request lives: the replica, its rid there, and the
-    submit timestamp the stream-duration histogram measures from."""
+    submit timestamp the stream-duration histogram measures from.
 
-    __slots__ = ("replica", "rid", "t0", "_accounted")
+    For crash recovery the handle also remembers what was submitted
+    (``prompt_ids`` / ``kw``), how many tokens the caller has already
+    received (``streamed``), and whether the request was already requeued
+    once (``requeued``) — a replica death with ``streamed == 0`` may be
+    transparently resubmitted elsewhere, anything else fails typed via
+    ``final_status`` / ``final_error``."""
 
-    def __init__(self, replica, rid):
+    __slots__ = ("replica", "rid", "t0", "_accounted", "prompt_ids", "kw",
+                 "streamed", "requeued", "final_status", "final_error")
+
+    def __init__(self, replica, rid, prompt_ids=None, kw=None):
         self.replica = replica
         self.rid = rid
         self.t0 = time.perf_counter()
         self._accounted = False
+        self.prompt_ids = prompt_ids
+        self.kw = kw or {}
+        self.streamed = 0
+        self.requeued = False
+        self.final_status = None
+        self.final_error = None
 
     def __repr__(self):
         return f"RequestHandle({self.replica.name!r}, rid={self.rid})"
@@ -220,10 +242,18 @@ class ReplicaSet:
     events; pass ``router=RoundRobinRouter()`` for the affinity-blind
     baseline.  ``admission`` is consulted before routing — a refusal raises
     :class:`~.admission.ShedError` without touching any replica.
+
+    ``requeue=True`` turns on crash recovery: when a replica dies under an
+    inflight request that has streamed ZERO tokens, the request is
+    transparently resubmitted once onto a surviving replica (routed warm
+    through the prefix-affinity router); a request that already streamed
+    tokens fails typed FAILED as before (re-emitting its prefix would
+    corrupt the caller's stream).  The multi-process fleet enables this —
+    the in-process default stays off, preserving fail-fast semantics.
     """
 
     def __init__(self, engines, router=None, admission=None, names=None,
-                 start=True, poll_interval=0.05):
+                 start=True, poll_interval=0.05, requeue=False):
         engines = list(engines)
         if not engines:
             raise ValueError("ReplicaSet needs at least one engine")
@@ -236,6 +266,7 @@ class ReplicaSet:
             router = PrefixAffinityRouter(page_size=engines[0].page)
         self.router = router
         self.admission = admission if admission is not None else AlwaysAdmit()
+        self.requeue = bool(requeue)
         self.replicas = [
             EngineReplica(n, e, router=router, poll_interval=poll_interval)
             for n, e in zip(names, engines)]
@@ -265,6 +296,27 @@ class ReplicaSet:
     def alive_replicas(self):
         return [r for r in self.replicas if r.alive]
 
+    def add_replica(self, replica, start=False):
+        """Join a pre-built replica (in-process or remote) into routing;
+        replaces any previous replica of the same name."""
+        old = self._by_name.get(replica.name)
+        if old is not None:
+            self.remove_replica(old.name)
+        self.replicas.append(replica)
+        self._by_name[replica.name] = replica
+        if start and hasattr(replica, "start"):
+            replica.start()
+        return replica
+
+    def remove_replica(self, name):
+        """Drop a replica from routing (its inflight handles hit the death
+        path on their next poll); returns the removed replica or None."""
+        rep = self._by_name.pop(name, None)
+        if rep is not None:
+            self.replicas.remove(rep)
+            self.router.forget(name)
+        return rep
+
     # ---- request facade ------------------------------------------------------
     def submit(self, prompt_ids, **kw):
         """Admit, route, and submit one request; returns a
@@ -281,11 +333,23 @@ class ReplicaSet:
             _obs.FRONTEND_SHED.inc(reason=decision.reason)
             _obs.FRONTEND_REQUESTS.inc(outcome="shed")
             raise ShedError(decision.reason, decision.retry_after)
-        route = self.router.route(prompt_ids, alive)
-        rep = route.replica
-        if _faults.FAULTS.active:
-            _faults.FAULTS.raise_if("frontend.submit", replica=rep.name)
-        rid = rep.submit(prompt_ids, **kw)
+        # a replica can die between routing and submit (remote worker
+        # killed); reroute over the survivors instead of failing the request
+        tried = set()
+        while True:
+            candidates = [r for r in self.alive_replicas()
+                          if r.name not in tried]
+            if not candidates:
+                raise ReplicaDeadError("no live replicas")
+            route = self.router.route(prompt_ids, candidates)
+            rep = route.replica
+            if _faults.FAULTS.active:
+                _faults.FAULTS.raise_if("frontend.submit", replica=rep.name)
+            try:
+                rid = rep.submit(prompt_ids, **kw)
+                break
+            except ReplicaDeadError:
+                tried.add(rep.name)
         if rep.status(rid) is _RequestStatus.SHED:
             # the engine's own admission control refused it (queue bound /
             # page watermark); surface it exactly like a frontend shed
@@ -294,7 +358,8 @@ class ReplicaSet:
             raise ShedError("engine", decision.retry_after)
         _obs.FRONTEND_ROUTED.inc(replica=rep.name, reason=route.reason)
         _obs.FRONTEND_INFLIGHT.inc()
-        return RequestHandle(rep, rid)
+        return RequestHandle(rep, rid, prompt_ids=list(prompt_ids),
+                             kw=dict(kw))
 
     def _account(self, handle, status):
         """First terminal observation of a request: outcome counter, inflight
@@ -306,23 +371,66 @@ class ReplicaSet:
         _obs.FRONTEND_REQUESTS.inc(outcome=status.value)
         _obs.FRONTEND_INFLIGHT.inc(-1)
         _obs.FRONTEND_STREAM_SECONDS.observe(time.perf_counter() - handle.t0)
-        self.admission.observe_ttft(handle.replica.ttft(handle.rid))
-        observe_tpot = getattr(self.admission, "observe_tpot", None)
-        if observe_tpot is not None:
-            observe_tpot(handle.replica.tpot(handle.rid))
+        try:
+            self.admission.observe_ttft(handle.replica.ttft(handle.rid))
+            observe_tpot = getattr(self.admission, "observe_tpot", None)
+            if observe_tpot is not None:
+                observe_tpot(handle.replica.tpot(handle.rid))
+        except ReplicaDeadError:
+            pass  # the replica died under us; its latencies died with it
+
+    # ---- replica-death handling ---------------------------------------------
+    def _poll_handle(self, handle, timeout):
+        """``replica.poll`` with fleet-level crash recovery: a dead replica
+        either requeues the handle (zero tokens streamed, once) or pins a
+        typed FAILED terminal on it."""
+        if handle.final_status is not None:
+            return [], handle.final_status
+        try:
+            toks, status = handle.replica.poll(handle.rid, timeout=timeout)
+        except ReplicaDeadError as e:
+            return [], self._on_replica_death(handle, e)
+        handle.streamed += len(toks)
+        return toks, status
+
+    def _on_replica_death(self, handle, error):
+        """The replica under ``handle`` died (lease expiry / RPC failure /
+        in-process step death).  Returns the handle's new status: a live
+        one after a successful requeue, else the pinned FAILED."""
+        if (self.requeue and not handle.requeued and handle.streamed == 0
+                and handle.prompt_ids is not None):
+            try:
+                alive = [r for r in self.alive_replicas()
+                         if r is not handle.replica]
+                if alive:
+                    route = self.router.route(handle.prompt_ids, alive)
+                    rid = route.replica.submit(handle.prompt_ids,
+                                               **handle.kw)
+                    if route.replica.status(rid) is not _RequestStatus.SHED:
+                        handle.replica, handle.rid = route.replica, rid
+                        handle.requeued = True
+                        _obs.FRONTEND_REQUEUED.inc()
+                        _obs.FRONTEND_ROUTED.inc(replica=route.replica.name,
+                                                 reason="requeue")
+                        return route.replica.status(rid)
+            except (ReplicaDeadError, ShedError):
+                pass  # no survivor could take it: fall through to FAILED
+        handle.final_status = _RequestStatus.FAILED
+        handle.final_error = error
+        self._account(handle, _RequestStatus.FAILED)
+        return _RequestStatus.FAILED
 
     def stream(self, handle, poll_timeout=0.5):
         """Yield ``handle``'s tokens as they are emitted, one int at a time,
         until the request is terminal.  Check ``self.status(handle)`` after
         exhaustion for the terminal status."""
         while True:
-            toks, status = handle.replica.poll(handle.rid,
-                                               timeout=poll_timeout)
+            toks, status = self._poll_handle(handle, poll_timeout)
             yield from toks
             if status.terminal and not toks:
                 # drain once more: tokens emitted by the finalizing step
                 # land before the terminal status is visible
-                yield from handle.replica.poll(handle.rid, timeout=0)[0]
+                yield from self._poll_handle(handle, 0)[0]
                 self._account(handle, status)
                 return
 
@@ -330,21 +438,38 @@ class ReplicaSet:
         """Block until terminal; returns ``(tokens, status)``."""
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
-            _, status = handle.replica.poll(handle.rid, timeout=1.0)
+            _, status = self._poll_handle(handle, 1.0)
             if status.terminal:
                 self._account(handle, status)
+                if handle.final_status is not None:
+                    return [], handle.final_status
                 return handle.replica.result(handle.rid), status
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError(f"{handle!r} not terminal after {timeout}s")
 
     def status(self, handle):
-        return handle.replica.status(handle.rid)
+        if handle.final_status is not None:
+            return handle.final_status
+        try:
+            return handle.replica.status(handle.rid)
+        except ReplicaDeadError as e:
+            return self._on_replica_death(handle, e)
 
     def cancel(self, handle):
-        return handle.replica.cancel(handle.rid)
+        if handle.final_status is not None:
+            return False
+        try:
+            return handle.replica.cancel(handle.rid)
+        except ReplicaDeadError:
+            return False
 
     def request_error(self, handle):
-        return handle.replica.request_error(handle.rid)
+        if handle.final_error is not None:
+            return repr(handle.final_error)
+        try:
+            return handle.replica.request_error(handle.rid)
+        except ReplicaDeadError as e:
+            return repr(e)
 
     def health(self):
         """Per-replica health snapshots keyed by replica name."""

@@ -1,0 +1,227 @@
+"""Stdlib socket RPC for replica workers — length-prefixed pickle frames.
+
+The fleet's control plane (``submit`` / ``poll`` / ``cancel`` / ``health``)
+crosses process boundaries over this: one :class:`RpcServer` per worker
+process, one :class:`RpcClient` per remote replica handle in the gateway.
+Deliberately tiny — blocking sockets, a thread per server connection, no
+framing beyond ``u32 length | pickle`` — because the payloads are token
+lists and status enums, not tensors (bulk KV traffic rides XLA collectives,
+never this channel).
+
+Both ends are the same codebase, so exceptions travel by pickle: a worker
+raising :class:`~.admission.ShedError` re-raises as ``ShedError`` in the
+gateway with ``reason`` / ``retry_after`` intact.  An exception that won't
+pickle degrades to ``RuntimeError(repr)`` rather than poisoning the
+connection.
+
+Connection failures surface as :class:`RpcError` — the remote-replica layer
+maps those to replica death.  Fault points ``rpc.send`` / ``rpc.recv``
+(:mod:`paddle_tpu.testing.faults`, ctx has ``op``) fire client-side around
+the request/response halves so chaos tests can sever a live worker's
+channel without touching the process.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+
+from ...testing import faults as _faults
+
+__all__ = ["RpcError", "RpcServer", "RpcClient"]
+
+_OK, _ERR = 0, 1
+
+
+class RpcError(ConnectionError):
+    """The RPC channel itself failed (connect, send, or recv) — distinct
+    from an exception the remote handler raised, which re-raises as
+    itself."""
+
+
+def _send_frame(sock, obj):
+    payload = pickle.dumps(obj)
+    sock.sendall(struct.pack("!I", len(payload)) + payload)
+
+
+def _recv_frame(sock):
+    hdr = _recv_exact(sock, 4)
+    (n,) = struct.unpack("!I", hdr)
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise RpcError("rpc connection closed")
+        buf += chunk
+    return buf
+
+
+class RpcServer:
+    """Serve ``handler(op, kwargs)`` over TCP until :meth:`close`.
+
+    Each accepted connection gets a daemon thread running request frames in
+    a loop; :meth:`close` shuts the listener down and joins the accept
+    thread (per-connection threads exit when their peer disconnects or the
+    listener's close unblocks them).
+    """
+
+    def __init__(self, handler, host="127.0.0.1", port=0):
+        self.handler = handler
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(128)
+        self.host, self.port = host, self._srv.getsockname()[1]
+        self._accept_thread = None
+        self._closing = False
+        self._conns = set()
+        self._conns_lock = threading.Lock()
+
+    def start(self):
+        if self._accept_thread is None:
+            self._accept_thread = threading.Thread(
+                target=self._accept_loop, name=f"rpc-accept-{self.port}",
+                daemon=True)
+            self._accept_thread.start()
+        return self
+
+    def _accept_loop(self):
+        while True:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return  # listener closed: shut down
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                if self._closing:
+                    conn.close()
+                    return
+                self._conns.add(conn)
+            threading.Thread(target=self._serve, args=(conn,),
+                             name=f"rpc-conn-{self.port}",
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                try:
+                    op, kw = _recv_frame(conn)
+                except (RpcError, OSError, EOFError, pickle.UnpicklingError):
+                    return
+                try:
+                    reply = (_OK, self.handler(op, kw))
+                except BaseException as e:  # noqa: BLE001 — RPC boundary
+                    try:
+                        pickle.dumps(e)
+                    except Exception:
+                        e = RuntimeError(f"unpicklable remote error: {e!r}")
+                    reply = (_ERR, e)
+                try:
+                    _send_frame(conn, reply)
+                except OSError:
+                    return
+        finally:
+            conn.close()
+            with self._conns_lock:
+                self._conns.discard(conn)
+
+    def close(self):
+        with self._conns_lock:
+            self._closing = True
+            conns = list(self._conns)
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._srv.close()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            c.close()
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=10.0)
+            self._accept_thread = None
+
+
+class RpcClient:
+    """Call a worker's ops over a small pool of pooled connections.
+
+    One socket per *concurrent* call (checked out of a free list, returned
+    on success) so long-polling one stream never serializes another; a
+    socket that errors is discarded, not reused.  All channel failures
+    raise :class:`RpcError`; remote handler exceptions re-raise as
+    themselves.
+    """
+
+    def __init__(self, host, port, connect_timeout=5.0, call_timeout=60.0):
+        self.host, self.port = host, int(port)
+        self.connect_timeout = float(connect_timeout)
+        self.call_timeout = float(call_timeout)
+        self._free = []
+        self._lock = threading.Lock()
+        self.closed = False
+
+    def _checkout(self):
+        with self._lock:
+            if self.closed:
+                raise RpcError("rpc client closed")
+            if self._free:
+                return self._free.pop()
+        try:
+            sock = socket.create_connection((self.host, self.port),
+                                            timeout=self.connect_timeout)
+        except OSError as e:
+            raise RpcError(
+                f"cannot reach worker at {self.host}:{self.port}: {e}") from e
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def _checkin(self, sock):
+        with self._lock:
+            if not self.closed and len(self._free) < 8:
+                self._free.append(sock)
+                return
+        sock.close()
+
+    def call(self, op, deadline=None, **kw):
+        """One round trip: returns the handler's value or re-raises its
+        exception.  ``deadline`` bounds the whole call socket-side (the
+        server adds no deadline of its own); it is a separate parameter so
+        ops are free to take a ``timeout`` kwarg of their own."""
+        sock = self._checkout()
+        try:
+            sock.settimeout(self.call_timeout if deadline is None
+                            else float(deadline))
+            if _faults.FAULTS.active:
+                _faults.FAULTS.raise_if("rpc.send", op=op)
+            try:
+                _send_frame(sock, (op, kw))
+            except OSError as e:
+                raise RpcError(f"rpc send failed ({op}): {e}") from e
+            if _faults.FAULTS.active:
+                _faults.FAULTS.raise_if("rpc.recv", op=op)
+            try:
+                status, value = _recv_frame(sock)
+            except (OSError, EOFError, pickle.UnpicklingError) as e:
+                raise RpcError(f"rpc recv failed ({op}): {e}") from e
+        except BaseException:
+            sock.close()
+            raise
+        self._checkin(sock)
+        if status == _ERR:
+            raise value
+        return value
+
+    def close(self):
+        with self._lock:
+            self.closed = True
+            free, self._free = self._free, []
+        for s in free:
+            s.close()

@@ -152,6 +152,35 @@ FRONTEND_STREAM_SECONDS = REGISTRY.histogram(
     "frontend_stream_seconds",
     "submit-to-terminal wall time per gateway request")
 
+# membership plane (distributed/membership.py); group labels the fleet
+MEMBERSHIP_LEASE_EXPIRIES = REGISTRY.counter(
+    "membership_lease_expiries_total",
+    "member leases a watcher declared expired (missed heartbeats)",
+    ("group",))
+MEMBERSHIP_EVENTS = REGISTRY.counter(
+    "membership_events_total",
+    "membership transitions observed by watchers (join/leave/expire)",
+    ("group", "kind"))
+MEMBERSHIP_HEARTBEAT_SECONDS = REGISTRY.histogram(
+    "membership_heartbeat_seconds",
+    "wall time of one lease renewal (store round-trip incl. retries)",
+    ("group",),
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0))
+
+# self-healing fleet (inference/frontend/ supervisor + requeue path)
+FRONTEND_RESTARTS = REGISTRY.counter(
+    "frontend_replica_restarts_total",
+    "worker processes respawned by the supervisor after a crash",
+    ("replica",))
+FRONTEND_QUARANTINES = REGISTRY.counter(
+    "frontend_replica_quarantines_total",
+    "replicas the crash-loop circuit breaker stopped respawning (alert!)",
+    ("replica",))
+FRONTEND_REQUEUED = REGISTRY.counter(
+    "frontend_requeued_total",
+    "inflight requests transparently re-enqueued onto a surviving replica "
+    "after their replica died before streaming any token")
+
 # shared retry helper (core/retry.py); op labels the retried operation
 RETRY_ATTEMPTS = REGISTRY.histogram(
     "retry_attempts", "attempts consumed per retried operation", ("op",),

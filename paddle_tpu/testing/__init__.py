@@ -6,8 +6,8 @@ threaded through the serving engine and the control-plane store, driven by
 seeded schedules so every failure path is exercised deterministically (see
 :mod:`.faults`).
 """
-from .faults import (FAULTS, FailNth, FailProb, FaultInjector,  # noqa: F401
-                     InjectedFault, injected)
+from .faults import (FAULTS, Always, FailNth, FailProb,  # noqa: F401
+                     FaultInjector, InjectedFault, Never, injected)
 
 __all__ = ["FAULTS", "FaultInjector", "InjectedFault", "FailNth",
-           "FailProb", "injected"]
+           "FailProb", "Always", "Never", "injected"]

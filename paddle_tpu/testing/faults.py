@@ -31,8 +31,13 @@ before any page is copied, so a transient retry is idempotent; ctx has
 (gateway submit fails before routing), ``frontend.submit`` (fails after a
 replica is chosen; ctx has ``replica``), and ``frontend.step`` (a replica's
 step loop dies — the chaos tests kill a replica mid-stream with this; ctx
-has ``replica``).  The registry is name-keyed and open: new subsystems add
-points without touching this module.
+has ``replica``).  The self-healing fleet adds ``membership.register`` /
+``membership.heartbeat`` (lease registration / renewal attempts raise; ctx
+has ``group`` and ``member`` — arm ``Always`` to starve a lease to death)
+and ``rpc.send`` / ``rpc.recv`` (the worker RPC channel fails client-side
+around the request/response halves; ctx has ``op``).  The registry is
+name-keyed and open: new subsystems add points without touching this
+module.
 """
 from __future__ import annotations
 
@@ -54,6 +59,11 @@ class InjectedFault(RuntimeError):
                          + (" (transient)" if transient else ""))
         self.point = point
         self.transient = transient
+
+    def __reduce__(self):
+        # survive the worker RPC's pickle round trip with point/transient
+        # intact (chaos tests assert on them gateway-side)
+        return (InjectedFault, (self.point, self.transient))
 
 
 # ---- schedules ---------------------------------------------------------------

@@ -1,0 +1,21 @@
+"""Engine factory for fleet worker subprocesses (--engine-spec target).
+
+Kept as a plain module (not a test file) so
+``python -m paddle_tpu.inference.frontend.worker
+--engine-spec tests/_fleet_worker_spec.py:make_engine`` can load it by path
+in the slow kill-9 chaos test without importing the pytest machinery."""
+
+
+def make_engine():
+    import paddle_tpu as pt
+    from paddle_tpu.inference.serving import LLMEngine
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=176,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=128)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return LLMEngine(model, max_batch=3, max_len=64, page_size=8,
+                     prefix_cache=True)

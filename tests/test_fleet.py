@@ -1,0 +1,672 @@
+"""Self-healing multi-process fleet: worker RPC, supervision, membership-fed
+routing, and crash recovery.
+
+Layers under test, bottom-up:
+
+- the length-prefixed socket RPC (exception transport fidelity, fault
+  points, pooled concurrency);
+- the worker supervisor (bounded-backoff respawn, crash-loop quarantine) —
+  pure units with fake process handles, fake clock, fake sleep;
+- the fleet itself: thread-hosted :class:`WorkerServer`\\ s (identical code
+  path to the subprocess entry, minus fork cost) behind a
+  :class:`FleetReplicaSet` with an injectable clock — "kill -9" is closing
+  a worker's RPC listener and step loop WITHOUT releasing its lease, which
+  is exactly what the real signal leaves behind.  The deterministic chaos
+  test asserts the ISSUE 10 acceptance row: survivors token-exact, the
+  zero-token victim requeued once and completed elsewhere, the
+  partially-streamed victim failed typed, the respawned worker re-registered
+  under a new epoch within one lease TTL, and all three new metric families
+  visible in ``render_prometheus()``.
+
+The real-SIGKILL variant (actual subprocess workers, actual ``kill -9``)
+is slow-marked and excluded from tier-1."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.inference.engine.request import RequestStatus
+from paddle_tpu.inference.frontend import ShedError
+from paddle_tpu.inference.frontend.fleet import FleetReplicaSet, RemoteReplica
+from paddle_tpu.inference.frontend.replica import ReplicaDeadError
+from paddle_tpu.inference.frontend.router import RouteDecision
+from paddle_tpu.inference.frontend.rpc import RpcClient, RpcError, RpcServer
+from paddle_tpu.inference.frontend.supervisor import (QUARANTINED, RESPAWNED,
+                                                      RUNNING,
+                                                      WorkerSupervisor)
+from paddle_tpu.inference.frontend.worker import WorkerServer
+from paddle_tpu.testing import FAULTS, Always, FailNth, InjectedFault
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+# ------------------------------------------------------------------ RPC layer
+
+class TestRpc:
+    def _server(self, handler):
+        srv = RpcServer(handler)
+        srv.start()
+        return srv
+
+    def test_roundtrip_and_kwargs(self):
+        srv = self._server(lambda op, kw: (op, sorted(kw.items())))
+        try:
+            c = RpcClient(srv.host, srv.port)
+            assert c.call("echo", a=1, b=[2, 3]) == ("echo",
+                                                     [("a", 1), ("b", [2, 3])])
+            c.close()
+        finally:
+            srv.close()
+
+    def test_remote_exception_fidelity(self):
+        def handler(op, kw):
+            if op == "shed":
+                raise ShedError("draining", retry_after=7.5)
+            if op == "injected":
+                raise InjectedFault("some.point", transient=True)
+            raise KeyError(kw["k"])
+
+        srv = self._server(handler)
+        try:
+            c = RpcClient(srv.host, srv.port)
+            with pytest.raises(ShedError) as ei:
+                c.call("shed")
+            assert ei.value.reason == "draining"
+            assert ei.value.retry_after == 7.5
+            with pytest.raises(InjectedFault) as ei:
+                c.call("injected")
+            assert ei.value.point == "some.point" and ei.value.transient
+            with pytest.raises(KeyError):
+                c.call("missing", k="x")
+            # the connection survives remote errors
+            with pytest.raises(ShedError):
+                c.call("shed")
+            c.close()
+        finally:
+            srv.close()
+
+    def test_unpicklable_remote_error_degrades(self):
+        class Evil(RuntimeError):
+            def __reduce__(self):
+                raise TypeError("nope")
+
+        srv = self._server(lambda op, kw: (_ for _ in ()).throw(Evil("boom")))
+        try:
+            c = RpcClient(srv.host, srv.port)
+            with pytest.raises(RuntimeError, match="unpicklable"):
+                c.call("x")
+            c.close()
+        finally:
+            srv.close()
+
+    def test_connect_failure_is_rpc_error(self):
+        dead = RpcServer(lambda op, kw: None)
+        port = dead.port
+        dead.close()
+        c = RpcClient("127.0.0.1", port, connect_timeout=0.5)
+        with pytest.raises(RpcError):
+            c.call("ping")
+
+    def test_fault_points(self):
+        srv = self._server(lambda op, kw: "pong")
+        try:
+            c = RpcClient(srv.host, srv.port)
+            FAULTS.install("rpc.send", FailNth(1))
+            with pytest.raises(InjectedFault):
+                c.call("ping")
+            assert c.call("ping") == "pong"          # next call recovers
+            FAULTS.reset()
+            FAULTS.install("rpc.recv", FailNth(1))
+            with pytest.raises(InjectedFault):
+                c.call("ping")
+            FAULTS.reset()
+            c.close()
+        finally:
+            srv.close()
+
+    def test_concurrent_calls_do_not_serialize(self):
+        gate = threading.Event()
+
+        def handler(op, kw):
+            if op == "slow":
+                gate.wait(10)
+            return op
+
+        srv = self._server(handler)
+        try:
+            c = RpcClient(srv.host, srv.port)
+            t = threading.Thread(target=c.call, args=("slow",), daemon=True)
+            t.start()
+            time.sleep(0.1)
+            t0 = time.monotonic()
+            assert c.call("fast") == "fast"          # separate pooled socket
+            assert time.monotonic() - t0 < 5.0
+            gate.set()
+            t.join(10)
+            c.close()
+        finally:
+            srv.close()
+
+
+# --------------------------------------------------------------- supervisor
+
+class _FakeProc:
+    def __init__(self):
+        self.rc = None
+        self.terminated = False
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self):
+        self.terminated = True
+        self.rc = 0
+
+    def kill(self):
+        self.rc = -9
+
+    def wait(self, timeout=None):
+        return self.rc
+
+
+class _FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+class TestWorkerSupervisor:
+    def _sup(self, clock=None, **kw):
+        procs = []
+
+        def spawn():
+            p = _FakeProc()
+            procs.append(p)
+            return p
+
+        sleeps = []
+        sup = WorkerSupervisor(spawn, name="w", clock=clock or _FakeClock(),
+                               sleep=sleeps.append, **kw)
+        return sup, procs, sleeps
+
+    def test_running_child_is_left_alone(self):
+        sup, procs, _ = self._sup()
+        sup.start_worker()
+        assert sup.tick() == RUNNING
+        assert len(procs) == 1
+
+    def test_respawn_with_bounded_backoff(self):
+        clock = _FakeClock()
+        sup, procs, sleeps = self._sup(clock=clock, base_delay=0.1,
+                                       multiplier=2.0, max_delay=0.3,
+                                       max_crashes=10, crash_window=100.0)
+        sup.start_worker()
+        for expected in (0.1, 0.2, 0.3, 0.3):        # capped at max_delay
+            procs[-1].rc = 1
+            clock.t += 1
+            assert sup.tick() == RESPAWNED
+            assert sleeps[-1] == pytest.approx(expected)
+        assert sup.restarts == 4
+        assert len(procs) == 5
+
+    def test_crash_loop_quarantines(self):
+        clock = _FakeClock()
+        alerts = []
+        sup, procs, _ = self._sup(clock=clock, max_crashes=3,
+                                  crash_window=10.0,
+                                  on_quarantine=alerts.append)
+        sup.on_quarantine = alerts.append
+        sup.start_worker()
+        for _ in range(2):
+            procs[-1].rc = 1
+            clock.t += 1
+            assert sup.tick() == RESPAWNED
+        procs[-1].rc = 1
+        clock.t += 1
+        assert sup.tick() == QUARANTINED
+        assert sup.quarantined and alerts == [sup]
+        assert sup.tick() == QUARANTINED             # stays down, no respawn
+        assert len(procs) == 3
+
+    def test_slow_crashes_outside_window_never_quarantine(self):
+        clock = _FakeClock()
+        sup, procs, _ = self._sup(clock=clock, max_crashes=3,
+                                  crash_window=10.0)
+        sup.start_worker()
+        for _ in range(6):                            # one crash per 60s
+            procs[-1].rc = 1
+            clock.t += 60
+            assert sup.tick() == RESPAWNED
+        assert not sup.quarantined
+
+    def test_reset_clears_quarantine(self):
+        clock = _FakeClock()
+        sup, procs, _ = self._sup(clock=clock, max_crashes=1)
+        sup.start_worker()
+        procs[-1].rc = 1
+        assert sup.tick() == QUARANTINED
+        sup.reset()
+        assert sup.tick() == RESPAWNED
+
+    def test_stop_terminates_child(self):
+        sup, procs, _ = self._sup()
+        sup.start_worker()
+        sup.stop()
+        assert procs[0].terminated
+        assert sup.tick() == "stopped"
+
+    def test_restart_metric_renders(self):
+        import paddle_tpu.observability as obs
+        obs.enable()
+        try:
+            clock = _FakeClock()
+            sup, procs, _ = self._sup(clock=clock, max_crashes=5)
+            sup.start_worker()
+            procs[-1].rc = 1
+            sup.tick()
+            text = obs.render_prometheus()
+            assert 'frontend_replica_restarts_total{replica="w"} 1' in text
+        finally:
+            obs.disable()
+            obs.reset()
+
+
+# ------------------------------------------- fleet end-to-end (tiny engines)
+
+def _tiny_model():
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=176,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=128)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_model()
+
+
+def _engine(model):
+    from paddle_tpu.inference.serving import LLMEngine
+    return LLMEngine(model, max_batch=3, max_len=64, page_size=8,
+                     prefix_cache=True)
+
+
+class _PinRouter:
+    """Deterministic routing for chaos tests: always pick the pinned
+    replica when it is in the candidate list, else the first candidate."""
+
+    def __init__(self):
+        self.pin = None
+
+    def route(self, prompt_ids, replicas):
+        rep = next((r for r in replicas if r.name == self.pin), replicas[0])
+        return RouteDecision(rep, "pinned")
+
+    def note_event(self, replica_name, event, key):
+        pass
+
+    def forget(self, name):
+        pass
+
+
+class _Fleet:
+    """Test harness: a fake-clock store + N thread-hosted WorkerServers +
+    one FleetReplicaSet.  kill() is SIGKILL-shaped: the worker's RPC
+    listener and step loop vanish, its lease does not."""
+
+    def __init__(self, model, n=2, ttl=5.0, group="fl"):
+        self.model = model
+        self.group = group
+        self.ttl = ttl
+        self.clock = _FakeClock(1000.0)
+        self.master = TCPStore(is_master=True, timeout=20)
+        self.workers = {}
+        self.router = _PinRouter()
+        self.fleet = FleetReplicaSet(self._store(), group=group, ttl=ttl,
+                                     clock=self.clock, router=self.router)
+        for i in range(n):
+            self.spawn(f"w{i}")
+        self.fleet.sync()
+
+    def _store(self):
+        return TCPStore(port=self.master.port, timeout=20)
+
+    def spawn(self, name):
+        w = WorkerServer(name, _engine(self.model), self._store(),
+                         group=self.group, ttl=self.ttl, clock=self.clock)
+        w.start(heartbeat=False)                     # tests renew by hand
+        self.workers[name] = w
+        return w
+
+    def kill(self, name):
+        w = self.workers.pop(name)
+        w.rpc.close()
+        w.replica.close()
+        return w
+
+    def renew_all(self):
+        for w in self.workers.values():
+            w.lease.renew()
+
+    def close(self):
+        self.fleet.close()
+        for name in list(self.workers):
+            self.workers[name].close(drain=False)
+
+
+@pytest.fixture()
+def fleet(model, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_PURE_PY_STORE", "1")
+    f = _Fleet(model, n=2)
+    yield f
+    f.close()
+
+
+def _reference_tokens(model, prompt, n=6):
+    eng = _engine(model)
+    rid = eng.add_request(list(prompt), max_new_tokens=n, do_sample=False)
+    eng.run_until_done()
+    return list(eng.result(rid))
+
+
+class TestFleetServing:
+    def test_members_join_and_stream_token_exact(self, fleet, model):
+        assert {r.name for r in fleet.fleet.alive_replicas()} == {"w0", "w1"}
+        prompt = list(range(1, 17))
+        ref = _reference_tokens(model, prompt)
+        h = fleet.fleet.submit(prompt, max_new_tokens=6, do_sample=False)
+        assert list(fleet.fleet.stream(h)) == ref
+        assert fleet.fleet.status(h).terminal
+
+    def test_worker_drain_sheds_typed_over_rpc(self, fleet):
+        w = fleet.workers["w0"]
+        w.draining = True
+        rep = fleet.fleet.replica("w0")
+        with pytest.raises(ShedError) as ei:
+            rep.submit(list(range(16)), max_new_tokens=4)
+        assert ei.value.reason == "draining"
+        assert rep.alive                             # shed is not death
+
+    def test_clean_release_emits_leave_not_expire(self, fleet):
+        fleet.workers["w1"].lease.release()
+        evs = fleet.fleet.sync()
+        assert [(e.kind, e.member.name) for e in evs] == [("leave", "w1")]
+        assert {r.name for r in fleet.fleet.alive_replicas()} == {"w0"}
+
+    def test_prefix_keys_warm_router_on_join(self, fleet, model):
+        # run one request through w0 so its cache holds prefix pages, then
+        # stand up a fresh fleet view: the join must import those keys
+        fleet.router.pin = "w0"
+        prompt = list(range(1, 25))
+        h = fleet.fleet.submit(prompt, max_new_tokens=4, do_sample=False)
+        list(fleet.fleet.stream(h))
+        from paddle_tpu.inference.frontend.router import PrefixAffinityRouter
+        router2 = PrefixAffinityRouter(page_size=8)
+        fleet2 = FleetReplicaSet(fleet._store(), group=fleet.group,
+                                 ttl=fleet.ttl, clock=fleet.clock,
+                                 router=router2)
+        try:
+            fleet2.sync()
+            assert router2.known_keys("w0")          # warmed from snapshot
+        finally:
+            fleet2.close()
+
+
+class TestFleetChaos:
+    """The deterministic ISSUE 10 acceptance scenario."""
+
+    def test_kill_mid_stream_full_recovery(self, fleet, model):
+        import paddle_tpu.observability as obs
+        obs.enable()
+        try:
+            self._scenario(fleet, model)
+        finally:
+            obs.disable()
+            obs.reset()
+
+    def _scenario(self, fleet, model):
+        fs = fleet.fleet
+        prompt_a = list(range(1, 17))                # partially-streamed victim
+        prompt_b = list(range(30, 46))               # zero-token victim
+        prompt_c = list(range(60, 76))               # survivor
+        ref_b = _reference_tokens(model, prompt_b)
+        ref_c = _reference_tokens(model, prompt_c)
+
+        fleet.router.pin = "w0"
+        h_a = fs.submit(prompt_a, max_new_tokens=6, do_sample=False)
+        h_b = fs.submit(prompt_b, max_new_tokens=6, do_sample=False)
+        fleet.router.pin = "w1"
+        h_c = fs.submit(prompt_c, max_new_tokens=6, do_sample=False)
+        assert (h_a.replica.name, h_b.replica.name,
+                h_c.replica.name) == ("w0", "w0", "w1")
+
+        # stream two tokens of A, none of B, one of C, then kill w0
+        stream_a = fs.stream(h_a)
+        got_a = [next(stream_a), next(stream_a)]
+        stream_c = fs.stream(h_c)
+        got_c = [next(stream_c)]
+        fleet.kill("w0")
+        fleet.router.pin = None
+
+        # zero-token victim: requeued once onto w1 and token-exact
+        toks_b = list(fs.stream(h_b))
+        assert h_b.requeued and h_b.replica.name == "w1"
+        assert toks_b == ref_b
+        assert fs.status(h_b).terminal
+
+        # partially-streamed victim: typed FAILED, never requeued
+        got_a += list(stream_a)
+        assert fs.status(h_a) is RequestStatus.FAILED
+        assert not h_a.requeued
+        assert "w0" in fs.request_error(h_a)
+
+        # survivor: token-exact to the single-engine reference
+        got_c += list(stream_c)
+        assert got_c == ref_c
+
+        # lease expiry: w1 renews, w0 cannot; one TTL later it expires
+        fleet.renew_all()
+        fleet.clock.t += fleet.ttl + 0.5
+        fleet.workers["w1"].lease.renew()
+        evs = fs.sync()
+        assert [(e.kind, e.member.name) for e in evs] == [("expire", "w0")]
+        assert {r.name for r in fs.alive_replicas()} == {"w1"}
+
+        # supervisor respawn: new incarnation registers under epoch 2 and
+        # rejoins routing within one lease TTL of the respawn
+        sup = WorkerSupervisor(lambda: _RespawnHandle(fleet, "w0"),
+                               name="w0", clock=fleet.clock,
+                               sleep=lambda s: None, max_crashes=5)
+        sup.start_worker()
+        assert sup.tick() == RUNNING
+        fleet.clock.t += fleet.ttl / 2               # < one TTL
+        evs = fs.sync()
+        assert [(e.kind, e.member.name, e.member.epoch)
+                for e in evs] == [("join", "w0", 2)]
+        assert {r.name for r in fs.alive_replicas()} == {"w0", "w1"}
+
+        # the respawned worker serves token-exact streams again
+        fleet.router.pin = "w0"
+        h = fs.submit(prompt_b, max_new_tokens=6, do_sample=False)
+        assert h.replica.name == "w0" and h.replica.epoch == 2
+        assert list(fs.stream(h)) == ref_b
+
+        # all three acceptance metric families are visible
+        import paddle_tpu.observability as obs
+        text = obs.render_prometheus()
+        assert ('membership_lease_expiries_total{group="%s"} 1'
+                % fleet.group) in text
+        assert "frontend_requeued_total 1" in text
+        assert 'frontend_replica_restarts_total' in text
+
+    def test_gateway_keeps_serving_through_kill(self, fleet, model):
+        from paddle_tpu.inference.frontend import start_gateway
+        prompt = list(range(1, 17))
+        ref = _reference_tokens(model, prompt)
+        gw = start_gateway(fleet.fleet)
+        try:
+            fleet.router.pin = "w0"
+            body = self._post(gw.url, prompt)
+            assert body["tokens"] == ref
+            fleet.kill("w0")
+            fleet.router.pin = None
+            body = self._post(gw.url, prompt)        # routed to the survivor
+            assert body["tokens"] == ref and body["replica"] == "w1"
+        finally:
+            gw.close()
+
+    def _post(self, url, prompt, **extra):
+        req = urllib.request.Request(
+            url + "/v1/completions",
+            data=json.dumps({"prompt": prompt, "max_tokens": 6,
+                             "do_sample": False, **extra}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return json.loads(resp.read())
+
+
+class _RespawnHandle:
+    """Process-handle shim the supervisor drives in the deterministic test:
+    'spawning' is standing up a fresh thread-hosted WorkerServer."""
+
+    def __init__(self, harness, name):
+        self.worker = harness.spawn(name)
+
+    def poll(self):
+        return None if self.worker.replica.alive else 1
+
+    def terminate(self):
+        self.worker.close(drain=False)
+
+    def kill(self):
+        self.terminate()
+
+    def wait(self, timeout=None):
+        return 0
+
+
+class TestGatewayDeadFleet:
+    def test_dead_fleet_503_carries_retry_after(self, model, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_PURE_PY_STORE", "1")
+        from paddle_tpu.inference.frontend import start_gateway
+        master = TCPStore(is_master=True, timeout=20)
+        fleet = FleetReplicaSet(TCPStore(port=master.port, timeout=20),
+                                group="empty", clock=_FakeClock())
+        gw = start_gateway(fleet)
+        try:
+            req = urllib.request.Request(
+                gw.url + "/v1/completions",
+                data=json.dumps({"prompt": [1, 2, 3],
+                                 "max_tokens": 2}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 503
+            assert ei.value.headers.get("Retry-After") is not None
+        finally:
+            gw.close()
+            fleet.close()
+
+
+# ------------------------------------------------- real processes (slow tier)
+
+@pytest.mark.slow
+class TestRealKillNine:
+    def test_sigkill_worker_subprocess(self, tmp_path, monkeypatch):
+        """Real worker subprocesses, a real SIGKILL, wall-clock leases."""
+        monkeypatch.setenv("PADDLE_TPU_PURE_PY_STORE", "1")
+        master = TCPStore(is_master=True, timeout=60)
+        spec = os.path.join(os.path.dirname(__file__),
+                            "_fleet_worker_spec.py")
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "PADDLE_TPU_PURE_PY_STORE": "1"}
+        ttl = 3.0
+        procs = []
+
+        def spawn(name):
+            p = subprocess.Popen(
+                [sys.executable, "-m",
+                 "paddle_tpu.inference.frontend.worker",
+                 "--engine-spec", f"{spec}:make_engine",
+                 "--name", name, "--store-port", str(master.port),
+                 "--group", "real", "--ttl", str(ttl)],
+                env=env, cwd=os.path.dirname(os.path.dirname(spec)))
+            procs.append(p)
+            return p
+
+        fleet = FleetReplicaSet(TCPStore(port=master.port, timeout=60),
+                                group="real", ttl=ttl)
+        try:
+            spawn("w0")
+            spawn("w1")
+            deadline = time.monotonic() + 180
+            while (len(fleet.alive_replicas()) < 2
+                   and time.monotonic() < deadline):
+                fleet.sync()
+                time.sleep(0.5)
+            assert len(fleet.alive_replicas()) == 2, "workers never joined"
+
+            prompt = list(range(1, 17))
+            h0 = fleet.submit(prompt, max_new_tokens=6, do_sample=False)
+            ref = list(fleet.stream(h0))
+            assert len(ref) == 6
+
+            # submit, then SIGKILL the routed worker before polling a token
+            h = fleet.submit(prompt, max_new_tokens=6, do_sample=False)
+            victim = h.replica.name
+            pid = fleet.membership.members()[victim].meta["pid"]
+            os.kill(pid, signal.SIGKILL)
+            toks = list(fleet.stream(h))
+            assert h.requeued and h.replica.name != victim
+            assert toks == ref                        # token-exact recovery
+
+            # expiry + respawn: the dead member leaves within ~one TTL,
+            # a respawned process rejoins under a new epoch
+            deadline = time.monotonic() + ttl * 4
+            gone = False
+            while time.monotonic() < deadline and not gone:
+                gone = any(e.kind == "expire" and e.member.name == victim
+                           for e in fleet.sync())
+                time.sleep(0.2)
+            assert gone, "dead worker's lease never expired"
+            spawn(victim)
+            deadline = time.monotonic() + 180
+            rejoined = None
+            while time.monotonic() < deadline and rejoined is None:
+                for e in fleet.sync():
+                    if e.kind == "join" and e.member.name == victim:
+                        rejoined = e.member
+                time.sleep(0.5)
+            assert rejoined is not None and rejoined.epoch == 2
+            h2 = fleet.submit(prompt, max_new_tokens=6, do_sample=False)
+            assert list(fleet.stream(h2)) == ref
+        finally:
+            fleet.close()
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()

@@ -1,0 +1,284 @@
+"""Lease-based membership over TCPStore: CAS/delete store primitives, the
+register/renew/expire/release lifecycle, epoch bumps across restarts, the
+heartbeat thread, fault injection, and the membership metric families.
+
+Everything runs against the pure-Python store server (the native daemon is
+once-per-process; its protocol parity is covered in test_native_store.py)
+with an injectable clock, so every expiry in here is a clock assignment,
+never a sleep."""
+import pickle
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.core.retry import RetryPolicy
+from paddle_tpu.distributed.membership import (EXPIRE, JOIN, LEAVE,
+                                               LeaseLostError,
+                                               MembershipService)
+from paddle_tpu.distributed.store import StoreKeyDeleted, TCPStore
+from paddle_tpu.testing import FAULTS, Always, FailNth
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+@pytest.fixture()
+def store(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_PURE_PY_STORE", "1")
+    master = TCPStore(is_master=True, timeout=20)
+    yield master
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+def _service(store, clock, group="g", ttl=2.0, attempts=2):
+    return MembershipService(
+        store, group=group, ttl=ttl, clock=clock,
+        retry_policy=RetryPolicy(max_attempts=attempts, base_delay=0.0,
+                                 max_delay=0.0))
+
+
+# ---------------------------------------------------- store lease primitives
+
+class TestStorePrimitives:
+    def test_cas_expect_absent_then_token_swap(self, store):
+        ok, cur = store.compare_and_set("k", None, {"v": 1})
+        assert ok and pickle.loads(cur) == {"v": 1}
+        raw = store.get_raw("k")
+        ok, _ = store.compare_and_set("k", b"not-the-token", {"v": 2})
+        assert not ok and store.get("k") == {"v": 1}
+        ok, _ = store.compare_and_set("k", raw, {"v": 2})
+        assert ok and store.get("k") == {"v": 2}
+
+    def test_cas_expect_absent_fails_on_existing(self, store):
+        store.set("k", 1)
+        ok, cur = store.compare_and_set("k", None, 2)
+        assert not ok and pickle.loads(cur) == 1
+
+    def test_cas_rejects_non_bytes_expected(self, store):
+        with pytest.raises(TypeError):
+            store.compare_and_set("k", {"v": 1}, {"v": 2})
+        with pytest.raises(ValueError):
+            store.compare_and_set("k", b"", {"v": 2})
+
+    def test_cas_loop_under_contention(self, store):
+        # two clients CAS-appending concurrently must not lose updates
+        other = TCPStore(port=store.port, timeout=20)
+
+        def add(client, items):
+            for it in items:
+                while True:
+                    try:
+                        raw = client.get_raw("set", timeout=0.05)
+                    except (TimeoutError, StoreKeyDeleted):
+                        raw = None
+                    cur = set(pickle.loads(raw)) if raw else set()
+                    if client.compare_and_set("set", raw,
+                                              sorted(cur | {it}))[0]:
+                        break
+
+        t = threading.Thread(target=add, args=(other, range(0, 10)))
+        t.start()
+        add(store, range(10, 20))
+        t.join(30)
+        assert set(store.get("set")) == set(range(20))
+
+    def test_delete_mid_wait_is_typed(self, store):
+        res = {}
+
+        def blocked():
+            try:
+                store2 = TCPStore(port=store.port, timeout=20)
+                store2.get("dw", timeout=10)
+                res["r"] = "value"
+            except StoreKeyDeleted as e:
+                res["r"] = ("deleted", e.key)
+            except TimeoutError:
+                res["r"] = "timeout"
+
+        t = threading.Thread(target=blocked)
+        t.start()
+        time.sleep(0.3)
+        store.delete_key("dw")
+        t.join(15)
+        assert res.get("r") == ("deleted", "dw")
+
+    def test_absent_key_still_times_out(self, store):
+        with pytest.raises(TimeoutError):
+            store.get("never", timeout=0.1)
+        with pytest.raises(TimeoutError):
+            store.get_raw("never", timeout=0.1)
+
+
+# ------------------------------------------------------- lease lifecycle
+
+class TestMembershipLifecycle:
+    def test_join_events_and_members(self, store):
+        clock = _Clock()
+        svc = _service(store, clock)
+        w = svc.watch()
+        a = svc.register("a", meta={"port": 1})
+        svc.register("b", meta={"port": 2})
+        evs = w.poll()
+        assert [(e.kind, e.member.name) for e in evs] == [
+            (JOIN, "a"), (JOIN, "b")]
+        assert a.epoch == 1
+        assert set(svc.members()) == {"a", "b"}
+        assert svc.members()["a"].meta == {"port": 1}
+        assert w.poll() == []                       # steady state is quiet
+
+    def test_renew_extends_and_expiry_reaps(self, store):
+        clock = _Clock()
+        svc = _service(store, clock, ttl=2.0)
+        w = svc.watch()
+        a = svc.register("a")
+        svc.register("b")
+        w.poll()
+        clock.t += 1.5
+        a.renew()                                    # a now expires at +3.5
+        assert w.poll() == []
+        clock.t += 1.0                               # b's lease (+2.0) lapsed
+        evs = w.poll()
+        assert [(e.kind, e.member.name) for e in evs] == [(EXPIRE, "b")]
+        assert set(w.members()) == {"a"}
+        assert set(svc.members()) == {"a"}           # record reaped
+
+    def test_release_emits_leave_immediately(self, store):
+        clock = _Clock()
+        svc = _service(store, clock)
+        w = svc.watch()
+        a = svc.register("a")
+        w.poll()
+        a.release()
+        evs = w.poll()
+        assert [(e.kind, e.member.name) for e in evs] == [(LEAVE, "a")]
+        a.release()                                  # idempotent
+
+    def test_reregistration_bumps_epoch(self, store):
+        clock = _Clock()
+        svc = _service(store, clock, ttl=1.0)
+        w = svc.watch()
+        first = svc.register("a")
+        w.poll()
+        clock.t += 5                                 # die unrenewed
+        assert [e.kind for e in w.poll()] == [EXPIRE]
+        second = svc.register("a")
+        assert second.epoch == first.epoch + 1
+        evs = w.poll()
+        assert [(e.kind, e.member.epoch) for e in evs] == [(JOIN, 2)]
+
+    def test_epoch_bump_visible_without_expiry_gap(self, store):
+        # watcher that never saw the death still reports the respawn as a
+        # join (epoch changed under the same name)
+        clock = _Clock()
+        svc = _service(store, clock, ttl=10.0)
+        w = svc.watch()
+        svc.register("a")
+        w.poll()
+        svc.register("a")                            # new incarnation
+        evs = w.poll()
+        assert [(e.kind, e.member.epoch) for e in evs] == [(JOIN, 2)]
+
+    def test_fresh_watcher_sees_current_members_as_joins(self, store):
+        clock = _Clock()
+        svc = _service(store, clock)
+        svc.register("a")
+        svc.register("b")
+        evs = svc.watch().poll()
+        assert [(e.kind, e.member.name) for e in evs] == [
+            (JOIN, "a"), (JOIN, "b")]
+
+
+# ------------------------------------------------------ heartbeat + faults
+
+class TestHeartbeatAndFaults:
+    def test_register_fault_point(self, store):
+        svc = _service(store, _Clock())
+        FAULTS.install("membership.register", Always())
+        with pytest.raises(Exception):
+            svc.register("a")
+        FAULTS.reset()
+        svc.register("a")                            # recovers once disarmed
+
+    def test_renew_retries_through_transient_fault(self, store):
+        svc = _service(store, _Clock(), attempts=3)
+        lease = svc.register("a")
+        FAULTS.install("membership.heartbeat", FailNth(1))
+        lease.renew()                                # attempt 2 succeeds
+        assert not lease.lost
+
+    def test_renew_exhaustion_marks_lease_lost(self, store):
+        svc = _service(store, _Clock(), attempts=2)
+        lease = svc.register("a")
+        FAULTS.install("membership.heartbeat", Always())
+        with pytest.raises(LeaseLostError):
+            lease.renew()
+        assert lease.lost
+
+    def test_heartbeat_thread_keeps_lease_alive(self, store):
+        # wall-clock service (real renewals) with a tight ttl: the thread
+        # must keep the member alive across several ttl windows
+        svc = MembershipService(store, group="hb", ttl=0.4)
+        w = svc.watch()
+        lease = svc.register("a")
+        lease.start_heartbeat(interval=0.05)
+        try:
+            time.sleep(1.0)
+            assert [e.kind for e in w.poll()] in ([JOIN], [])
+            assert set(w.members() or svc.members()) == {"a"}
+            assert not lease.lost
+        finally:
+            lease.release()
+
+    def test_heartbeat_thread_reports_loss(self, store):
+        lost = []
+        svc = MembershipService(
+            store, group="hb2", ttl=0.4,
+            retry_policy=RetryPolicy(max_attempts=2, base_delay=0.0,
+                                     max_delay=0.0))
+        lease = svc.register("a")
+        FAULTS.install("membership.heartbeat", Always())
+        lease.start_heartbeat(interval=0.05, on_lost=lost.append)
+        deadline = time.monotonic() + 10
+        while not lost and time.monotonic() < deadline:
+            time.sleep(0.02)
+        lease.stop_heartbeat()
+        assert lost and isinstance(lost[0], LeaseLostError)
+        assert lease.lost
+
+
+# --------------------------------------------------------------- metrics
+
+class TestMembershipMetrics:
+    def test_expiry_and_event_counters_render(self, store):
+        import paddle_tpu.observability as obs
+        obs.enable()
+        try:
+            clock = _Clock()
+            svc = _service(store, clock, group="mg", ttl=1.0)
+            w = svc.watch()
+            lease = svc.register("a")
+            w.poll()
+            lease.renew()                            # histogram sample
+            clock.t += 50
+            w.poll()                                 # expire
+            text = obs.render_prometheus()
+            assert 'membership_lease_expiries_total{group="mg"} 1' in text
+            assert 'membership_events_total{group="mg",kind="join"} 1' in text
+            assert ('membership_events_total{group="mg",kind="expire"} 1'
+                    in text)
+            assert 'membership_heartbeat_seconds_count{group="mg"} 1' in text
+        finally:
+            obs.disable()
+            obs.reset()

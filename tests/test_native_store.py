@@ -48,6 +48,57 @@ print("NATIVE_OK")
                            env={**os.environ, "JAX_PLATFORMS": "cpu"})
         assert "NATIVE_OK" in r.stdout, r.stderr[-2000:]
 
+    def test_native_cas_and_deleted_miss(self):
+        from paddle_tpu.core.native.build import load
+        if load("pt_store", "store.cc") is None:
+            pytest.skip("no C++ toolchain")
+        code = """
+import threading, time
+from paddle_tpu.distributed.store import TCPStore, StoreKeyDeleted
+m = TCPStore(is_master=True, timeout=20)
+assert m.server_kind == "native", m.server_kind
+c = TCPStore(host="127.0.0.1", port=m.port, timeout=20)
+# expect-absent install, then raw-token swap semantics
+ok, cur = c.compare_and_set("k", None, ["v1"])
+assert ok
+raw = c.get_raw("k")
+ok, _ = c.compare_and_set("k", b"stale-token", ["v2"])
+assert not ok
+ok, _ = c.compare_and_set("k", raw, ["v2"])
+assert ok and c.get("k") == ["v2"]
+ok, _ = c.compare_and_set("k", None, ["v3"])
+assert not ok and c.get("k") == ["v2"]
+# DELETE observed by a blocked GET -> typed miss, not a timeout stall
+# (DELETE bumps the key's generation even when absent, so this is
+# deterministic: the blocked reader always sees the bump)
+res = {}
+def blocked():
+    try:
+        c.get("dw", timeout=10)
+        res["r"] = "value"
+    except StoreKeyDeleted:
+        res["r"] = "deleted"
+    except TimeoutError:
+        res["r"] = "timeout"
+t = threading.Thread(target=blocked)
+t.start()
+time.sleep(0.3)
+m.delete_key("dw")
+t.join(15)
+assert res.get("r") == "deleted", res
+# a plain absent-key read still times out as before
+try:
+    c.get("never-set", timeout=0.1)
+    raise SystemExit("expected TimeoutError")
+except TimeoutError:
+    pass
+print("NATIVE_CAS_OK")
+"""
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=120,
+                           env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert "NATIVE_CAS_OK" in r.stdout, r.stderr[-2000:]
+
     def test_python_fallback(self, monkeypatch):
         monkeypatch.setenv("PADDLE_TPU_PURE_PY_STORE", "1")
         from paddle_tpu.distributed.store import TCPStore
