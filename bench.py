@@ -892,8 +892,10 @@ def _frontend_degraded(m, max_len, page, prefix_pages, suffix, new):
     at t=50% of the clean wall: heartbeats stop and the RPC socket drops,
     which is a crash/`kill -9` as the fleet observes it.  Reports recovery
     time (kill → dead replica evicted from routing), how many inflight
-    requests were transparently requeued onto the survivor, and p95 TTFT
-    clean vs faulted."""
+    requests were transparently requeued (zero tokens streamed) or RESUMED
+    (partially streamed, emitted history re-prefilled on the survivor) and
+    the mean resume-splice latency (death detection → first post-resume
+    token), and p95 TTFT clean vs faulted."""
     import threading
 
     from paddle_tpu.distributed.store import TCPStore
@@ -1004,13 +1006,26 @@ def _frontend_degraded(m, max_len, page, prefix_pages, suffix, new):
             "ttft_p95_s": round(percentile(ttfts, 95), 4) if ttfts
             else None,
             "requeued": sum(1 for h in handles if h.requeued),
+            "resumed": sum(1 for h in handles if h.resumed),
         }
         if kill_at is not None:
             res["recovery_s"] = recovery.get("s")
         return res
 
     clean = _run()
-    faulted = _run(kill_at=max(0.05, clean["wall_s"] * 0.5))
+    from paddle_tpu import observability as _obs
+    _obs.enable()
+    try:
+        faulted = _run(kill_at=max(0.05, clean["wall_s"] * 0.5))
+        splice = _obs.snapshot(prefix="frontend_resume_splice_seconds")
+    finally:
+        _obs.disable()
+        _obs.reset()
+    series = (splice.get("frontend_resume_splice_seconds") or
+              {}).get("series") or []
+    n = sum(s["count"] for s in series)
+    faulted["resume_splice_mean_s"] = (
+        round(sum(s["sum"] for s in series) / n, 4) if n else None)
     return {"replicas": 2, "lease_ttl_s": TTL, "clean": clean,
             "faulted": faulted}
 

@@ -16,13 +16,24 @@ expiries; the retry helper re-raises after backoff).  Flagged:
     blocks interpreter exit and outlives every ``close()``.  The fleet's
     worker/supervisor/heartbeat threads are the motivating consumers: each
     is ``daemon=True`` AND joined on its shutdown path.  (RB103)
+  * a bare ``time.sleep(...)`` inside a retry loop — a ``while``/``for``
+    whose body both attempts a call under ``try``/``except`` and sleeps
+    between attempts.  That is a hand-rolled retry with a flat, unjittered,
+    uncounted backoff; ``core/retry.py`` (``RetryPolicy`` + ``retry_call``)
+    is the shared policy such loops bypass: capped exponential backoff,
+    seeded jitter against stampedes, attempt telemetry.  (RB104)
 
 Narrow handlers (``except KeyError: continue``) are idiomatic probing and
 stay silent, as are broad handlers that do anything observable (log, count,
 record) before escaping.  A thread constructed with ``daemon=True`` (or a
 non-literal ``daemon=`` the pass can't evaluate) passes RB103, as does any
 thread whose storage target is joined somewhere in its enclosing class or
-function.  Deliberate exceptions carry a line pragma or a baseline entry.
+function.  RB104 only fires on the literal ``time.sleep`` spelling inside a
+loop that also catches an attempt's failure: wait/poll loops with no
+``try`` (drain loops, boot-readiness spins) stay silent, and so does code
+taking an injectable ``sleep=`` callable — ``retry_call`` itself sleeps
+through its injected parameter, never ``time.sleep`` directly.  Deliberate
+exceptions carry a line pragma or a baseline entry.
 """
 from __future__ import annotations
 
@@ -37,6 +48,11 @@ _HINT = ("handle the error, re-raise, or log it (module logger / "
 _THREAD_HINT = ("pass daemon=True at construction, or join() the thread on "
                 "the owner's shutdown path (close/stop); do both for "
                 "threads that must not outlive their owner")
+
+_RETRY_HINT = ("use core.retry.retry_call / RetryPolicy (capped exponential "
+               "backoff, seeded jitter, attempt telemetry) instead of a "
+               "hand-rolled sleep loop; a deliberate flat-sleep loop "
+               "carries a pragma or baseline entry")
 
 _BROAD = ("Exception", "BaseException")
 
@@ -78,6 +94,46 @@ def _escapes(handler):
                                   and stmt.value.value is None):
             return "return"
     return False
+
+
+def _loop_scope_walk(loop):
+    """Walk a loop body without crossing into nested loops' or nested
+    defs' bodies: a closure defined inside the loop sleeping on its own
+    schedule is not THIS loop retrying, and an inner loop gets its own
+    RB104 decision."""
+    stack = list(loop.body)          # orelse is the no-break exit, not a turn
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.While, ast.For, ast.AsyncFor,
+                             ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_time_sleep(call):
+    """The literal ``time.sleep(...)`` spelling only: an injectable
+    ``sleep=`` callable (core.retry's own discipline) never matches."""
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr == "sleep"
+            and isinstance(f.value, ast.Name) and f.value.id == "time")
+
+
+def _retry_sleeps(loop):
+    """RB104 sites in ``loop``: the ``time.sleep`` calls of a loop body
+    that also attempts a call under ``try``/``except`` — the shape of a
+    hand-rolled retry.  A sleeping loop with no handler (drain/poll spin)
+    yields nothing."""
+    sleeps, attempts = [], False
+    for node in _loop_scope_walk(loop):
+        if isinstance(node, ast.Call) and _is_time_sleep(node):
+            sleeps.append(node)
+        elif isinstance(node, ast.Try) and node.handlers and any(
+                isinstance(c, ast.Call)
+                for stmt in node.body for c in ast.walk(stmt)):
+            attempts = True
+    return sleeps if attempts else []
 
 
 def _is_thread_ctor(call):
@@ -154,11 +210,13 @@ def _target_released(scope, target):
 @register_pass
 class RobustnessPass(AnalysisPass):
     name = "robustness"
-    version = 3
+    version = 4
     description = ("swallowed exceptions: broad except handlers whose "
                    "whole body is pass (RB101) or a bare "
                    "continue/break/return (RB102); orphan threads: "
-                   "non-daemon Thread never joined (RB103)")
+                   "non-daemon Thread never joined (RB103); hand-rolled "
+                   "retry loops sleeping through time.sleep instead of "
+                   "core.retry (RB104)")
 
     def check_file(self, src) -> list[Finding]:
         findings: list[Finding] = []
@@ -171,6 +229,8 @@ class RobustnessPass(AnalysisPass):
                 findings.extend(self._check_handler(src, node))
             elif isinstance(node, ast.Call) and _is_thread_ctor(node):
                 findings.extend(self._check_thread(src, node, parents))
+            elif isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+                findings.extend(self._check_retry_loop(src, node))
         return findings
 
     def _check_handler(self, src, node):
@@ -191,6 +251,16 @@ class RobustnessPass(AnalysisPass):
                 f"drops the iteration's work",
                 _HINT, severity="warning")]
         return []
+
+    def _check_retry_loop(self, src, loop):
+        kind = "while" if isinstance(loop, ast.While) else "for"
+        return [Finding(
+            self.name, "RB104", src.path, call.lineno,
+            f"bare time.sleep inside a {kind} retry loop — flat, "
+            f"unjittered, uncounted backoff bypassing core.retry's "
+            f"RetryPolicy",
+            _RETRY_HINT, severity="warning")
+            for call in _retry_sleeps(loop)]
 
     def _check_thread(self, src, call, parents):
         if _daemon_safe(call):

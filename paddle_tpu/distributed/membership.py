@@ -153,6 +153,16 @@ class MembershipService:
         finally:
             self._index_update(lambda names: names - {name})
 
+    def evict(self, name):
+        """Administratively remove ``name`` from the group NOW — watchers
+        observe ``leave`` on their next poll instead of waiting out the TTL.
+        This is the third-party counterpart of :meth:`Lease.release` for
+        members that cannot release themselves: the supervisor evicts a
+        quarantined crash-looper so routers stop selecting it immediately.
+        Idempotent; a concurrent release/expiry of the same name is
+        harmless (both paths reap the same record)."""
+        self._remove_member(str(name))
+
     def _index_update(self, mutate):
         """Raw-bytes CAS loop over the index key — lost updates are
         impossible, concurrent mutators just retry on the fresh bytes."""

@@ -212,6 +212,7 @@ class LLMEngine(_LegacyDelegation, _SpecOrchestration):
         self.step_failures = 0          # step dispatches that raised
         self.step_retries = 0           # transient-path retry invocations
         self.quarantine_probes = 0      # single-slot isolation probes run
+        self.resume_admissions = 0      # requests admitted with resume_tokens
         # disaggregation seam: when set, a request whose prompt just
         # finished prefilling is handed to the sink (which detaches it for
         # KV handoff) instead of decoding here — see disagg.DisaggEngine
@@ -220,15 +221,30 @@ class LLMEngine(_LegacyDelegation, _SpecOrchestration):
     # ------------------------------------------------------------- scheduling
     def add_request(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
                     do_sample=False, temperature=1.0, top_p=1.0, top_k=0,
-                    seed=None, deadline=None):
+                    seed=None, deadline=None, resume_tokens=None):
         """Submit a request; returns its rid.  ``deadline`` (seconds,
         default ``default_deadline``) bounds its total wall time.  Admission
         control may refuse it: the rid is still returned, but the request is
         already terminal with :attr:`RequestStatus.SHED` (check
-        :meth:`status`) — malformed arguments still raise."""
+        :meth:`status`) — malformed arguments still raise.
+
+        ``resume_tokens``: output history already emitted by a previous
+        incarnation of this request (the durable-resume path after a replica
+        death).  The history counts as prefill context — it folds into the
+        prompt exactly like preemption folds ``prompt0 + out``, so the first
+        token generated here continues the sequence and the stream accessors
+        emit only NEW tokens; ``max_new_tokens`` is the REMAINING budget.
+        Warm prefix-cache pages make the re-prefill cheap.  Token-exactness
+        of the continuation: greedy sampling depends only on the context,
+        and a fixed ``seed`` keys the sampler identically at every position
+        (the generate-parity scheme), so the token at each position is a
+        pure function of (seed, context) — identical whether or not the
+        request was interrupted.  Seedless ``do_sample`` draws from the
+        engine's global counter and promises no cross-replica determinism."""
         n_prompt = int(np.asarray(prompt_ids).reshape(-1).shape[0])
         if n_prompt == 0:
             raise ValueError("empty prompt")
+        n_prompt += len(resume_tokens) if resume_tokens is not None else 0
         if int(max_new_tokens) < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if n_prompt + int(max_new_tokens) > self.max_len:
@@ -246,8 +262,11 @@ class LLMEngine(_LegacyDelegation, _SpecOrchestration):
             deadline = self.default_deadline
         r = Request(self._next_rid, prompt_ids, max_new_tokens, eos_token_id,
                     do_sample=do_sample, temperature=temperature,
-                    top_p=top_p, top_k=top_k, seed=seed, deadline=deadline)
+                    top_p=top_p, top_k=top_k, seed=seed, deadline=deadline,
+                    resume_tokens=resume_tokens)
         self._next_rid += 1
+        if r.resumed_from:
+            self.resume_admissions += 1
         if deadline is not None:
             self._any_deadline = True
         if self.sched.should_shed():
@@ -726,5 +745,6 @@ class LLMEngine(_LegacyDelegation, _SpecOrchestration):
             "step_failures": self.step_failures,
             "step_retries": self.step_retries,
             "quarantine_probes": self.quarantine_probes,
+            "resume_admissions": self.resume_admissions,
             "preemptions": self.sched.preemptions,
         }

@@ -66,9 +66,20 @@ TERMINAL_STATUSES = tuple(s for s in RequestStatus if s.terminal)
 class Request:
     def __init__(self, rid, prompt_ids, max_new_tokens, eos_token_id=None,
                  do_sample=False, temperature=1.0, top_p=1.0, top_k=0,
-                 seed=None, deadline=None):
+                 seed=None, deadline=None, resume_tokens=None):
+        """``resume_tokens``: output history from a previous incarnation of
+        this request (a replica that died mid-stream).  The history folds
+        into the prompt exactly like preemption folds ``prompt0 + out`` —
+        it re-prefills as context, the first token sampled here continues
+        the sequence, and ``out`` holds only NEW tokens so the streaming
+        accessors never re-emit what the caller already has."""
         self.rid = rid
         self.prompt = list(int(t) for t in np.asarray(prompt_ids).reshape(-1))
+        self.resumed_from = 0
+        if resume_tokens is not None:
+            resume = [int(t) for t in resume_tokens]
+            self.prompt += resume
+            self.resumed_from = len(resume)
         self.prompt0 = list(self.prompt)   # original; preemption re-folds
         self.max_new = int(max_new_tokens)
         self.eos = eos_token_id

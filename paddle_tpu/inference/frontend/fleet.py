@@ -19,7 +19,10 @@ replica list is a **fold over membership events**:
 
 Crash recovery itself lives in the base class (``requeue=True`` here by
 default): a request that has streamed zero tokens is resubmitted once onto
-a surviving replica, anything partially streamed fails typed FAILED.
+a surviving replica; one that already streamed tokens is resumed once —
+resubmitted with its emitted history as ``resume_tokens`` so the survivor
+re-prefills prompt + history and continues token-exact.  Either way only a
+second death fails the request typed FAILED.
 
 ``sync()`` is one deterministic membership tick (tests drive it with a
 fake clock); ``start_sync()`` wraps it in a daemon thread for wall-clock

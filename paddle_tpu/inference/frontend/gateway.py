@@ -73,6 +73,7 @@ class Gateway:
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     replica_set = None       # bound per-server by start_gateway
+    ping_interval = 5.0      # idle seconds between SSE keep-alive comments
 
     # ---- GET -----------------------------------------------------------------
     def do_GET(self):  # noqa: N802 (stdlib handler API)
@@ -162,7 +163,16 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         try:
             i = 0
-            for tok in rs.stream(handle):
+            for tok in rs.stream(handle, heartbeat=self.ping_interval):
+                if tok is None:
+                    # idle keep-alive: proxies don't sever a silent stream
+                    # during a long prefill/queue wait, and a client that
+                    # dropped before the first token fails THIS write — the
+                    # except below then cancels on the replica instead of
+                    # decoding for nobody
+                    self.wfile.write(b": ping\n\n")
+                    self.wfile.flush()
+                    continue
                 self._sse({"token": int(tok), "index": i})
                 i += 1
             status = rs.status(handle)
@@ -203,12 +213,16 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
 
-def start_gateway(replica_set, port=0, addr="127.0.0.1"):
+def start_gateway(replica_set, port=0, addr="127.0.0.1", ping_interval=5.0):
     """Serve ``replica_set`` at ``http://addr:port`` from a daemon thread;
     ``port=0`` lets the OS pick (read it back from the returned handle).
     The caller owns the handle: ``close()`` stops the HTTP server (the
-    replicas keep running until their owner closes them)."""
-    handler = type("_BoundHandler", (_Handler,), {"replica_set": replica_set})
+    replicas keep running until their owner closes them).  ``ping_interval``
+    is the idle-stream keep-alive cadence (seconds between ``: ping`` SSE
+    comments while no token is ready)."""
+    handler = type("_BoundHandler", (_Handler,),
+                   {"replica_set": replica_set,
+                    "ping_interval": float(ping_interval)})
     httpd = ThreadingHTTPServer((addr, port), handler)
     httpd.daemon_threads = True
     thread = threading.Thread(target=httpd.serve_forever,

@@ -4,22 +4,31 @@
 page refcounts, and the prefix index with no internal locking.  This module
 keeps that invariant while serving many concurrent callers by giving each
 replica ONE ``threading.Condition`` that serializes every engine touch — the
-step loop holds it per step, and ``submit`` / ``new_tokens`` / ``cancel`` /
-``health`` take it per call.  Streams block on the condition and are woken
-after every step, so token latency is one notify away from the engine's own
-cadence rather than a polling interval.
+step loop holds it per step, and ``submit`` / ``cancel`` / ``health`` take
+it per call.  Token DELIVERY does not ride that lock: each step publishes
+new tokens and statuses into a per-request outbox under a light condition
+of its own, and ``poll`` waits there — token latency stays one notify away
+from the engine's cadence, and a timed poll keeps its deadline even while
+a step holds the engine condition for seconds (jit compile, paced chaos
+steps), which is what SSE keep-alive heartbeats ride on.
 
 Replica death is a first-class event: when the step loop dies (an armed
 ``frontend.step`` fault, or an error that escapes the engine's own
 step-isolation machinery) the replica finalizes every inflight request as
 FAILED via ``LLMEngine.fail_all`` — streams observe a typed terminal status
 instead of hanging — drops its prefix-key mirror from the router, and is
-excluded from routing from then on.
+excluded from routing from then on.  With ``requeue=True`` the
+:class:`ReplicaSet` turns that death into recovery instead: zero-streamed
+requests requeue onto a survivor, partially-streamed ones resume with
+their emitted history (see :meth:`ReplicaSet._resume`).
 
 Fault points (see :mod:`paddle_tpu.testing.faults`): ``frontend.route``
 fires before routing, ``frontend.submit`` after a replica is chosen (ctx has
 ``replica``), ``frontend.step`` inside a replica's step loop (ctx has
-``replica``) — the chaos tests use the last to kill a replica mid-stream.
+``replica``) — the chaos tests use the last to kill a replica mid-stream —
+and ``frontend.resume`` inside the durable-resume attempt (ctx has the dead
+``replica``; arming it fails the one resume attempt, the only path on which
+a partially-streamed request may end FAILED).
 """
 from __future__ import annotations
 
@@ -42,7 +51,19 @@ class ReplicaDeadError(RuntimeError):
 
 class EngineReplica:
     """One engine + the lock that makes it multi-caller safe + the thread
-    that drives it.  All public methods are thread-safe."""
+    that drives it.  All public methods are thread-safe.
+
+    Token delivery is decoupled from the engine lock: after every step the
+    loop PUBLISHES each request's new tokens and status into a per-request
+    outbox guarded by its own light condition, and :meth:`poll` waits on
+    that outbox alone.  The engine condition is held for a step's whole
+    duration (first-call jit compile runs seconds; a fault-paced slow step
+    sleeps inside it), and a lock release followed by an immediate
+    re-acquire routinely barges past timed waiters — a poller contending on
+    the engine lock can starve for an entire decode burst and then receive
+    the whole batch at once.  Waiting on the outbox instead keeps timed
+    polls inside their deadline (SSE heartbeats depend on this) and token
+    latency at one notify."""
 
     def __init__(self, name, engine, router=None, poll_interval=0.05):
         self.name = str(name)
@@ -51,6 +72,11 @@ class EngineReplica:
         self.alive = True
         self.error = None
         self._cv = threading.Condition(threading.RLock())
+        # rid -> {"toks": [undelivered], "status": last published} — written
+        # by _publish (engine condition held), read/drained by poll under
+        # the light condition only.  Lock order: engine cv, then outbox cv.
+        self._out_cv = threading.Condition()
+        self._out = {}
         self._stop = False
         self._thread = None
         self._poll = float(poll_interval)
@@ -96,7 +122,32 @@ class EngineReplica:
                 except Exception as e:  # noqa: BLE001 — replica death boundary
                     self._die(e)
                     return
+                self._publish()
                 self._cv.notify_all()
+
+    def _publish(self):
+        """Move every tracked request's new tokens and current status from
+        the engine into the outbox and wake pollers.  Caller holds the
+        engine condition; terminal slots are already complete and skipped.
+        Terminal slots are retained (a drained slot is a status enum and an
+        empty list) so re-polls of a finished rid stay answerable — the
+        engine keeps its own finished table just the same."""
+        eng = self.engine
+        with self._out_cv:
+            changed = False
+            for rid, slot in self._out.items():
+                if slot["status"].terminal:
+                    continue
+                toks = eng.new_tokens(rid)
+                status = eng.status(rid)
+                if toks:
+                    slot["toks"].extend(int(t) for t in toks)
+                    changed = True
+                if status is not slot["status"]:
+                    slot["status"] = status
+                    changed = True
+            if changed:
+                self._out_cv.notify_all()
 
     def _die(self, error):
         """Step loop died: fail every inflight request with a typed terminal
@@ -109,6 +160,7 @@ class EngineReplica:
         finally:
             if self.router is not None:
                 self.router.forget(self.name)
+            self._publish()
             self._cv.notify_all()
 
     # ---- request facade ------------------------------------------------------
@@ -128,14 +180,40 @@ class EngineReplica:
                 raise ReplicaDeadError(
                     f"replica {self.name!r} is dead: {self.error!r}")
             rid = self.engine.add_request(prompt_ids, **kw)
+            with self._out_cv:
+                self._out[rid] = {"toks": [],
+                                  "status": self.engine.status(rid)}
             self._cv.notify_all()
             return rid
 
     def poll(self, rid, timeout=None):
         """Block until ``rid`` has new tokens or is terminal; returns
-        ``(tokens, status)``.  ``timeout`` bounds the wait — on expiry the
-        current (possibly empty) increment is returned with a live status."""
+        ``(tokens, status)``.  ``timeout`` bounds the WHOLE wait — the wait
+        happens on the outbox condition, which is never held across an
+        engine step, so a multi-second step (first-call jit compile, a
+        fault-paced slow step) cannot stall a timed poll past its deadline
+        and SSE heartbeats keep flowing.  On expiry the current (possibly
+        empty) increment is returned with the last published status."""
         deadline = None if timeout is None else time.monotonic() + timeout
+        with self._out_cv:
+            while True:
+                slot = self._out.get(rid)
+                if slot is None:
+                    break  # not submitted through this facade
+                toks, status = slot["toks"], slot["status"]
+                if toks or status.terminal:
+                    slot["toks"] = []
+                    return toks, status
+                if deadline is None:
+                    self._out_cv.wait(self._poll)
+                else:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        return [], status
+                    self._out_cv.wait(min(left, self._poll))
+        # fallback for rids the engine was handed directly: read under the
+        # engine condition (may block for a step; such callers own the
+        # engine's pace anyway)
         with self._cv:
             while True:
                 toks = self.engine.new_tokens(rid)
@@ -153,6 +231,7 @@ class EngineReplica:
     def cancel(self, rid):
         with self._cv:
             ok = self.engine.cancel(rid)
+            self._publish()
             self._cv.notify_all()
             return ok
 
@@ -208,14 +287,19 @@ class RequestHandle:
     submit timestamp the stream-duration histogram measures from.
 
     For crash recovery the handle also remembers what was submitted
-    (``prompt_ids`` / ``kw``), how many tokens the caller has already
-    received (``streamed``), and whether the request was already requeued
-    once (``requeued``) — a replica death with ``streamed == 0`` may be
-    transparently resubmitted elsewhere, anything else fails typed via
-    ``final_status`` / ``final_error``."""
+    (``prompt_ids`` / ``kw``) and every token already delivered to the
+    caller (``emitted`` — ``streamed`` is its length).  A replica death
+    with ``streamed == 0`` may be transparently resubmitted elsewhere
+    (``requeued``, once); one that already streamed tokens may be RESUMED
+    once (``resumed``) — resubmitted with ``emitted`` as re-prefill
+    context so the continuation is token-exact.  Only when recovery itself
+    fails does the handle pin a typed terminal via ``final_status`` /
+    ``final_error``.  ``resume_t0`` stamps the death-detection instant so
+    the first post-resume token lands in the splice-latency histogram."""
 
     __slots__ = ("replica", "rid", "t0", "_accounted", "prompt_ids", "kw",
-                 "streamed", "requeued", "final_status", "final_error")
+                 "emitted", "requeued", "resumed", "resume_t0",
+                 "final_status", "final_error")
 
     def __init__(self, replica, rid, prompt_ids=None, kw=None):
         self.replica = replica
@@ -224,10 +308,17 @@ class RequestHandle:
         self._accounted = False
         self.prompt_ids = prompt_ids
         self.kw = kw or {}
-        self.streamed = 0
+        self.emitted = []
         self.requeued = False
+        self.resumed = False
+        self.resume_t0 = None
         self.final_status = None
         self.final_error = None
+
+    @property
+    def streamed(self):
+        """Tokens already delivered to the caller."""
+        return len(self.emitted)
 
     def __repr__(self):
         return f"RequestHandle({self.replica.name!r}, rid={self.rid})"
@@ -246,9 +337,13 @@ class ReplicaSet:
     ``requeue=True`` turns on crash recovery: when a replica dies under an
     inflight request that has streamed ZERO tokens, the request is
     transparently resubmitted once onto a surviving replica (routed warm
-    through the prefix-affinity router); a request that already streamed
-    tokens fails typed FAILED as before (re-emitting its prefix would
-    corrupt the caller's stream).  The multi-process fleet enables this —
+    through the prefix-affinity router).  A request that already streamed
+    tokens is RESUMED once instead: resubmitted with its emitted history as
+    ``resume_tokens`` — the survivor re-prefills prompt + history (cheap
+    when prefix-cache pages are warm) and continues decode token-exact, so
+    the caller's stream splices seamlessly with no duplicated or dropped
+    tokens.  A partially-streamed request fails typed FAILED only when its
+    single resume attempt also dies.  The multi-process fleet enables this —
     the in-process default stays off, preserving fail-fast semantics.
     """
 
@@ -382,55 +477,151 @@ class ReplicaSet:
     # ---- replica-death handling ---------------------------------------------
     def _poll_handle(self, handle, timeout):
         """``replica.poll`` with fleet-level crash recovery: a dead replica
-        either requeues the handle (zero tokens streamed, once) or pins a
-        typed FAILED terminal on it."""
+        requeues the handle (zero tokens streamed), resumes it with its
+        emitted history (partially streamed), or — when recovery itself is
+        impossible — pins a typed FAILED terminal on it."""
         if handle.final_status is not None:
             return [], handle.final_status
         try:
             toks, status = handle.replica.poll(handle.rid, timeout=timeout)
         except ReplicaDeadError as e:
             return [], self._on_replica_death(handle, e)
-        handle.streamed += len(toks)
+        if (status is _RequestStatus.FAILED and self.requeue
+                and not getattr(handle.replica, "alive", True)):
+            # in-process replica death: the step loop's fail_all pinned
+            # FAILED instead of raising on poll.  Tokens the dying step
+            # decoded but never delivered are dropped here — the resume
+            # regenerates them (greedy/fixed-seed tokens are pure functions
+            # of context), so the caller's stream stays gap-free.
+            return [], self._on_replica_death(handle, ReplicaDeadError(
+                f"replica {handle.replica.name!r} died mid-request: "
+                f"{handle.replica.error!r}"))
+        handle.emitted.extend(int(t) for t in toks)
+        if toks and handle.resume_t0 is not None:
+            _obs.FRONTEND_SPLICE_SECONDS.observe(
+                time.perf_counter() - handle.resume_t0)
+            handle.resume_t0 = None
         return toks, status
 
     def _on_replica_death(self, handle, error):
         """The replica under ``handle`` died (lease expiry / RPC failure /
-        in-process step death).  Returns the handle's new status: a live
-        one after a successful requeue, else the pinned FAILED."""
-        if (self.requeue and not handle.requeued and handle.streamed == 0
-                and handle.prompt_ids is not None):
-            try:
-                alive = [r for r in self.alive_replicas()
-                         if r is not handle.replica]
-                if alive:
-                    route = self.router.route(handle.prompt_ids, alive)
-                    rid = route.replica.submit(handle.prompt_ids,
-                                               **handle.kw)
-                    if route.replica.status(rid) is not _RequestStatus.SHED:
-                        handle.replica, handle.rid = route.replica, rid
-                        handle.requeued = True
-                        _obs.FRONTEND_REQUEUED.inc()
-                        _obs.FRONTEND_ROUTED.inc(replica=route.replica.name,
-                                                 reason="requeue")
-                        return route.replica.status(rid)
-            except (ReplicaDeadError, ShedError):
-                pass  # no survivor could take it: fall through to FAILED
+        in-process step death).  Zero-streamed requests are requeued once;
+        partially-streamed ones are resumed once with their emitted history
+        as re-prefill context (token-exact continuation).  Returns the
+        handle's new status: a live one after successful recovery, else the
+        pinned terminal."""
+        if self.requeue and handle.prompt_ids is not None:
+            if handle.streamed == 0 and not handle.requeued:
+                try:
+                    alive = [r for r in self.alive_replicas()
+                             if r is not handle.replica]
+                    if alive:
+                        route = self.router.route(handle.prompt_ids, alive)
+                        rid = route.replica.submit(handle.prompt_ids,
+                                                   **handle.kw)
+                        if route.replica.status(rid) \
+                                is not _RequestStatus.SHED:
+                            handle.replica, handle.rid = route.replica, rid
+                            handle.requeued = True
+                            _obs.FRONTEND_REQUEUED.inc()
+                            _obs.FRONTEND_ROUTED.inc(
+                                replica=route.replica.name, reason="requeue")
+                            return route.replica.status(rid)
+                except (ReplicaDeadError, ShedError):
+                    pass  # no survivor could take it: fall through to FAILED
+            elif handle.streamed > 0 and not handle.resumed:
+                status = self._resume(handle)
+                if status is not None:
+                    return status
         handle.final_status = _RequestStatus.FAILED
         handle.final_error = error
         self._account(handle, _RequestStatus.FAILED)
         return _RequestStatus.FAILED
 
-    def stream(self, handle, poll_timeout=0.5):
+    def _resume(self, handle):
+        """One attempt to continue a partially-streamed ``handle`` on a
+        survivor: resubmit with ``emitted`` as ``resume_tokens`` (the
+        engine re-prefills prompt + history, cheap when prefix-cache pages
+        are warm) and the REMAINING token budget.  Returns the resumed
+        request's live status, a locally-pinned terminal when the dead
+        replica owed nothing but the final status, or None when the attempt
+        failed (the caller pins FAILED)."""
+        handle.resumed = True
+        t_death = time.perf_counter()
+        emitted = list(handle.emitted)
+        kw = dict(handle.kw)
+        remaining = int(kw.get("max_new_tokens", 16)) - len(emitted)
+        eos = kw.get("eos_token_id")
+        hit_eos = eos is not None and emitted[-1] == eos
+        if remaining <= 0 or hit_eos:
+            # the caller already holds the complete output; only the
+            # terminal status died with the replica — pin it locally
+            status = (_RequestStatus.EOS if hit_eos
+                      else _RequestStatus.FINISHED)
+            handle.final_status = status
+            self._account(handle, status)
+            return status
+        kw["max_new_tokens"] = remaining
+        kw["resume_tokens"] = emitted
+        try:
+            if _faults.FAULTS.active:
+                _faults.FAULTS.raise_if("frontend.resume",
+                                        replica=handle.replica.name)
+            alive = [r for r in self.alive_replicas()
+                     if r is not handle.replica]
+            if not alive:
+                return None
+            # route by prompt + history: the survivor holding the warmest
+            # prefix pages re-prefills the least
+            route = self.router.route(list(handle.prompt_ids) + emitted,
+                                      alive)
+            rid = route.replica.submit(handle.prompt_ids, **kw)
+            if route.replica.status(rid) is _RequestStatus.SHED:
+                return None
+        except (ReplicaDeadError, ShedError, _faults.InjectedFault):
+            return None  # the resume attempt itself died: caller pins FAILED
+        handle.replica, handle.rid = route.replica, rid
+        handle.resume_t0 = t_death
+        _obs.FRONTEND_RESUMED.inc()
+        _obs.FRONTEND_ROUTED.inc(replica=route.replica.name, reason="resume")
+        return route.replica.status(rid)
+
+    def stream(self, handle, poll_timeout=0.5, heartbeat=None):
         """Yield ``handle``'s tokens as they are emitted, one int at a time,
         until the request is terminal.  Check ``self.status(handle)`` after
-        exhaustion for the terminal status."""
+        exhaustion for the terminal status.
+
+        ``heartbeat`` (seconds): when set, the generator yields ``None``
+        whenever that long passes without a token — long prefill or queue
+        waits stay observably alive.  The SSE gateway turns each ``None``
+        into a ``: ping`` keep-alive comment, whose failing write is also
+        how a client that disconnected before the first token is detected.
+        """
+        last = time.monotonic()
+        slice_ = (poll_timeout if heartbeat is None
+                  else min(poll_timeout, float(heartbeat)))
         while True:
-            toks, status = self._poll_handle(handle, poll_timeout)
+            toks, status = self._poll_handle(handle, slice_)
             yield from toks
+            if toks:
+                last = time.monotonic()
+            elif (heartbeat is not None and not status.terminal
+                    and time.monotonic() - last >= float(heartbeat)):
+                yield None
+                last = time.monotonic()
             if status.terminal and not toks:
                 # drain once more: tokens emitted by the finalizing step
-                # land before the terminal status is visible
-                yield from self._poll_handle(handle, 0)[0]
+                # land before the terminal status is visible.  The terminal
+                # status is already in hand, so a replica dying exactly here
+                # has nothing left to deliver — never trigger recovery (a
+                # resume now could regenerate a completed request).
+                if handle.final_status is None:
+                    try:
+                        tail, _ = handle.replica.poll(handle.rid, timeout=0)
+                    except ReplicaDeadError:
+                        tail = []
+                    handle.emitted.extend(int(t) for t in tail)
+                    yield from tail
                 self._account(handle, status)
                 return
 
@@ -441,8 +632,13 @@ class ReplicaSet:
             _, status = self._poll_handle(handle, 1.0)
             if status.terminal:
                 self._account(handle, status)
-                if handle.final_status is not None:
+                if handle.final_status is _RequestStatus.FAILED:
                     return [], handle.final_status
+                if handle.final_status is not None or handle.resumed:
+                    # locally-pinned terminal, or a resumed request whose
+                    # replica-side result holds only the post-splice tail:
+                    # ``emitted`` is the complete drained stream
+                    return list(handle.emitted), status
                 return handle.replica.result(handle.rid), status
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError(f"{handle!r} not terminal after {timeout}s")

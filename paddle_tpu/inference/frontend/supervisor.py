@@ -13,9 +13,12 @@ running?  If not, the crash is recorded against a sliding window:
   epoch, so the membership plane never confuses it with its dead
   incarnation.
 - ``max_crashes`` crashes in the window → the replica is **quarantined**:
-  no further respawns, ``frontend_replica_quarantines_total`` fires, and
-  the optional ``on_quarantine`` alert hook runs once.  A human (or a
-  higher-level operator loop) un-quarantines by calling :meth:`reset`.
+  no further respawns, ``frontend_replica_quarantines_total`` fires, the
+  member's lease is evicted from the membership group (when the supervisor
+  holds a ``membership`` handle) so routers drop it on their next sync
+  instead of waiting out the TTL, and the optional ``on_quarantine`` alert
+  hook runs once.  A human (or a higher-level operator loop)
+  un-quarantines by calling :meth:`reset`.
 
 Clock and sleep are injectable, and :meth:`tick` is a plain synchronous
 step — the deterministic tests drive crash schedules through fake handles
@@ -41,11 +44,19 @@ class WorkerSupervisor:
 
     def __init__(self, spawn, name="worker", base_delay=0.1, max_delay=5.0,
                  multiplier=2.0, crash_window=30.0, max_crashes=5,
-                 clock=time.monotonic, sleep=time.sleep, on_quarantine=None):
+                 clock=time.monotonic, sleep=time.sleep, on_quarantine=None,
+                 membership=None):
+        """``membership``: optional
+        :class:`~paddle_tpu.distributed.membership.MembershipService`
+        handle for the worker's group.  A quarantine then proactively
+        ``evict()``s the worker's lease — the dead incarnation cannot
+        release it, and without eviction the router keeps selecting the
+        quarantined member until the TTL expires it."""
         if max_crashes < 1:
             raise ValueError("max_crashes must be >= 1")
         self.spawn = spawn
         self.name = str(name)
+        self.membership = membership
         self.base_delay = float(base_delay)
         self.max_delay = float(max_delay)
         self.multiplier = float(multiplier)
@@ -97,6 +108,10 @@ class WorkerSupervisor:
                 self.proc = None
                 _obs.FRONTEND_QUARANTINES.inc(replica=self.name)
                 hook = self.on_quarantine
+                # evict the dead incarnation's lease NOW (outside the lock —
+                # store round-trips): watchers see `leave` on their next
+                # poll instead of routing to a quarantined member for the
+                # rest of the TTL
             else:
                 streak = len(self._crashes) - 1
                 delay = min(self.max_delay,
@@ -106,6 +121,11 @@ class WorkerSupervisor:
                 self.restarts += 1
                 _obs.FRONTEND_RESTARTS.inc(replica=self.name)
                 return RESPAWNED
+        if self.membership is not None:
+            try:
+                self.membership.evict(self.name)
+            except (OSError, ConnectionError, TimeoutError):
+                pass  # store unreachable: the TTL expiry path still reaps
         if hook is not None:
             hook(self)
         return QUARANTINED

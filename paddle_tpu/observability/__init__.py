@@ -180,6 +180,16 @@ FRONTEND_REQUEUED = REGISTRY.counter(
     "frontend_requeued_total",
     "inflight requests transparently re-enqueued onto a surviving replica "
     "after their replica died before streaming any token")
+FRONTEND_RESUMED = REGISTRY.counter(
+    "frontend_resumed_total",
+    "partially-streamed requests resumed token-exact on a surviving "
+    "replica (re-prefill of prompt + emitted history) after their replica "
+    "died mid-stream")
+FRONTEND_SPLICE_SECONDS = REGISTRY.histogram(
+    "frontend_resume_splice_seconds",
+    "replica-death detection to the first post-resume token — the stall a "
+    "streaming client rides through a crash",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0))
 
 # shared retry helper (core/retry.py); op labels the retried operation
 RETRY_ATTEMPTS = REGISTRY.histogram(

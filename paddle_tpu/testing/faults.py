@@ -29,9 +29,12 @@ before any page is copied, so a transient retry is idempotent; ctx has
 ``rids``), ``store.connect``
 (client connect raises); in the serving front door, ``frontend.route``
 (gateway submit fails before routing), ``frontend.submit`` (fails after a
-replica is chosen; ctx has ``replica``), and ``frontend.step`` (a replica's
+replica is chosen; ctx has ``replica``), ``frontend.step`` (a replica's
 step loop dies — the chaos tests kill a replica mid-stream with this; ctx
-has ``replica``).  The self-healing fleet adds ``membership.register`` /
+has ``replica``), and ``frontend.resume`` (the durable-resume attempt for a
+partially-streamed request fails — the only path on which such a request
+may end FAILED; ctx has the dead ``replica``).  The self-healing fleet adds
+``membership.register`` /
 ``membership.heartbeat`` (lease registration / renewal attempts raise; ctx
 has ``group`` and ``member`` — arm ``Always`` to starve a lease to death)
 and ``rpc.send`` / ``rpc.recv`` (the worker RPC channel fails client-side

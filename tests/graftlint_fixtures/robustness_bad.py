@@ -1,8 +1,11 @@
 """Lint fixture: swallowed exceptions the robustness pass must catch.
 
 Never imported or executed — read as source.  Each handler below silently
-discards every failure; tests assert one RB101 warning per site.
+discards every failure; tests assert one RB101 warning per site.  The
+tail adds hand-rolled retry loops (RB104): a ``time.sleep`` between
+``try``/``except`` attempts, bypassing core.retry's policy.
 """
+import time
 
 
 def bare_swallow(fn):
@@ -70,3 +73,22 @@ def return_none_swallow(fn):
         return fn()
     except Exception:         # RB102: explicit None is still nothing
         return None
+
+
+def while_retry_sleep(connect):
+    while True:
+        try:
+            return connect()
+        except OSError:       # RB104: flat sleep between attempts
+            time.sleep(0.1)
+
+
+def for_retry_sleep(fn, attempts):
+    last = None
+    for _ in range(attempts):
+        try:
+            return fn()
+        except ConnectionError as e:
+            last = e
+        time.sleep(0.5)       # RB104: sleep after the failed attempt
+    raise last

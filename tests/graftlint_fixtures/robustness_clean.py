@@ -1,6 +1,8 @@
 """Lint fixture: exception handling the robustness pass must NOT flag —
-narrow swallows, broad handlers that act, and pragma'd deliberate swallows."""
+narrow swallows, broad handlers that act, pragma'd deliberate swallows, and
+sleeping loops that are waiting, not retrying (RB104 stays silent)."""
 import logging
+import time
 
 log = logging.getLogger(__name__)
 
@@ -61,3 +63,36 @@ def return_value_after_broad(fn):
         return fn()
     except Exception:
         return -1             # sentinel communicates the failure
+
+
+def wait_loop(ready):
+    while not ready():        # poll/drain spin: no attempt under try —
+        time.sleep(0.05)      # waiting is not retrying
+
+
+def injected_sleep_retry(fn, sleep):
+    while True:               # core.retry's own discipline: the sleep is
+        try:                  # an injectable callable, not time.sleep
+            return fn()
+        except OSError:
+            sleep(0.1)
+
+
+def closure_in_loop(items, out):
+    for it in items:
+        try:
+            out.append(it())
+        except ValueError as e:
+            out.append(e)
+
+        def later():          # nested def: its sleep is not this loop's
+            time.sleep(1.0)   # backoff
+        out.append(later)
+
+
+def deliberate_retry(connect):
+    while True:
+        try:
+            return connect()
+        except OSError:
+            time.sleep(0.1)   # graftlint: disable=robustness — boot probe

@@ -386,24 +386,67 @@ def test_jit_shardings_use_mesh_spelling(tmp_path):
 
 def test_robustness_flags_swallowed_exceptions():
     res = run([str(FIXTURES / "robustness_bad.py")], select=["robustness"])
-    assert _codes(res) == {"RB101", "RB102"}
+    assert _codes(res) == {"RB101", "RB102", "RB104"}
     by_code = {}
     for f in res.findings:
         by_code.setdefault(f.code, []).append(f)
     assert len(by_code["RB101"]) == 5
     assert len(by_code["RB102"]) == 4        # continue, break, return, None
+    assert len(by_code["RB104"]) == 2        # while retry, for retry
     assert all(f.severity == "warning" for f in res.findings)
     msgs = " | ".join(f.message for f in res.findings)
     assert "bare except" in msgs and "except BaseException" in msgs
     rb102 = " | ".join(f.message for f in by_code["RB102"])
     assert "continue" in rb102 and "break" in rb102 and "return" in rb102
+    rb104 = " | ".join(f.message for f in by_code["RB104"])
+    assert "while retry loop" in rb104 and "for retry loop" in rb104
+    assert all("RetryPolicy" in f.message for f in by_code["RB104"])
     assert all(f.hint for f in res.findings)
 
 
 def test_robustness_clean_fixture_not_flagged():
     res = run([str(FIXTURES / "robustness_clean.py")], select=["robustness"])
     assert res.findings == []
-    assert res.suppressed == 1          # the pragma'd deliberate swallow
+    assert res.suppressed == 2          # pragma'd swallow + pragma'd retry
+
+
+def test_robustness_rb104_wait_loop_vs_retry_loop(tmp_path):
+    # the discriminator is an attempt under try/except in the SAME loop:
+    # a sleeping poll loop is waiting, not retrying
+    src = """
+        import time
+
+        def poll(ready):
+            while not ready():
+                time.sleep(0.1)
+
+        def reconnect(connect):
+            while True:
+                try:
+                    return connect()
+                except OSError:
+                    time.sleep(0.1)
+    """
+    res = _lint(tmp_path, src, select=["robustness"])
+    assert _codes(res) == {"RB104"}
+    (f,) = res.findings
+    assert "time.sleep" in f.message and "core.retry" in f.message
+
+
+def test_robustness_rb104_ignores_injected_sleep(tmp_path):
+    # core.retry's own loop sleeps through an injectable callable — only
+    # the literal time.sleep spelling is a policy bypass
+    src = """
+        def retry(fn, sleep, delays):
+            for d in delays:
+                try:
+                    return fn()
+                except OSError:
+                    sleep(d)
+            return fn()
+    """
+    res = _lint(tmp_path, src, select=["robustness"])
+    assert res.findings == []
 
 
 def test_sharding_spec_repo_parallel_tree_is_clean():

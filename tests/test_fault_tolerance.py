@@ -220,15 +220,19 @@ class TestServingChaos:
     def test_seeded_probability_chaos_converges(self, model):
         """FailProb page-alloc chaos: allocation randomly (but seed-
         reproducibly) runs dry; every request still finishes and matches
-        the fault-free tokens."""
+        the fault-free tokens.  ``PADDLE_TPU_FAULT_SEED`` picks the seed —
+        CI runs the chaos suites across a fixed seed matrix, and any seed
+        must converge (the log artifact names the one that didn't)."""
+        import os
         from paddle_tpu.inference.serving import RequestStatus
+        fault_seed = int(os.environ.get("PADDLE_TPU_FAULT_SEED", "11"))
         prompts = self._prompts(4, seed=1)
         ref_eng = self._engine(model)
         ref = [ref_eng.add_request(p, max_new_tokens=5) for p in prompts]
         ref_eng.run_until_done()
         eng = self._engine(model)
         rids = [eng.add_request(p, max_new_tokens=5) for p in prompts]
-        with injected("serving.page_alloc", FailProb(0.3, seed=11)):
+        with injected("serving.page_alloc", FailProb(0.3, seed=fault_seed)):
             eng.run_until_done()
         for rr, r in zip(ref, rids):
             assert eng.status(r) == RequestStatus.FINISHED

@@ -449,23 +449,39 @@ class TestFleetChaos:
         prompt_a = list(range(1, 17))                # partially-streamed victim
         prompt_b = list(range(30, 46))               # zero-token victim
         prompt_c = list(range(60, 76))               # survivor
+        # A gets a deep budget on purpose: its paced decode must still be
+        # in flight when the kill lands no matter how warm the XLA disk
+        # cache is (see the pacing comment below)
+        ref_a = _reference_tokens(model, prompt_a, n=24)
         ref_b = _reference_tokens(model, prompt_b)
         ref_c = _reference_tokens(model, prompt_c)
 
+        # stream two tokens of A, none of B, one of C, then kill w0.  The
+        # slow_step fault paces every engine's decode so w0 cannot race
+        # through A's whole budget between our second next() and the kill —
+        # the death must land mid-stream for the resume path to be real.
+        # Pacing MUST be armed before the submits: with a warm XLA disk
+        # cache the engine otherwise decodes A's whole budget in the gap
+        # between submit() and install().  The margin is scale-free:
+        # producing A's 24 tokens takes >= 24 paced steps (~6s of pure
+        # sleep, immune to compile-cache warmth and host load), while the
+        # pre-kill window is two A pulls and one C pull (~1-3s).  Pacing is
+        # dropped right after the kill so the recovery drains run fast.
+        FAULTS.install("serving.slow_step", Always(), delay=0.25)
         fleet.router.pin = "w0"
-        h_a = fs.submit(prompt_a, max_new_tokens=6, do_sample=False)
+        h_a = fs.submit(prompt_a, max_new_tokens=24, do_sample=False)
         h_b = fs.submit(prompt_b, max_new_tokens=6, do_sample=False)
         fleet.router.pin = "w1"
         h_c = fs.submit(prompt_c, max_new_tokens=6, do_sample=False)
         assert (h_a.replica.name, h_b.replica.name,
                 h_c.replica.name) == ("w0", "w0", "w1")
 
-        # stream two tokens of A, none of B, one of C, then kill w0
         stream_a = fs.stream(h_a)
         got_a = [next(stream_a), next(stream_a)]
         stream_c = fs.stream(h_c)
         got_c = [next(stream_c)]
         fleet.kill("w0")
+        FAULTS.reset()
         fleet.router.pin = None
 
         # zero-token victim: requeued once onto w1 and token-exact
@@ -474,11 +490,15 @@ class TestFleetChaos:
         assert toks_b == ref_b
         assert fs.status(h_b).terminal
 
-        # partially-streamed victim: typed FAILED, never requeued
+        # partially-streamed victim: resumed on w1 with its two emitted
+        # tokens re-prefilled — the spliced stream is byte-identical to an
+        # uninterrupted run
         got_a += list(stream_a)
-        assert fs.status(h_a) is RequestStatus.FAILED
-        assert not h_a.requeued
-        assert "w0" in fs.request_error(h_a)
+        assert h_a.resumed and not h_a.requeued
+        assert h_a.replica.name == "w1"
+        assert got_a == ref_a
+        assert fs.status(h_a).terminal
+        assert fs.status(h_a) is not RequestStatus.FAILED
 
         # survivor: token-exact to the single-engine reference
         got_c += list(stream_c)
@@ -517,6 +537,8 @@ class TestFleetChaos:
         assert ('membership_lease_expiries_total{group="%s"} 1'
                 % fleet.group) in text
         assert "frontend_requeued_total 1" in text
+        assert "frontend_resumed_total 1" in text
+        assert 'frontend_routed_total{replica="w1",reason="resume"} 1' in text
         assert 'frontend_replica_restarts_total' in text
 
     def test_gateway_keeps_serving_through_kill(self, fleet, model):
