@@ -789,7 +789,10 @@ def _frontend_bench(paddle, on_tpu, budget_left_s=None):
     A final ``degraded`` sub-run (same clamp) replays the trace against a
     2-worker self-healing fleet (RPC workers + lease membership) and kills
     one worker at t=50% of the clean wall — reporting recovery time,
-    transparent-requeue count, and p95 TTFT clean vs faulted."""
+    transparent-requeue count, and p95 TTFT clean vs faulted; a trailing
+    gateway-restart measurement journals requests through the durable
+    plane, "crashes" it mid-decode, and times the restart's journal
+    replay + re-drive back to all-terminal."""
     try:
         from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
         from paddle_tpu.inference.serving import LLMEngine
@@ -867,7 +870,7 @@ def _frontend_bench(paddle, on_tpu, budget_left_s=None):
         run_deg = True
         if budget_left_s is not None and sect0 is not None:
             spent = time.perf_counter() - t_enter
-            projected = sect0 * 2 + 12.0
+            projected = sect0 * 2 + 18.0
             if spent + projected > budget_left_s:
                 out.setdefault("skipped", []).append("degraded")
                 print(f"frontend extra 'degraded' skipped: projected "
@@ -1027,7 +1030,98 @@ def _frontend_degraded(m, max_len, page, prefix_pages, suffix, new):
     faulted["resume_splice_mean_s"] = (
         round(sum(s["sum"] for s in series) / n, 4) if n else None)
     return {"replicas": 2, "lease_ttl_s": TTL, "clean": clean,
-            "faulted": faulted}
+            "faulted": faulted,
+            "gateway_restart": _frontend_gateway_restart(
+                m, max_len, page, prefix_pages, suffix, new_tokens=new)}
+
+
+def _frontend_gateway_restart(m, max_len, page, prefix_pages, suffix,
+                              new_tokens):
+    """Durable request plane across a gateway death (the PR-15 layer).
+    Drives N journaled requests through a :class:`DurableRequestPlane`,
+    stops the plane mid-decode exactly as a ``kill -9`` leaves it (pumps
+    halt, no terminal records land, the journal directory survives), then
+    boots a fresh plane + fresh engines on the same journal dir and times
+    ``recover()`` → every journaled request terminal again.  Reports the
+    recovery wall, how many requests the replay re-drove onto the fleet
+    (``replayed_requests``) vs. answered replay-only, and the journaled
+    token count the restart carried across."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.inference.frontend import (DurableRequestPlane,
+                                               ReplicaSet)
+    from paddle_tpu.inference.frontend.loadgen import make_trace
+    from paddle_tpu.inference.serving import LLMEngine
+    from paddle_tpu.testing import FAULTS, Always
+
+    n_requests = 6
+    trace = make_trace(11, n_requests, groups=3, prefix_pages=prefix_pages,
+                       page_size=page, suffix_tokens=suffix,
+                       max_new_tokens=new_tokens, group_major=True)
+    journal_dir = tempfile.mkdtemp(prefix="paddle-tpu-bench-journal-")
+
+    def _mk_set():
+        return ReplicaSet(
+            [LLMEngine(m, max_batch=4, max_len=max_len, page_size=page,
+                       prefix_cache=True) for _ in range(2)],
+            requeue=True)
+
+    try:
+        rs = _mk_set()
+        plane = DurableRequestPlane(rs, journal_dir, fsync="critical")
+        # pace decode so the "crash" lands mid-stream, not post-terminal
+        FAULTS.install("serving.slow_step", Always(), delay=0.05)
+        try:
+            keys = []
+            for i, req in enumerate(trace):
+                key = f"bench-{i}"
+                plane.submit(key, req["prompt"],
+                             {"max_new_tokens": req["max_tokens"]})
+                keys.append(key)
+            # crash the moment every request has journaled its first
+            # token: maximally mid-stream, nothing terminal yet
+            deadline = time.perf_counter() + 10.0
+            while (time.perf_counter() < deadline
+                   and any(not plane.get(k).tokens for k in keys)):
+                time.sleep(0.01)
+        finally:
+            FAULTS.reset()
+        # the crash: pumps stop at the next batch boundary, inflight
+        # requests keep their unjournaled-terminal state (plane.close()
+        # never cancels them — that is the recovery contract)
+        plane.close()
+        rs.close()
+
+        rs2 = _mk_set()
+        plane2 = DurableRequestPlane(rs2, journal_dir, fsync="critical")
+        t0 = time.perf_counter()
+        plane2.recover()
+        for key in keys:
+            req = plane2.get(key)
+            if req is not None:
+                req.wait_terminal(timeout=120)
+        recovery_s = time.perf_counter() - t0
+        done = [plane2.get(k) for k in keys]
+        out = {
+            "requests": len(keys),
+            "recovery_s": round(recovery_s, 4),
+            "replayed_requests": plane2.recovered,
+            "replay_only": sum(1 for r in done
+                               if r is not None and r.replayed
+                               and r.handle is None),
+            "ok": sum(1 for r in done
+                      if r is not None
+                      and r.status is not None
+                      and r.status.value in ("finished", "eos")),
+            "journaled_tokens": sum(len(r.tokens) for r in done
+                                    if r is not None),
+        }
+        plane2.close()
+        rs2.close()
+        return out
+    finally:
+        shutil.rmtree(journal_dir, ignore_errors=True)
 
 
 def _decode_bench(paddle, on_tpu):

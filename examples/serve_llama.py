@@ -12,6 +12,13 @@ Set METRICS_PORT to also expose engine + frontend telemetry on a
 Prometheus pull endpoint for the duration of the run (e.g.
 METRICS_PORT=9400 -> scrape http://127.0.0.1:9400/metrics; 0 lets the OS
 pick a port).  The gateway itself always serves /metrics too.
+
+Set JOURNAL_DIR to turn on the durable request plane: requests journal to
+that directory before acknowledgment, submits become idempotent
+(Idempotency-Key header), SSE streams resumable (Last-Event-ID), and a
+restarted gateway pointed at the same directory recovers unfinished
+requests -- the script demonstrates an idempotent replay when the knob is
+set.
 """
 import os
 import sys
@@ -49,8 +56,11 @@ def main():
     rng = np.random.RandomState(0)
     with ReplicaSet([_engine(), _engine()],
                     admission=SLOAdmission(max_queue_per_replica=32)) as rs:
-        gw = start_gateway(rs, port=int(os.environ.get("PORT", 0)))
-        print(f"front door: {gw.url}/v1/completions")
+        journal_dir = os.environ.get("JOURNAL_DIR")
+        gw = start_gateway(rs, port=int(os.environ.get("PORT", 0)),
+                           journal_dir=journal_dir)
+        print(f"front door: {gw.url}/v1/completions"
+              + (f" (journal: {journal_dir})" if journal_dir else ""))
         try:
             shared = rng.randint(
                 1, model.config.vocab_size, (12,)).tolist()
@@ -69,8 +79,21 @@ def main():
                     gw.url, prompt, max_tokens=16, do_sample=bool(i),
                     temperature=0.8, top_p=0.9, seed=7)
                 print(f"request {i}: {len(out['tokens'])} tokens on "
-                      f"{out['replica']} ({out['status']}) "
+                      f"{out.get('replica', 'durable')} ({out['status']}) "
                       f"-> {out['tokens'][:8]}...")
+            if journal_dir is not None:
+                # idempotent replay: same key, same tokens, nothing re-runs
+                first = http_completion(
+                    gw.url, shared, max_tokens=16,
+                    headers={"Idempotency-Key": "demo"})
+                again = http_completion(
+                    gw.url, shared, max_tokens=16,
+                    headers={"Idempotency-Key": "demo"})
+                print(f"idempotent replay: "
+                      f"{'match' if again['tokens'] == first['tokens'] else 'MISMATCH'}"
+                      f" ({len(again['tokens'])} tokens, key="
+                      f"{again['idempotency_key']})")
+                print(f"journal: {gw.plane.health()}")
             for name, h in rs.health().items():
                 print(f"replica {name}: finished={h['finished']} "
                       f"free_pages={h['free_pages']} alive={h['alive']}")

@@ -22,6 +22,14 @@ expiries; the retry helper re-raises after backoff).  Flagged:
     uncounted backoff; ``core/retry.py`` (``RetryPolicy`` + ``retry_call``)
     is the shared policy such loops bypass: capped exponential backoff,
     seeded jitter against stampedes, attempt telemetry.  (RB104)
+  * ``open(path, "w")`` to a FINAL path inside a persistence module — one
+    that elsewhere calls ``os.replace``/``os.fsync``, i.e. code that already
+    knows the atomic write discipline.  A create-truncate write to the real
+    destination tears on crash: readers see an empty or half file.  The
+    module's own idiom is the fix — write a ``*.tmp`` sibling, flush +
+    fsync, ``os.replace`` onto the final name, fsync the directory (the
+    request journal's compaction and the analysis cache are in-tree
+    models).  (RB105)
 
 Narrow handlers (``except KeyError: continue``) are idiomatic probing and
 stay silent, as are broad handlers that do anything observable (log, count,
@@ -32,8 +40,13 @@ function.  RB104 only fires on the literal ``time.sleep`` spelling inside a
 loop that also catches an attempt's failure: wait/poll loops with no
 ``try`` (drain loops, boot-readiness spins) stay silent, and so does code
 taking an injectable ``sleep=`` callable — ``retry_call`` itself sleeps
-through its injected parameter, never ``time.sleep`` directly.  Deliberate
-exceptions carry a line pragma or a baseline entry.
+through its injected parameter, never ``time.sleep`` directly.  RB105 is
+scoped to modules that already use ``os.replace``/``os.fsync`` (pure
+config-dump scripts with no durability pretensions stay silent), skips
+append modes (``"a"``/``"ab"`` never truncate), non-literal modes, and
+any path whose expression mentions tmp/temp — the staging file of the
+idiom itself.  Deliberate exceptions carry a line pragma or a baseline
+entry.
 """
 from __future__ import annotations
 
@@ -54,7 +67,18 @@ _RETRY_HINT = ("use core.retry.retry_call / RetryPolicy (capped exponential "
                "hand-rolled sleep loop; a deliberate flat-sleep loop "
                "carries a pragma or baseline entry")
 
+_ATOMIC_HINT = ("write to a '<name>.tmp' sibling, flush + os.fsync, then "
+                "os.replace onto the final path (and fsync the directory); "
+                "a deliberately torn-tolerant write carries a pragma or "
+                "baseline entry")
+
 _BROAD = ("Exception", "BaseException")
+
+# open() modes that create-or-truncate their target; "a"/"ab" append and
+# "r"/"rb" read, neither can tear an existing file's contents on crash
+_TRUNCATING = ("w", "x")
+
+_TMPISH = ("tmp", "temp")
 
 
 def _is_broad(handler):
@@ -136,6 +160,56 @@ def _retry_sleeps(loop):
     return sleeps if attempts else []
 
 
+def _is_os_call(call, names):
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr in names
+            and isinstance(f.value, ast.Name) and f.value.id == "os")
+
+
+def _persistence_module(tree):
+    """True when the module calls ``os.replace`` or ``os.fsync`` anywhere —
+    it participates in the atomic-write discipline, so a create-truncate
+    write to a final path elsewhere in it is an oversight, not a style."""
+    return any(isinstance(n, ast.Call) and _is_os_call(n, ("replace",
+                                                           "fsync"))
+               for n in ast.walk(tree))
+
+
+def _open_truncates(call):
+    """The literal mode string of an ``open(...)`` call when it creates or
+    truncates (``w``/``x`` family), else None.  A missing mode reads, a
+    non-literal mode gets the benefit of the doubt."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if not isinstance(mode, ast.Constant) or not isinstance(mode.value, str):
+        return None
+    return mode.value if mode.value[:1] in _TRUNCATING else None
+
+
+def _tmpish_path(call):
+    """True when the path argument's expression mentions tmp/temp anywhere
+    — a string constant (``name + ".tmp"``), an identifier (``tmp_path``),
+    or an attribute (``self._tmp``): the staging file of the atomic idiom,
+    which RB105 must not flag."""
+    if not call.args:
+        return True                 # open() with kw-only path: stay silent
+    for node in ast.walk(call.args[0]):
+        text = None
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            text = node.value
+        elif isinstance(node, ast.Name):
+            text = node.id
+        elif isinstance(node, ast.Attribute):
+            text = node.attr
+        if text is not None and any(t in text.lower() for t in _TMPISH):
+            return True
+    return False
+
+
 def _is_thread_ctor(call):
     f = call.func
     if isinstance(f, ast.Name):
@@ -210,13 +284,15 @@ def _target_released(scope, target):
 @register_pass
 class RobustnessPass(AnalysisPass):
     name = "robustness"
-    version = 4
+    version = 5
     description = ("swallowed exceptions: broad except handlers whose "
                    "whole body is pass (RB101) or a bare "
                    "continue/break/return (RB102); orphan threads: "
                    "non-daemon Thread never joined (RB103); hand-rolled "
                    "retry loops sleeping through time.sleep instead of "
-                   "core.retry (RB104)")
+                   "core.retry (RB104); create-truncate writes to final "
+                   "paths in modules that elsewhere follow the atomic "
+                   "write-rename(+fsync) idiom (RB105)")
 
     def check_file(self, src) -> list[Finding]:
         findings: list[Finding] = []
@@ -224,14 +300,30 @@ class RobustnessPass(AnalysisPass):
         for node in ast.walk(src.tree):
             for child in ast.iter_child_nodes(node):
                 parents[child] = node
+        persistence = _persistence_module(src.tree)
         for node in ast.walk(src.tree):
             if isinstance(node, ast.ExceptHandler):
                 findings.extend(self._check_handler(src, node))
             elif isinstance(node, ast.Call) and _is_thread_ctor(node):
                 findings.extend(self._check_thread(src, node, parents))
+            elif (persistence and isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "open"):
+                findings.extend(self._check_atomic_write(src, node))
             elif isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
                 findings.extend(self._check_retry_loop(src, node))
         return findings
+
+    def _check_atomic_write(self, src, call):
+        mode = _open_truncates(call)
+        if mode is None or _tmpish_path(call):
+            return []
+        return [Finding(
+            self.name, "RB105", src.path, call.lineno,
+            f"open(..., {mode!r}) to a final path in a persistence module "
+            f"— a crash mid-write leaves a torn file where the module's "
+            f"own os.replace idiom would not",
+            _ATOMIC_HINT, severity="warning")]
 
     def _check_handler(self, src, node):
         if not _is_broad(node):

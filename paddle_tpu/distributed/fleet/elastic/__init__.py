@@ -201,11 +201,16 @@ class PreemptionCheckpointer:
         with open(tmp, "wb") as f:
             np.savez(f, **arrays)
         os.replace(tmp, os.path.join(d, f"rank_{self.rank}.npz"))
-        with open(os.path.join(d, f"rank_{self.rank}.done"), "w") as f:
+        # the marker's EXISTENCE is the commit point _scan trusts, so it
+        # must appear atomically — a torn marker would count a half-saved
+        # rank as done
+        marker_tmp = os.path.join(d, f"rank_{self.rank}.done.tmp")
+        with open(marker_tmp, "w") as f:
             # world in the marker: a restart at a different scale must judge
             # completeness against the world that WROTE the step, not its own
             json.dump({"rank": self.rank, "step": step,
                        "world": self.world}, f)
+        os.replace(marker_tmp, os.path.join(d, f"rank_{self.rank}.done"))
 
     # -- restart plane --------------------------------------------------------
     def _scan(self):

@@ -432,8 +432,11 @@ def serve(port, data_dir, host="127.0.0.1", ready_file=None, load_dir=None):
             conn.close()
 
     if ready_file:
-        with open(ready_file, "w") as f:
+        # the launcher polls for this file's existence; publish it
+        # atomically so it can never observe an empty/torn pid
+        with open(ready_file + ".tmp", "w") as f:
             f.write(str(os.getpid()))
+        os.replace(ready_file + ".tmp", ready_file)
     srv.settimeout(0.2)
     while not stop.is_set():
         try:

@@ -12,6 +12,11 @@ replicas into one service:
   (:class:`SLOAdmission`), typed :class:`ShedError`.
 - :mod:`.gateway` — stdlib streaming HTTP/SSE server
   (``POST /v1/completions``, ``/healthz``, ``/metrics``).
+- :mod:`.journal` — the durable request plane: a CRC'd write-ahead request
+  journal plus the keyed table that makes gateway submits idempotent
+  (``Idempotency-Key``), SSE streams client-resumable (``Last-Event-ID``),
+  and gateway ``kill -9`` recoverable (journal replay re-drives unfinished
+  requests through the engines' ``resume_tokens`` machinery).
 - :mod:`.loadgen` — deterministic trace-driven load generation for tests and
   the bench frontend extra.
 - :mod:`.rpc` / :mod:`.worker` / :mod:`.supervisor` / :mod:`.fleet` — the
@@ -35,10 +40,12 @@ from .admission import (AdmissionDecision, AlwaysAdmit,  # noqa: F401
                         ShedError, SLOAdmission)
 from .fleet import FleetReplicaSet, RemoteReplica  # noqa: F401
 from .gateway import Gateway, start_gateway  # noqa: F401
+from .journal import (DurableRequest, DurableRequestPlane,  # noqa: F401
+                      RequestJournal)
 from .loadgen import (http_completion, make_trace,  # noqa: F401
                       run_closed_loop, summarize)
 from .replica import (EngineReplica, ReplicaDeadError,  # noqa: F401
-                      ReplicaSet, RequestHandle)
+                      ReplicaSet, RequestHandle, StuckStepError)
 from .router import (PrefixAffinityRouter, RouteDecision,  # noqa: F401
                      RoundRobinRouter)
 from .rpc import RpcClient, RpcError, RpcServer  # noqa: F401
@@ -47,9 +54,11 @@ from .worker import WorkerServer  # noqa: F401
 
 __all__ = [
     "ReplicaSet", "EngineReplica", "RequestHandle", "ReplicaDeadError",
+    "StuckStepError",
     "PrefixAffinityRouter", "RoundRobinRouter", "RouteDecision",
     "SLOAdmission", "AlwaysAdmit", "AdmissionDecision", "ShedError",
     "Gateway", "start_gateway",
+    "RequestJournal", "DurableRequest", "DurableRequestPlane",
     "make_trace", "run_closed_loop", "summarize", "http_completion",
     "RpcServer", "RpcClient", "RpcError",
     "WorkerServer", "WorkerSupervisor",

@@ -27,6 +27,13 @@ second death fails the request typed FAILED.
 ``sync()`` is one deterministic membership tick (tests drive it with a
 fake clock); ``start_sync()`` wraps it in a daemon thread for wall-clock
 deployments.
+
+The durable request plane (:mod:`.journal`) pumps fleet requests through
+the inherited :meth:`~.replica.ReplicaSet.stream_batches` — token batches
+journal gateway-side before clients see them, so the fleet needs no
+journal awareness of its own: worker processes stay stateless across
+gateway restarts and the journal replay re-drives onto whichever workers
+membership currently routes.
 """
 from __future__ import annotations
 

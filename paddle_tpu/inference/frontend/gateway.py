@@ -17,9 +17,10 @@ Terminal-status → HTTP mapping:
 
     SHED      429 Too Many Requests + Retry-After (admission or engine shed;
               decided before any tokens move, stream and non-stream alike)
-    TIMEOUT   408 Request Timeout on the non-stream path; a stream that
-              times out mid-flight has already sent 200 + tokens, so the
-              deadline surfaces in the final SSE event's ``status``
+    TIMEOUT   408 Request Timeout + Retry-After on the non-stream path; a
+              stream that times out mid-flight has already sent 200 +
+              tokens, so the deadline surfaces in the final SSE event's
+              ``status``
     FAILED    500 on non-stream (error string in the body) / final-event
               status on streams
     CANCELLED client disconnect mid-stream — the handler detects the broken
@@ -28,16 +29,35 @@ Terminal-status → HTTP mapping:
 
 Stream framing is SSE: one ``data: {"token": t, "index": i}`` event per
 token, then ``data: {"status": ..., "usage": ...}``, then ``data: [DONE]``.
+
+Passing ``journal_dir`` to :func:`start_gateway` turns on the **durable
+request plane** (:mod:`.journal`):
+
+- every accepted request is journaled (fsynced) before the response
+  starts, keyed by the client's ``Idempotency-Key`` header (one is
+  generated when absent and echoed back) — re-POSTing a known key replays
+  the journaled stream/result without re-running anything on the fleet;
+- durable SSE events carry ``id: <seq>``; a reconnecting client sends
+  ``Last-Event-ID: <seq>`` and the gateway replays the journaled tokens
+  after it, then splices onto the live stream;
+- a mid-stream disconnect *detaches* (grace TTL) instead of cancelling,
+  so the client can come back;
+- a restarted gateway pointed at the same ``journal_dir`` replays the
+  journal and re-drives unfinished requests via the engines'
+  ``resume_tokens`` machinery; while that replay runs, ``/healthz``
+  reports ``recovering: true`` and new submits shed 503 + Retry-After.
 """
 from __future__ import annotations
 
 import json
 import threading
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ... import observability as _obs
 from ..serving import RequestStatus
 from .admission import ShedError
+from .journal import DurableRequestPlane
 from .replica import ReplicaDeadError
 
 __all__ = ["Gateway", "start_gateway"]
@@ -51,10 +71,11 @@ class Gateway:
     Owns the HTTP server only — the ReplicaSet's lifecycle stays with its
     creator (``close()`` does not stop the replicas)."""
 
-    def __init__(self, httpd, thread, replica_set):
+    def __init__(self, httpd, thread, replica_set, plane=None):
         self._httpd = httpd
         self._thread = thread
         self.replica_set = replica_set
+        self.plane = plane          # DurableRequestPlane in durable mode
         self.addr, self.port = httpd.server_address[:2]
         self.url = f"http://{self.addr}:{self.port}"
 
@@ -62,6 +83,10 @@ class Gateway:
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=10.0)
+        if self.plane is not None:
+            # pumps stop, journal closes; inflight requests keep their
+            # unjournaled-terminal state so a restart recovers them
+            self.plane.close()
 
     def __enter__(self):
         return self
@@ -73,13 +98,20 @@ class Gateway:
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     replica_set = None       # bound per-server by start_gateway
+    plane = None             # DurableRequestPlane, durable mode only
     ping_interval = 5.0      # idle seconds between SSE keep-alive comments
 
     # ---- GET -----------------------------------------------------------------
     def do_GET(self):  # noqa: N802 (stdlib handler API)
         path = self.path.split("?")[0]
         if path == "/healthz":
-            self._send_json(200, self.replica_set.health())
+            health = self.replica_set.health()
+            if self.plane is not None:
+                # "journal" is a reserved key in durable mode (don't name a
+                # replica that): journal depth + recovery state ride along
+                health = dict(health)
+                health["journal"] = self.plane.health()
+            self._send_json(200, health)
         elif path == "/metrics":
             body = _obs.render_prometheus().encode("utf-8")
             self.send_response(200)
@@ -108,17 +140,15 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, KeyError, TypeError) as e:
             self._send_json(400, {"error": f"bad request: {e}"})
             return
+        if self.plane is not None:
+            self._durable_completion(prompt, kw, stream)
+            return
         try:
             handle = self.replica_set.submit(prompt, **kw)
         except ShedError as e:
-            self.send_response(429)
-            body = json.dumps({"error": str(e),
-                               "reason": e.reason}).encode("utf-8")
-            self.send_header("Retry-After", str(max(1, int(e.retry_after))))
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._send_json(429, {"error": str(e), "reason": e.reason},
+                            headers={"Retry-After":
+                                     str(max(1, int(e.retry_after)))})
             return
         except ReplicaDeadError as e:
             # dead fleet: carry Retry-After like the SHED 429 does, so
@@ -138,8 +168,11 @@ class _Handler(BaseHTTPRequestHandler):
         rs = self.replica_set
         tokens, status = rs.result(handle)
         if status is RequestStatus.TIMEOUT and not tokens:
+            # Retry-After parity with 429/503: an unserved deadline is a
+            # load symptom, the client should back off before re-asking
             self._send_json(408, {"error": "deadline expired unserved",
-                                  "status": status.value})
+                                  "status": status.value},
+                            headers={"Retry-After": "1"})
             return
         if status is RequestStatus.FAILED:
             self._send_json(500, {"error": rs.request_error(handle),
@@ -188,6 +221,111 @@ class _Handler(BaseHTTPRequestHandler):
             # client went away mid-stream: stop decoding for nobody
             rs.cancel(handle)
 
+    # ---- durable mode (journal-backed) ---------------------------------------
+    def _durable_completion(self, prompt, kw, stream):
+        plane = self.plane
+        if plane.recovering:
+            # journal replay owns the fleet right now; shed instead of
+            # interleaving fresh admissions with re-driven requests
+            self._send_json(503, {"error": "gateway recovering",
+                                  "recovering": True},
+                            headers={"Retry-After": "1"})
+            return
+        key = self.headers.get("Idempotency-Key") or uuid.uuid4().hex
+        last_id = self.headers.get("Last-Event-ID")
+        try:
+            after = 0 if last_id is None else int(last_id) + 1
+        except ValueError:
+            self._send_json(400, {"error":
+                                  f"bad Last-Event-ID {last_id!r}"})
+            return
+        req = plane.get(key)
+        if req is not None:
+            # replayed key: serve from the journaled request, never re-run
+            if last_id is not None:
+                _obs.STREAM_REATTACH.inc()
+        else:
+            try:
+                req, _created = plane.submit(key, prompt, kw)
+            except ShedError as e:
+                self._send_json(429, {"error": str(e), "reason": e.reason},
+                                headers={"Retry-After":
+                                         str(max(1, int(e.retry_after)))})
+                return
+            except ReplicaDeadError as e:
+                self._send_json(503, {"error": str(e)},
+                                headers={"Retry-After": "1"})
+                return
+            except ValueError as e:
+                self._send_json(400, {"error": str(e)})
+                return
+            except Exception as e:  # noqa: BLE001 — journal append failed
+                # acceptance could not be made durable, so it did not happen
+                self._send_json(500, {"error": f"journal append failed: "
+                                               f"{e}"})
+                return
+        if stream:
+            self._durable_stream(req, after)
+        else:
+            self._durable_blocking(req, key)
+
+    def _durable_blocking(self, req, key):
+        tokens, status = req.wait_terminal()
+        if status is RequestStatus.TIMEOUT and not tokens:
+            self._send_json(408, {"error": "deadline expired unserved",
+                                  "status": status.value,
+                                  "idempotency_key": key},
+                            headers={"Retry-After": "1"})
+            return
+        if status is RequestStatus.FAILED:
+            self._send_json(500, {"error": req.error,
+                                  "status": status.value,
+                                  "idempotency_key": key})
+            return
+        self._send_json(200, {
+            "status": status.value,
+            "tokens": tokens,
+            "idempotency_key": key,
+            "usage": {"completion_tokens": len(tokens)},
+        })
+
+    def _durable_stream(self, req, after):
+        plane = self.plane
+        plane.attach(req)
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-store")
+            self.send_header("Connection", "close")
+            self.send_header("Idempotency-Key", req.key)
+            self.close_connection = True
+            self.end_headers()
+            for ev in req.events(after=after,
+                                 heartbeat=self.ping_interval):
+                if ev is None:
+                    self.wfile.write(b": ping\n\n")
+                    self.wfile.flush()
+                    continue
+                seq, tok = ev
+                # id: <seq> is what a reconnecting client echoes back as
+                # Last-Event-ID — replay resumes AFTER this event
+                self.wfile.write(b"id: %d\n" % seq)
+                self._sse({"token": tok, "index": seq})
+            final = {"status": req.status.value,
+                     "usage": {"completion_tokens": len(req.tokens)}}
+            if req.status is RequestStatus.FAILED:
+                final["error"] = req.error
+            self._sse(final)
+            self.wfile.write(b"data: [DONE]\n\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionError, OSError):
+            # client went away pre-terminal: DETACH, don't cancel — the
+            # grace TTL gives it a reconnect window (plane pump cancels
+            # only once the window lapses with nobody attached)
+            pass
+        finally:
+            plane.detach(req)
+
     # ---- plumbing ------------------------------------------------------------
     def _read_body(self):
         n = int(self.headers.get("Content-Length", 0))
@@ -213,19 +351,43 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
 
-def start_gateway(replica_set, port=0, addr="127.0.0.1", ping_interval=5.0):
+def start_gateway(replica_set, port=0, addr="127.0.0.1", ping_interval=5.0,
+                  journal_dir=None, detach_ttl=30.0,
+                  journal_fsync="critical", recover=True):
     """Serve ``replica_set`` at ``http://addr:port`` from a daemon thread;
     ``port=0`` lets the OS pick (read it back from the returned handle).
     The caller owns the handle: ``close()`` stops the HTTP server (the
     replicas keep running until their owner closes them).  ``ping_interval``
     is the idle-stream keep-alive cadence (seconds between ``: ping`` SSE
-    comments while no token is ready)."""
+    comments while no token is ready).
+
+    ``journal_dir`` turns on the durable request plane (see module
+    docstring): requests journal to that directory, submits become
+    idempotent, streams resumable, and — with ``recover=True`` — any
+    journal left by a previous gateway replays in a background thread
+    (``/healthz`` shows ``recovering`` until it lands; submits shed 503
+    meanwhile).  ``detach_ttl`` is the seconds a fully-disconnected
+    pre-terminal stream survives before cancellation; ``journal_fsync``
+    is the :class:`~.journal.RequestJournal` fsync policy."""
+    plane = None
+    if journal_dir is not None:
+        plane = DurableRequestPlane(replica_set, journal_dir,
+                                    fsync=journal_fsync,
+                                    detach_ttl=detach_ttl)
+        if recover:
+            # flagged before the serving thread exists so no request can
+            # slip in ahead of the replay
+            plane.recovering = True
+            threading.Thread(target=plane.recover,
+                             name="paddle-tpu-gateway-recover",
+                             daemon=True).start()
     handler = type("_BoundHandler", (_Handler,),
                    {"replica_set": replica_set,
+                    "plane": plane,
                     "ping_interval": float(ping_interval)})
     httpd = ThreadingHTTPServer((addr, port), handler)
     httpd.daemon_threads = True
     thread = threading.Thread(target=httpd.serve_forever,
                               name="paddle-tpu-gateway", daemon=True)
     thread.start()
-    return Gateway(httpd, thread, replica_set)
+    return Gateway(httpd, thread, replica_set, plane=plane)

@@ -125,26 +125,35 @@ def summarize(records, wall_seconds):
 
 
 def http_completion(base_url, prompt, max_tokens=16, stream=False,
-                    timeout=30.0, **sampling):
+                    timeout=30.0, headers=None, **sampling):
     """One ``POST /v1/completions`` against a running gateway.
 
     Non-stream: returns the decoded JSON body.  Stream: consumes the SSE
-    response and returns ``{"tokens": [...], "status": ..., "events": n}``
-    reassembled from the events — the shape tests compare against the
-    engine-direct result."""
+    response and returns ``{"tokens": [...], "status": ..., "events": n,
+    "last_id": ...}`` reassembled from the events — the shape tests compare
+    against the engine-direct result.  ``last_id`` is the final ``id:``
+    field seen (None on a non-durable gateway), ready to echo back as
+    ``Last-Event-ID`` on a reconnect.  ``headers`` adds request headers —
+    the durable gateway's ``Idempotency-Key`` / ``Last-Event-ID`` ride
+    here."""
     body = {"prompt": [int(t) for t in prompt],
             "max_tokens": int(max_tokens), "stream": bool(stream)}
     body.update(sampling)
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
     req = urllib.request.Request(
         base_url.rstrip("/") + "/v1/completions",
         data=json.dumps(body).encode("utf-8"),
-        headers={"Content-Type": "application/json"}, method="POST")
+        headers=hdrs, method="POST")
     with urllib.request.urlopen(req, timeout=timeout) as resp:
         if not stream:
             return json.loads(resp.read().decode("utf-8"))
-        tokens, status, events = [], None, 0
+        tokens, status, events, last_id = [], None, 0, None
         for raw in resp:
             line = raw.decode("utf-8").strip()
+            if line.startswith("id: "):
+                last_id = int(line[len("id: "):])
+                continue
             if not line.startswith("data: "):
                 continue
             events += 1
@@ -156,4 +165,5 @@ def http_completion(base_url, prompt, max_tokens=16, stream=False,
                 tokens.append(evt["token"])
             else:
                 status = evt.get("status")
-        return {"tokens": tokens, "status": status, "events": events}
+        return {"tokens": tokens, "status": status, "events": events,
+                "last_id": last_id}

@@ -41,12 +41,19 @@ from ..serving import RequestStatus as _RequestStatus
 from .admission import AlwaysAdmit, ShedError
 from .router import PrefixAffinityRouter
 
-__all__ = ["ReplicaDeadError", "EngineReplica", "RequestHandle", "ReplicaSet"]
+__all__ = ["ReplicaDeadError", "StuckStepError", "EngineReplica",
+           "RequestHandle", "ReplicaSet"]
 
 
 class ReplicaDeadError(RuntimeError):
     """Raised when submitting to a dead replica, or when no replica in the
     set is alive."""
+
+
+class StuckStepError(RuntimeError):
+    """A replica step exceeded ``step_wall_timeout`` — the watchdog promoted
+    the gray failure (wedged device, deadlocked collective) to a typed
+    replica death so inflight streams fail over instead of hanging."""
 
 
 class EngineReplica:
@@ -65,7 +72,8 @@ class EngineReplica:
     polls inside their deadline (SSE heartbeats depend on this) and token
     latency at one notify."""
 
-    def __init__(self, name, engine, router=None, poll_interval=0.05):
+    def __init__(self, name, engine, router=None, poll_interval=0.05,
+                 step_wall_timeout=None):
         self.name = str(name)
         self.engine = engine
         self.router = router
@@ -80,6 +88,10 @@ class EngineReplica:
         self._stop = False
         self._thread = None
         self._poll = float(poll_interval)
+        self.step_wall_timeout = (None if step_wall_timeout is None
+                                  else float(step_wall_timeout))
+        self._step_t0 = None        # monotonic start of the inflight step
+        self._watchdog = None
         if router is not None:
             # called from inside step() while the step thread holds our
             # condition; the router only takes its own (leaf) lock.
@@ -92,6 +104,11 @@ class EngineReplica:
             self._thread = threading.Thread(
                 target=self._loop, name=f"replica-{self.name}", daemon=True)
             self._thread.start()
+        if self.step_wall_timeout is not None and self._watchdog is None:
+            self._watchdog = threading.Thread(
+                target=self._watch_steps, name=f"watchdog-{self.name}",
+                daemon=True)
+            self._watchdog.start()
         return self
 
     def close(self):
@@ -101,6 +118,9 @@ class EngineReplica:
         if self._thread is not None:
             self._thread.join(timeout=10.0)
             self._thread = None
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=10.0)
+            self._watchdog = None
 
     def _has_work(self):
         eng = self.engine
@@ -118,12 +138,56 @@ class EngineReplica:
                     if _faults.FAULTS.active:
                         _faults.FAULTS.raise_if("frontend.step",
                                                 replica=self.name)
+                    self._step_t0 = time.monotonic()
                     self.engine.step()
                 except Exception as e:  # noqa: BLE001 — replica death boundary
-                    self._die(e)
+                    self._step_t0 = None
+                    self._die(self.error if not self.alive else e)
+                    return
+                self._step_t0 = None
+                if not self.alive:
+                    # the watchdog declared this step stuck while it ran;
+                    # it could not touch the engine (we held the condition)
+                    # so finalize engine-side state now that we are back
+                    self._die(self.error)
                     return
                 self._publish()
                 self._cv.notify_all()
+
+    def _watch_steps(self):
+        """Wall-clock watchdog for the step loop: a step running longer
+        than ``step_wall_timeout`` is a gray failure (wedged device,
+        deadlocked collective) that would hang every stream on this replica
+        forever — promote it to a typed replica death.  The stuck step
+        HOLDS the engine condition, so the watchdog must not take it:
+        it marks the replica dead, fails the outbox directly (pollers fail
+        over immediately), and leaves engine-side finalization to the step
+        loop whenever the wedged step finally returns."""
+        timeout = self.step_wall_timeout
+        tick = max(0.01, min(0.25, timeout / 4.0))
+        while not self._stop and self.alive:
+            t0 = self._step_t0
+            if t0 is not None and time.monotonic() - t0 > timeout:
+                self._trip_stuck(time.monotonic() - t0)
+                return
+            time.sleep(tick)
+
+    def _trip_stuck(self, elapsed):
+        """Lock-free replica death for a wedged step (see ``_watch_steps``):
+        everything ``_die`` does except touching the engine, which stays
+        owned by the stuck step thread."""
+        self.error = StuckStepError(
+            f"replica {self.name!r} step exceeded step_wall_timeout="
+            f"{self.step_wall_timeout}s (ran {elapsed:.2f}s)")
+        self.alive = False
+        _obs.FRONTEND_STUCK_STEPS.inc(replica=self.name)
+        if self.router is not None:
+            self.router.forget(self.name)
+        with self._out_cv:
+            for slot in self._out.values():
+                if not slot["status"].terminal:
+                    slot["status"] = _RequestStatus.FAILED
+            self._out_cv.notify_all()
 
     def _publish(self):
         """Move every tracked request's new tokens and current status from
@@ -348,7 +412,8 @@ class ReplicaSet:
     """
 
     def __init__(self, engines, router=None, admission=None, names=None,
-                 start=True, poll_interval=0.05, requeue=False):
+                 start=True, poll_interval=0.05, requeue=False,
+                 step_wall_timeout=None):
         engines = list(engines)
         if not engines:
             raise ValueError("ReplicaSet needs at least one engine")
@@ -363,7 +428,8 @@ class ReplicaSet:
         self.admission = admission if admission is not None else AlwaysAdmit()
         self.requeue = bool(requeue)
         self.replicas = [
-            EngineReplica(n, e, router=router, poll_interval=poll_interval)
+            EngineReplica(n, e, router=router, poll_interval=poll_interval,
+                          step_wall_timeout=step_wall_timeout)
             for n, e in zip(names, engines)]
         self._by_name = {r.name: r for r in self.replicas}
         if start:
@@ -562,7 +628,11 @@ class ReplicaSet:
             self._account(handle, status)
             return status
         kw["max_new_tokens"] = remaining
-        kw["resume_tokens"] = emitted
+        # a request already driven with resume_tokens (gateway crash
+        # recovery) must carry its FULL history — prior resume prefix plus
+        # what this incarnation streamed — or the re-prefill would forget
+        # the pre-recovery tokens
+        kw["resume_tokens"] = list(kw.get("resume_tokens") or []) + emitted
         try:
             if _faults.FAULTS.active:
                 _faults.FAULTS.raise_if("frontend.resume",
@@ -586,28 +656,26 @@ class ReplicaSet:
         _obs.FRONTEND_ROUTED.inc(replica=route.replica.name, reason="resume")
         return route.replica.status(rid)
 
-    def stream(self, handle, poll_timeout=0.5, heartbeat=None):
-        """Yield ``handle``'s tokens as they are emitted, one int at a time,
-        until the request is terminal.  Check ``self.status(handle)`` after
-        exhaustion for the terminal status.
+    def stream_batches(self, handle, poll_timeout=0.5, heartbeat=None):
+        """Yield ``(tokens, status)`` batches for ``handle`` — each batch
+        exactly as one poll delivered it — until the request is terminal.
+        This is the primitive the durable request plane journals from: a
+        batch boundary here is a journal-record boundary there.
 
-        ``heartbeat`` (seconds): when set, the generator yields ``None``
-        whenever that long passes without a token — long prefill or queue
-        waits stay observably alive.  The SSE gateway turns each ``None``
-        into a ``: ping`` keep-alive comment, whose failing write is also
-        how a client that disconnected before the first token is detected.
-        """
+        ``heartbeat`` (seconds): when set, an EMPTY batch ``([], status)``
+        is yielded whenever that long passes without a token — the liveness
+        signal :meth:`stream` turns into its ``None`` pings."""
         last = time.monotonic()
         slice_ = (poll_timeout if heartbeat is None
                   else min(poll_timeout, float(heartbeat)))
         while True:
             toks, status = self._poll_handle(handle, slice_)
-            yield from toks
             if toks:
+                yield list(toks), status
                 last = time.monotonic()
             elif (heartbeat is not None and not status.terminal
                     and time.monotonic() - last >= float(heartbeat)):
-                yield None
+                yield [], status
                 last = time.monotonic()
             if status.terminal and not toks:
                 # drain once more: tokens emitted by the finalizing step
@@ -621,9 +689,28 @@ class ReplicaSet:
                     except ReplicaDeadError:
                         tail = []
                     handle.emitted.extend(int(t) for t in tail)
-                    yield from tail
+                    if tail:
+                        yield list(tail), status
                 self._account(handle, status)
                 return
+
+    def stream(self, handle, poll_timeout=0.5, heartbeat=None):
+        """Yield ``handle``'s tokens as they are emitted, one int at a time,
+        until the request is terminal.  Check ``self.status(handle)`` after
+        exhaustion for the terminal status.
+
+        ``heartbeat`` (seconds): when set, the generator yields ``None``
+        whenever that long passes without a token — long prefill or queue
+        waits stay observably alive.  The SSE gateway turns each ``None``
+        into a ``: ping`` keep-alive comment, whose failing write is also
+        how a client that disconnected before the first token is detected.
+        """
+        for toks, _status in self.stream_batches(handle, poll_timeout,
+                                                 heartbeat):
+            if not toks:
+                yield None
+            else:
+                yield from toks
 
     def result(self, handle, timeout=None):
         """Block until terminal; returns ``(tokens, status)``."""

@@ -190,6 +190,27 @@ FRONTEND_SPLICE_SECONDS = REGISTRY.histogram(
     "replica-death detection to the first post-resume token — the stall a "
     "streaming client rides through a crash",
     buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0))
+FRONTEND_STUCK_STEPS = REGISTRY.counter(
+    "frontend_stuck_steps_total",
+    "replica steps the wall-clock watchdog declared wedged (gray failure "
+    "promoted to a typed replica death)", ("replica",))
+
+# durable request plane (inference/frontend/journal.py + gateway)
+JOURNAL_APPEND_SECONDS = REGISTRY.histogram(
+    "journal_append_seconds",
+    "wall time of one request-journal append (incl. any fsync)",
+    buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5))
+JOURNAL_REPLAYED = REGISTRY.counter(
+    "journal_replayed_total",
+    "journal records consumed during crash recovery, by record kind "
+    "(accepted/tokens/terminal/result)", ("kind",))
+GATEWAY_RECOVERIES = REGISTRY.counter(
+    "gateway_recoveries_total",
+    "gateway restarts that replayed a non-empty request journal")
+STREAM_REATTACH = REGISTRY.counter(
+    "stream_reattach_total",
+    "SSE clients that reconnected with Last-Event-ID and were spliced "
+    "back onto a journaled stream")
 
 # shared retry helper (core/retry.py); op labels the retried operation
 RETRY_ATTEMPTS = REGISTRY.histogram(

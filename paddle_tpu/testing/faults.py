@@ -33,7 +33,12 @@ replica is chosen; ctx has ``replica``), ``frontend.step`` (a replica's
 step loop dies — the chaos tests kill a replica mid-stream with this; ctx
 has ``replica``), and ``frontend.resume`` (the durable-resume attempt for a
 partially-streamed request fails — the only path on which such a request
-may end FAILED; ctx has the dead ``replica``).  The self-healing fleet adds
+may end FAILED; ctx has the dead ``replica``).  The durable request plane
+adds ``journal.append`` (a write-ahead journal record fails to append; ctx
+has ``kind``), ``journal.fsync`` (the fsync after a critical append raises
+— a full-disk / dying-device stand-in), and ``gateway.recover`` (the
+re-drive of one journaled non-terminal request during gateway crash
+recovery fails; ctx has ``key``).  The self-healing fleet adds
 ``membership.register`` /
 ``membership.heartbeat`` (lease registration / renewal attempts raise; ctx
 has ``group`` and ``member`` — arm ``Always`` to starve a lease to death)

@@ -1,0 +1,63 @@
+"""Child process for the real-SIGKILL durable-gateway test
+(``tests/test_journal.py::TestRealKillNine``).
+
+Builds the deterministic tiny Llama (``pt.seed(0)`` pins the weights, so
+token streams match across processes), starts a durable gateway on an
+OS-picked port over the journal dir given in argv, and prints
+``READY <port>`` once it can serve.  The parent kills this process with
+SIGKILL mid-stream, spawns a fresh one on the SAME journal dir, and
+expects the spliced stream to be byte-identical.
+
+Usage::
+
+    python tests/_gateway_child.py <journal_dir> [--slow-step SECONDS]
+
+``--slow-step`` paces every engine step through the ``serving.slow_step``
+fault point so the parent can reliably kill mid-stream.
+"""
+import argparse
+import sys
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("journal_dir")
+    ap.add_argument("--slow-step", type=float, default=0.0)
+    args = ap.parse_args()
+
+    import paddle_tpu as pt
+    from paddle_tpu.inference.frontend import ReplicaSet, start_gateway
+    from paddle_tpu.inference.serving import LLMEngine
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.testing import FAULTS, Always
+
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=176,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=128)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    if args.slow_step > 0:
+        FAULTS.install("serving.slow_step", Always(), delay=args.slow_step)
+
+    rs = ReplicaSet(
+        [LLMEngine(model, max_batch=3, max_len=64, page_size=8,
+                   prefix_cache=True) for _ in range(2)],
+        requeue=True)
+    gw = start_gateway(rs, journal_dir=args.journal_dir,
+                       journal_fsync="critical")
+    print(f"READY {gw.port}", flush=True)
+    try:
+        while True:          # serve until the parent kills us
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        gw.close()
+        rs.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,39 @@
+"""Lint fixture: non-atomic writes the robustness pass must catch (RB105).
+
+Never imported or executed — read as source.  This module "qualifies" as a
+persistence code path (it calls ``os.replace`` below), so every
+create-truncate ``open`` of a final path is a torn-file hazard its own
+idiom already knows how to avoid.
+"""
+import json
+import os
+
+
+def save_atomic(path, obj):
+    # the module's one correct write: this is what makes it "qualify"
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def save_torn(path, obj):
+    with open(path, "w") as f:        # RB105: truncates the final path
+        json.dump(obj, f)
+
+
+def save_torn_binary(path, blob):
+    f = open(path, "wb")              # RB105: same, binary
+    f.write(blob)
+    f.close()
+
+
+def save_torn_kw_mode(path, obj):
+    with open(path, mode="w") as f:   # RB105: mode via keyword
+        json.dump(obj, f)
+
+
+def marker_torn(done_dir, rank):
+    # a commit marker whose EXISTENCE is the signal readers trust
+    with open(os.path.join(done_dir, f"rank_{rank}.done"), "w") as f:  # RB105
+        f.write("done")

@@ -1,0 +1,49 @@
+"""Lint fixture: writes the robustness RB105 check must stay silent on.
+
+Never imported or executed — read as source.  Tmp-staged writes, appends,
+reads, non-literal modes, and — in ``no_discipline_module`` style — the
+whole-module exemption are exercised by the companion module
+``persistence_clean_nodisc.py`` (a module with no ``os.replace``/
+``os.fsync`` never qualifies, whatever it opens).
+"""
+import json
+import os
+
+
+def save_atomic(path, obj):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:         # staging file of the idiom itself
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def save_via_tmpname(tmp_path, obj):
+    with open(tmp_path, "w") as f:    # identifier says temp: trusted
+        json.dump(obj, f)
+
+
+def save_joined_tmp(d, name, obj):
+    with open(os.path.join(d, name + ".tmp"), "w") as f:  # constant says tmp
+        json.dump(obj, f)
+
+
+def append_log(path, line):
+    with open(path, "a") as f:        # append never truncates
+        f.write(line)
+
+
+def read_back(path):
+    with open(path) as f:             # default mode reads
+        return json.load(f)
+
+
+def read_binary(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def dynamic_mode(path, mode):
+    with open(path, mode) as f:       # non-literal mode: benefit of doubt
+        return f

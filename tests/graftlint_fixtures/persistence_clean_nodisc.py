@@ -1,0 +1,17 @@
+"""Lint fixture: a module with truncating writes but NO atomic-write
+discipline anywhere (no ``os.replace``/``os.fsync``) — RB105 is scoped to
+modules that already practice the idiom, so this one stays silent.
+
+Never imported or executed — read as source.
+"""
+import json
+
+
+def dump_config(path, obj):
+    with open(path, "w") as f:        # not a persistence module: silent
+        json.dump(obj, f)
+
+
+def dump_blob(path, blob):
+    with open(path, "wb") as f:
+        f.write(blob)

@@ -449,6 +449,32 @@ def test_robustness_rb104_ignores_injected_sleep(tmp_path):
     assert res.findings == []
 
 
+def test_robustness_rb105_flags_torn_writes_in_persistence_modules():
+    res = run([str(FIXTURES / "persistence_bad.py")], select=["robustness"])
+    assert _codes(res) == {"RB105"}
+    assert len(res.findings) == 4            # w, wb, mode="w", marker
+    assert all(f.severity == "warning" for f in res.findings)
+    assert all("os.replace" in f.hint for f in res.findings)
+    modes = " | ".join(f.message for f in res.findings)
+    assert "'w'" in modes and "'wb'" in modes
+
+
+def test_robustness_rb105_clean_fixtures_not_flagged():
+    # tmp-staged / append / read / dynamic-mode writes inside a qualifying
+    # module, and ANY write inside a module with no os.replace/os.fsync
+    for name in ("persistence_clean.py", "persistence_clean_nodisc.py"):
+        res = run([str(FIXTURES / name)], select=["robustness"])
+        assert res.findings == [], name
+
+
+def test_robustness_rb105_journal_compaction_is_clean():
+    # the request journal IS the in-tree model of the idiom RB105 enforces:
+    # its own truncating writes are all tmp-staged or append-mode
+    res = run([str(REPO / "paddle_tpu" / "inference" / "frontend"
+                   / "journal.py")], select=["robustness"])
+    assert not [f for f in res.findings if f.code == "RB105"]
+
+
 def test_sharding_spec_repo_parallel_tree_is_clean():
     res = _sharding([REPO / "paddle_tpu" / "parallel",
                      REPO / "paddle_tpu" / "distributed"])

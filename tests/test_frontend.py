@@ -398,6 +398,66 @@ class TestReplicaSet:
             rs.close()
 
 
+class TestStuckStepWatchdog:
+    def test_stuck_step_trips_typed_death_and_fails_over(self, model):
+        """A step that wedges past ``step_wall_timeout`` is a gray failure:
+        the watchdog promotes it to a typed replica death while the step
+        still holds the engine condition, pollers fail over immediately,
+        and the zero-streamed request requeues onto the survivor with
+        byte-identical output."""
+        import paddle_tpu.observability as obs
+        from paddle_tpu.inference.frontend import (ReplicaSet,
+                                                   StuckStepError)
+        from paddle_tpu.inference.serving import RequestStatus
+
+        prompt = _prompts(1, seed=3)[0]
+        ref = _engine(model)
+        rid = ref.add_request(prompt, max_new_tokens=6)
+        ref.run_until_done()
+        want = list(ref.result(rid))
+
+        engines = [_engine(model) for _ in range(2)]
+        for eng in engines:
+            # pay each engine's JIT compilation for the exact prompt and
+            # decode shapes this test submits, so the watchdog times
+            # genuine step wall time, not compilation
+            eng.add_request(list(prompt), max_new_tokens=6)
+            eng.run_until_done()
+        real_step = engines[0].step
+        stalled = threading.Event()
+
+        def wedged_step():
+            if not stalled.is_set():
+                stalled.set()            # wedge the FIRST step only —
+                time.sleep(2.0)          #   far past step_wall_timeout
+            return real_step()
+
+        engines[0].step = wedged_step
+        obs.enable()
+        try:
+            rs = ReplicaSet(engines, router=RoundRobinRouter(),
+                            requeue=True, step_wall_timeout=0.5)
+            try:
+                h = rs.submit(prompt, max_new_tokens=6)  # round 1 → r0
+                toks, status = rs.result(h, timeout=60.0)
+                assert status in (RequestStatus.FINISHED, RequestStatus.EOS)
+                assert list(toks) == want
+                r0 = rs.replicas[0]
+                assert not r0.alive
+                assert isinstance(r0.error, StuckStepError)
+                health = rs.health()
+                assert health["r0"]["alive"] is False
+                assert health["r1"]["alive"] is True
+                text = obs.render_prometheus()
+                assert 'frontend_stuck_steps_total{replica="r0"} 1' in text
+                assert "frontend_requeued_total 1" in text
+            finally:
+                rs.close()
+        finally:
+            obs.disable()
+            obs.reset()
+
+
 class TestAffinityVsRoundRobin:
     def _run(self, model, router, trace):
         rs = _replica_set(model, n=2, router=router)
@@ -531,6 +591,9 @@ class TestGatewayHTTP:
             http_completion(gw.url, _prompts(1)[0], max_tokens=4,
                             deadline=1e-6)
         assert ei.value.code == 408
+        # Retry-After parity with 429/503: an unserved deadline is a load
+        # symptom, the client should back off before re-asking
+        assert ei.value.headers["Retry-After"] == "1"
 
     def test_bad_request_maps_to_400(self, served):
         gw, _ = served

@@ -1,0 +1,708 @@
+"""Durable front door (ISSUE 15): write-ahead request journal, idempotent
+submission, client-resumable SSE, and gateway crash recovery.
+
+Layers under test, bottom-up:
+
+- journal units: record CRC round trip, segment rotation, compaction
+  retention, torn-tail truncation recovery (garbage at the active
+  segment's tail is skipped, earlier records survive), reopen-never-
+  appends-to-old-segments discipline, and the ``journal.append`` /
+  ``journal.fsync`` fault points;
+- durable plane: ACCEPTED journals (fsync path) before submit returns —
+  a failed append is a failed submit with nothing running on the fleet;
+  a fully-detached pre-terminal stream is cancelled only after the grace
+  TTL;
+- durable gateway: replayed ``Idempotency-Key`` submits serve the
+  journaled stream without re-running (engine admission count unchanged),
+  ``Last-Event-ID`` reconnects splice journal replay onto the live stream
+  byte-identically (offsets × seeds × prefix-cache on/off), healthz
+  carries journal depth + recovery state, and submits during recovery
+  shed 503 + Retry-After;
+- crash chaos: "kill -9" the gateway mid-stream (HTTP serving and pumps
+  stopped dead, no terminal journaled, journal left as the crash left
+  it), restart a fresh gateway + fresh engines on the same journal dir,
+  reconnect with ``Last-Event-ID`` — the client's concatenated stream is
+  byte-identical to an uninterrupted run, zero duplicate and zero missing
+  events, greedy and fixed-seed, prefix cache on and off.  The
+  real-process variant (actual subprocess, actual SIGKILL) is slow-marked
+  and excluded from tier-1.
+"""
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import paddle_tpu.observability as obs
+from paddle_tpu.inference.engine.request import RequestStatus
+from paddle_tpu.inference.frontend import (DurableRequestPlane,
+                                           RequestJournal, ReplicaSet,
+                                           http_completion, start_gateway)
+from paddle_tpu.testing import FAULTS, Always, FailNth
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def _tiny_model():
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=176,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=128)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_model()
+
+
+def _engine(model, **kw):
+    from paddle_tpu.inference.serving import LLMEngine
+    kw.setdefault("max_batch", 3)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("prefix_cache", True)
+    return LLMEngine(model, **kw)
+
+
+def _run(model, prompt, max_new, seed=None, cache=True):
+    """Reference: one fresh engine, one request, all tokens out."""
+    eng = _engine(model, prefix_cache=cache)
+    kw = {"max_new_tokens": max_new, "do_sample": seed is not None}
+    if seed is not None:
+        kw["seed"] = seed
+    rid = eng.add_request(list(prompt), **kw)
+    eng.run_until_done()
+    return list(eng.result(rid))
+
+
+PROMPT = list(range(1, 17))                  # 16 tokens = 2 full pages
+
+
+def _durable_gateway(model, tmp_path, n=2, cache=True, **gw_kw):
+    rs = ReplicaSet([_engine(model, prefix_cache=cache) for _ in range(n)],
+                    requeue=True)
+    gw_kw.setdefault("journal_fsync", "never")    # tests: page cache is fine
+    gw = start_gateway(rs, journal_dir=str(tmp_path / "journal"), **gw_kw)
+    _wait_recovered(gw)
+    return rs, gw
+
+
+def _wait_recovered(gw, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        h = json.loads(urllib.request.urlopen(gw.url + "/healthz",
+                                              timeout=10).read())
+        if not h["journal"]["recovering"]:
+            return h
+        time.sleep(0.05)
+    raise TimeoutError("gateway never finished recovery")
+
+
+def _admissions(rs):
+    """Total requests the fleet's engines ever saw — terminal, active, or
+    queued — the number the idempotency acceptance criterion pins."""
+    total = 0
+    for r in rs.replicas:
+        h = r.health()
+        total += h["finished"] + h["active_slots"] + h["waiting"]
+    return total
+
+
+def _sse_read(resp, want=None):
+    """Consume an SSE response; returns ``(tokens, last_id, status)``.
+    ``want`` stops reading after that many tokens (mid-stream disconnect
+    is the caller closing the connection afterwards)."""
+    tokens, last_id, status = [], None, None
+    for raw in resp:
+        line = raw.decode("utf-8").strip()
+        if line.startswith("id: "):
+            last_id = int(line[len("id: "):])
+        elif line.startswith("data: "):
+            payload = line[len("data: "):]
+            if payload == "[DONE]":
+                break
+            evt = json.loads(payload)
+            if "token" in evt:
+                tokens.append(evt["token"])
+                if want is not None and len(tokens) >= want:
+                    break
+            else:
+                status = evt.get("status")
+    return tokens, last_id, status
+
+
+def _stream_request(gw, prompt, max_tokens, key, last_id=None, seed=None):
+    """Open one streaming completion over raw http.client (so the caller
+    can stop mid-stream); returns ``(conn, resp)``."""
+    conn = http.client.HTTPConnection(gw.addr, gw.port, timeout=60)
+    body = {"prompt": list(prompt), "max_tokens": int(max_tokens),
+            "stream": True}
+    if seed is not None:
+        body.update(do_sample=True, seed=seed)
+    headers = {"Content-Type": "application/json", "Idempotency-Key": key}
+    if last_id is not None:
+        headers["Last-Event-ID"] = str(last_id)
+    conn.request("POST", "/v1/completions", body=json.dumps(body),
+                 headers=headers)
+    return conn, conn.getresponse()
+
+
+def _kill_gateway(gw):
+    """kill -9 facsimile: HTTP serving and journal pumps stop dead, no
+    terminal records land, the journal directory is left exactly as the
+    crash left it (the OS would close the fd; buffered lines were already
+    flushed per append, same as a real kill)."""
+    gw._httpd.shutdown()
+    gw._httpd.server_close()
+    gw.plane._closed = True
+
+
+# ------------------------------------------------------------ journal units
+
+class TestJournalUnits:
+    def test_record_roundtrip(self, tmp_path):
+        with RequestJournal(tmp_path, fsync="never") as j:
+            j.append_accepted("k", [1, 2, 3], {"max_new_tokens": 4,
+                                               "seed": 7})
+            j.append_tokens("k", 0, [10, 11])
+            j.append_tokens("k", 2, [12])
+            j.append_terminal("k", RequestStatus.FINISHED)
+            state, counts = j.replay()
+        req = state["k"]
+        assert req.prompt == [1, 2, 3]
+        assert req.kw == {"max_new_tokens": 4, "seed": 7}
+        assert req.tokens == [10, 11, 12]
+        assert req.status is RequestStatus.FINISHED
+        assert counts == {"accepted": 1, "tokens": 2, "terminal": 1,
+                          "result": 0, "torn": 0}
+
+    def test_duplicate_token_records_replay_once(self, tmp_path):
+        # compaction racing a crash can leave the same batch twice; the
+        # seq field makes the second application a no-op
+        with RequestJournal(tmp_path, fsync="never") as j:
+            j.append_accepted("k", [1], {})
+            j.append_tokens("k", 0, [10, 11])
+            j.append_tokens("k", 0, [10, 11])
+            j.append_tokens("k", 1, [11, 12])
+            state, _ = j.replay()
+        assert state["k"].tokens == [10, 11, 12]
+
+    def test_rotation_bounds_segments_and_replay_spans_them(self, tmp_path):
+        with RequestJournal(tmp_path, segment_bytes=128,
+                            fsync="never") as j:
+            for i in range(10):
+                j.append_accepted(f"k{i}", [i], {})
+            stats = j.stats()
+            state, _ = j.replay()
+        assert stats["segments"] > 1
+        assert len(state) == 10
+
+    def test_torn_tail_is_skipped_not_fatal(self, tmp_path):
+        j = RequestJournal(tmp_path, fsync="never")
+        j.append_accepted("k1", [1], {})
+        j.append_tokens("k1", 0, [10])
+        j.close()
+        segs = sorted(p for p in os.listdir(tmp_path)
+                      if p.endswith(".jsonl"))
+        with open(tmp_path / segs[-1], "ab") as fh:
+            fh.write(b'{"c":123,"k":"T","key":"k1","s":1,"t"')  # torn write
+        with RequestJournal(tmp_path, fsync="never") as j2:
+            state, counts = j2.replay()
+        assert counts["torn"] == 1
+        assert state["k1"].tokens == [10]        # pre-tear records survive
+
+    def test_corrupt_record_ends_its_segment_only(self, tmp_path):
+        j = RequestJournal(tmp_path, fsync="never")
+        j.append_accepted("old", [1], {})
+        j._rotate()                              # seal segment 0
+        j.append_accepted("newer", [2], {})
+        j.close()
+        segs = sorted(p for p in os.listdir(tmp_path)
+                      if p.endswith(".jsonl"))
+        # corrupt segment 0 entirely; segment 1 must still replay
+        with open(tmp_path / segs[0], "wb") as fh:
+            fh.write(b"\x00garbage\n")
+        with RequestJournal(tmp_path, fsync="never") as j2:
+            state, counts = j2.replay()
+        assert "newer" in state and "old" not in state
+        assert counts["torn"] == 1
+
+    def test_reopen_never_appends_to_preexisting_segment(self, tmp_path):
+        j = RequestJournal(tmp_path, fsync="never")
+        j.append_accepted("k", [1], {})
+        j.close()
+        j2 = RequestJournal(tmp_path, fsync="never")
+        j2.append_accepted("k2", [2], {})
+        j2.close()
+        segs = [p for p in os.listdir(tmp_path) if p.endswith(".jsonl")]
+        assert len(segs) >= 2                    # fresh segment per open
+
+    def test_compaction_folds_terminals_and_bounds_retention(self, tmp_path):
+        with RequestJournal(tmp_path, fsync="never",
+                            keep_terminal=2) as j:
+            for i in range(5):
+                j.append_accepted(f"k{i}", [i], {"max_new_tokens": 2})
+                j.append_tokens(f"k{i}", 0, [i, i + 1])
+                j.append_terminal(f"k{i}", RequestStatus.FINISHED)
+            j.append_accepted("live", [9], {"max_new_tokens": 8})
+            j.append_tokens("live", 0, [90])
+            dropped = j.compact()
+            state, counts = j.replay()
+        assert dropped == 3
+        # newest keep_terminal=2 terminals survive as RESULT records
+        assert set(state) == {"k3", "k4", "live"}
+        assert counts["result"] == 2
+        assert state["k4"].tokens == [4, 5]
+        assert state["k4"].status is RequestStatus.FINISHED
+        # the non-terminal request keeps everything recovery needs
+        assert state["live"].prompt == [9]
+        assert state["live"].kw == {"max_new_tokens": 8}
+        assert state["live"].tokens == [90]
+        assert state["live"].status is None
+
+    def test_append_fault_point(self, tmp_path):
+        from paddle_tpu.testing.faults import InjectedFault
+        with RequestJournal(tmp_path, fsync="never") as j:
+            FAULTS.install("journal.append", FailNth(2))
+            j.append_accepted("k", [1], {})
+            with pytest.raises(InjectedFault):
+                j.append_tokens("k", 0, [10])
+            FAULTS.reset()
+            # the failed record never landed; the journal still appends
+            j.append_tokens("k", 0, [10])
+            state, _ = j.replay()
+        assert state["k"].tokens == [10]
+
+    def test_fsync_fault_fails_critical_appends_only(self, tmp_path):
+        from paddle_tpu.testing.faults import InjectedFault
+        with RequestJournal(tmp_path, fsync="critical") as j:
+            FAULTS.install("journal.fsync", Always())
+            j.append_tokens("k", 0, [1])         # non-critical: flush only
+            with pytest.raises(InjectedFault):
+                j.append_accepted("k2", [1], {})  # critical: fsync path
+
+
+# ----------------------------------------------------------- durable plane
+
+class TestDurablePlane:
+    def test_accepted_journals_before_ack(self, model, tmp_path):
+        rs = ReplicaSet([_engine(model)], requeue=True)
+        try:
+            plane = DurableRequestPlane(rs, str(tmp_path / "j"),
+                                        fsync="never")
+            req, created = plane.submit("key1", PROMPT,
+                                        {"max_new_tokens": 2,
+                                         "do_sample": False})
+            assert created
+            state, _ = plane.journal.replay()
+            assert state["key1"].prompt == PROMPT   # durable at ack time
+            req.wait_terminal(timeout=60)
+            plane.close()
+        finally:
+            rs.close()
+
+    def test_failed_append_fails_submit_and_runs_nothing(self, model,
+                                                         tmp_path):
+        from paddle_tpu.testing.faults import InjectedFault
+        rs = ReplicaSet([_engine(model)], requeue=True)
+        try:
+            plane = DurableRequestPlane(rs, str(tmp_path / "j"),
+                                        fsync="never")
+            # pace the engine so the cancel races nothing
+            FAULTS.install("serving.slow_step", Always(), delay=0.05)
+            FAULTS.install("journal.append", Always())
+            with pytest.raises(InjectedFault):
+                plane.submit("key1", PROMPT, {"max_new_tokens": 40})
+            FAULTS.reset()
+            assert plane.get("key1") is None
+            state, _ = plane.journal.replay()
+            assert "key1" not in state
+            # the already-routed request was cancelled, not left decoding
+            deadline = time.monotonic() + 15
+            while (time.monotonic() < deadline
+                   and rs.replicas[0].health()["cancels"] == 0):
+                time.sleep(0.05)
+            assert rs.replicas[0].health()["cancels"] == 1
+            plane.close()
+        finally:
+            FAULTS.reset()
+            rs.close()
+
+    def test_detach_ttl_cancels_orphaned_request(self, model, tmp_path):
+        rs = ReplicaSet([_engine(model)], requeue=True)
+        try:
+            plane = DurableRequestPlane(rs, str(tmp_path / "j"),
+                                        fsync="never", detach_ttl=0.2)
+            FAULTS.install("serving.slow_step", Always(), delay=0.05)
+            req, _ = plane.submit("orphan", PROMPT,
+                                  {"max_new_tokens": 40})
+            # nobody ever attaches: the grace TTL must reap it
+            _, status = req.wait_terminal(timeout=30)
+            assert status is RequestStatus.CANCELLED
+            state, _ = plane.journal.replay()
+            assert state["orphan"].status is RequestStatus.CANCELLED
+            plane.close()
+        finally:
+            FAULTS.reset()
+            rs.close()
+
+    def test_attached_stream_is_not_reaped(self, model, tmp_path):
+        rs = ReplicaSet([_engine(model)], requeue=True)
+        try:
+            plane = DurableRequestPlane(rs, str(tmp_path / "j"),
+                                        fsync="never", detach_ttl=0.1)
+            FAULTS.install("serving.slow_step", Always(), delay=0.03)
+            req, _ = plane.submit("held", PROMPT, {"max_new_tokens": 8,
+                                                   "do_sample": False})
+            plane.attach(req)
+            try:
+                got = [t for _s, t in req.events()]
+            finally:
+                plane.detach(req)
+            assert req.status is not RequestStatus.CANCELLED
+            assert len(got) == 8
+            plane.close()
+        finally:
+            FAULTS.reset()
+            rs.close()
+
+
+# ------------------------------------------- idempotent submission (HTTP)
+
+class TestIdempotency:
+    def test_replayed_key_serves_journal_without_rerun(self, model,
+                                                       tmp_path):
+        ref = _run(model, PROMPT, 6)
+        rs, gw = _durable_gateway(model, tmp_path)
+        try:
+            first = http_completion(gw.url, PROMPT, max_tokens=6,
+                                    stream=True,
+                                    headers={"Idempotency-Key": "idem"})
+            assert first["tokens"] == ref
+            admitted = _admissions(rs)
+            # stream and non-stream replays: same tokens, no new admission
+            again = http_completion(gw.url, PROMPT, max_tokens=6,
+                                    stream=True,
+                                    headers={"Idempotency-Key": "idem"})
+            blocking = http_completion(gw.url, PROMPT, max_tokens=6,
+                                       headers={"Idempotency-Key": "idem"})
+            assert again["tokens"] == ref
+            assert blocking["tokens"] == ref
+            assert blocking["idempotency_key"] == "idem"
+            assert _admissions(rs) == admitted
+        finally:
+            gw.close()
+            rs.close()
+
+    def test_generated_key_is_echoed_for_streams(self, model, tmp_path):
+        rs, gw = _durable_gateway(model, tmp_path)
+        try:
+            conn, resp = _stream_request(gw, PROMPT, 2, key="echoed")
+            assert resp.getheader("Idempotency-Key") == "echoed"
+            _sse_read(resp)
+            conn.close()
+        finally:
+            gw.close()
+            rs.close()
+
+    def test_sse_events_carry_monotonic_ids(self, model, tmp_path):
+        rs, gw = _durable_gateway(model, tmp_path)
+        try:
+            out = http_completion(gw.url, PROMPT, max_tokens=5, stream=True,
+                                  headers={"Idempotency-Key": "ids"})
+            assert out["last_id"] == 4          # ids 0..4, one per token
+            assert out["tokens"] == _run(model, PROMPT, 5)
+        finally:
+            gw.close()
+            rs.close()
+
+
+# -------------------------------------------- Last-Event-ID splice parity
+
+class TestReattachSplice:
+    """A client that disconnects mid-stream and reconnects with
+    Last-Event-ID gets journal replay spliced onto the live stream —
+    the concatenation is byte-identical to the uninterrupted run, at
+    every offset, greedy and fixed-seed, prefix cache on and off."""
+
+    @pytest.mark.parametrize("cache", [True, False],
+                             ids=["prefix-cache", "no-cache"])
+    def test_reattach_parity_sweep(self, model, tmp_path, cache):
+        for seed in (None, 7):
+            ref = _run(model, PROMPT, 8, seed=seed, cache=cache)
+            for offset in (1, 3):
+                d = tmp_path / f"s{seed}-o{offset}"
+                d.mkdir()
+                rs, gw = _durable_gateway(model, d, cache=cache)
+                try:
+                    key = f"re-{seed}-{offset}"
+                    FAULTS.install("serving.slow_step", Always(),
+                                   delay=0.05)
+                    conn, resp = _stream_request(gw, PROMPT, 8, key=key,
+                                                 seed=seed)
+                    head, last_id, _ = _sse_read(resp, want=offset)
+                    conn.close()                 # vanish mid-stream
+                    FAULTS.reset()
+                    assert last_id == offset - 1
+                    conn2, resp2 = _stream_request(gw, PROMPT, 8, key=key,
+                                                   last_id=last_id,
+                                                   seed=seed)
+                    tail, _, status = _sse_read(resp2)
+                    conn2.close()
+                    assert status in ("finished", "eos")
+                    assert head + tail == ref, (
+                        f"seed={seed} offset={offset} cache={cache}: "
+                        f"spliced stream diverged")
+                finally:
+                    FAULTS.reset()
+                    gw.close()
+                    rs.close()
+
+    def test_reattach_ticks_metric_and_detach_preserves_request(
+            self, model, tmp_path):
+        obs.enable()
+        try:
+            rs, gw = _durable_gateway(model, tmp_path, detach_ttl=30.0)
+            try:
+                FAULTS.install("serving.slow_step", Always(), delay=0.05)
+                conn, resp = _stream_request(gw, PROMPT, 8, key="met")
+                _head, last_id, _ = _sse_read(resp, want=2)
+                conn.close()
+                FAULTS.reset()
+                # the disconnect DETACHED (grace TTL pending), the pump
+                # kept decoding: the reconnect must find it undamaged
+                conn2, resp2 = _stream_request(gw, PROMPT, 8, key="met",
+                                               last_id=last_id)
+                tail, _, status = _sse_read(resp2)
+                conn2.close()
+                assert status != "cancelled"
+                assert len(tail) == 6
+                text = obs.render_prometheus()
+                assert "stream_reattach_total 1" in text
+            finally:
+                FAULTS.reset()
+                gw.close()
+                rs.close()
+        finally:
+            obs.disable()
+            obs.reset()
+
+
+# ------------------------------------------------- gateway crash recovery
+
+class TestGatewayCrashRecovery:
+    """The acceptance chaos test: kill -9 the gateway mid-stream, restart
+    against the same journal dir, reconnect with Last-Event-ID — the
+    concatenated stream is byte-identical, no duplicate or missing
+    events, and idempotent re-submits do not re-execute."""
+
+    @pytest.mark.parametrize("cache", [True, False],
+                             ids=["prefix-cache", "no-cache"])
+    @pytest.mark.parametrize("seed", [None, 7], ids=["greedy", "seeded"])
+    def test_kill9_restart_reconnect_byte_identical(self, model, tmp_path,
+                                                    seed, cache):
+        obs.enable()
+        try:
+            ref = _run(model, PROMPT, 8, seed=seed, cache=cache)
+            rs, gw = _durable_gateway(model, tmp_path, cache=cache)
+            key = "crash"
+            try:
+                FAULTS.install("serving.slow_step", Always(), delay=0.1)
+                conn, resp = _stream_request(gw, PROMPT, 8, key=key,
+                                             seed=seed)
+                head, last_id, _ = _sse_read(resp, want=3)
+                _kill_gateway(gw)                # mid-stream, no goodbye
+                conn.close()
+            finally:
+                FAULTS.reset()
+                rs.close()
+            assert head == ref[:3]
+
+            # fresh gateway, fresh engines, same journal dir
+            rs2, gw2 = _durable_gateway(model, tmp_path, cache=cache)
+            try:
+                conn2, resp2 = _stream_request(gw2, PROMPT, 8, key=key,
+                                               last_id=last_id, seed=seed)
+                tail, _, status = _sse_read(resp2)
+                conn2.close()
+                assert status in ("finished", "eos")
+                assert head + tail == ref, (
+                    f"seed={seed} cache={cache}: stream across gateway "
+                    f"death diverged")
+                # recovery admitted the resumed request exactly once; the
+                # reconnect replayed from the journal, it did not re-run
+                assert _admissions(rs2) == 1
+                text = obs.render_prometheus()
+                assert "gateway_recoveries_total 1" in text
+                assert 'journal_replayed_total{kind="accepted"} 1' in text
+                assert 'kind="tokens"' in text
+            finally:
+                gw2.close()
+                rs2.close()
+        finally:
+            obs.disable()
+            obs.reset()
+
+    def test_terminal_requests_recover_as_replay_only(self, model,
+                                                      tmp_path):
+        ref = _run(model, PROMPT, 4)
+        rs, gw = _durable_gateway(model, tmp_path)
+        try:
+            done = http_completion(gw.url, PROMPT, max_tokens=4,
+                                   stream=True,
+                                   headers={"Idempotency-Key": "done"})
+            assert done["tokens"] == ref
+            _kill_gateway(gw)
+        finally:
+            rs.close()
+        rs2, gw2 = _durable_gateway(model, tmp_path)
+        try:
+            admitted = _admissions(rs2)
+            replay = http_completion(gw2.url, PROMPT, max_tokens=4,
+                                     headers={"Idempotency-Key": "done"})
+            assert replay["tokens"] == ref
+            assert replay["status"] in ("finished", "eos")
+            assert _admissions(rs2) == admitted   # replay-only, no re-run
+        finally:
+            gw2.close()
+            rs2.close()
+
+    def test_recover_fault_fails_request_durably(self, model, tmp_path):
+        rs, gw = _durable_gateway(model, tmp_path)
+        try:
+            FAULTS.install("serving.slow_step", Always(), delay=0.1)
+            conn, resp = _stream_request(gw, PROMPT, 8, key="doomed")
+            _sse_read(resp, want=1)
+            _kill_gateway(gw)
+            conn.close()
+        finally:
+            FAULTS.reset()
+            rs.close()
+        FAULTS.install("gateway.recover", Always())
+        rs2, gw2 = _durable_gateway(model, tmp_path)
+        try:
+            FAULTS.reset()
+            out = http_completion(gw2.url, PROMPT, max_tokens=8,
+                                  stream=True,
+                                  headers={"Idempotency-Key": "doomed"})
+            assert out["status"] == "failed"
+            # the failure is journaled: a THIRD gateway serves it replay-
+            # only instead of re-driving a poisoned request forever
+            state, _ = gw2.plane.journal.replay()
+            assert state["doomed"].status is RequestStatus.FAILED
+        finally:
+            gw2.close()
+            rs2.close()
+
+    def test_healthz_journal_state_and_recovery_shed(self, model,
+                                                     tmp_path):
+        rs, gw = _durable_gateway(model, tmp_path)
+        try:
+            h = json.loads(urllib.request.urlopen(gw.url + "/healthz",
+                                                  timeout=10).read())
+            assert h["journal"]["depth"] == 0
+            assert h["journal"]["recovering"] is False
+            assert "segments" in h["journal"]
+            assert set(h) == {"r0", "r1", "journal"}
+            # while recovery owns the fleet, submits shed with Retry-After
+            gw.plane.recovering = True
+            conn = http.client.HTTPConnection(gw.addr, gw.port, timeout=10)
+            conn.request("POST", "/v1/completions",
+                         body=json.dumps({"prompt": PROMPT,
+                                          "max_tokens": 2}),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+            assert resp.status == 503
+            assert resp.getheader("Retry-After") == "1"
+            assert body["recovering"] is True
+            conn.close()
+            gw.plane.recovering = False
+        finally:
+            gw.close()
+            rs.close()
+
+
+# ------------------------------------------------- real processes (slow tier)
+
+@pytest.mark.slow
+class TestRealKillNine:
+    def test_sigkill_gateway_subprocess(self, tmp_path):
+        """A real gateway process, a real SIGKILL, the same journal dir."""
+        child = os.path.join(os.path.dirname(__file__), "_gateway_child.py")
+        repo = os.path.dirname(os.path.dirname(child))
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               # script-by-path puts tests/ on sys.path, not the repo root
+               "PYTHONPATH": repo + os.pathsep
+               + os.environ.get("PYTHONPATH", "")}
+        journal_dir = str(tmp_path / "journal")
+        ref = _run(_tiny_model(), PROMPT, 8)
+
+        def spawn():
+            p = subprocess.Popen(
+                [sys.executable, child, journal_dir, "--slow-step", "0.2"],
+                env=env, cwd=os.path.dirname(os.path.dirname(child)),
+                stdout=subprocess.PIPE, text=True)
+            line = p.stdout.readline().strip()   # "READY <port>"
+            assert line.startswith("READY "), f"child said {line!r}"
+            return p, int(line.split()[1])
+
+        p1, port = spawn()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+            conn.request("POST", "/v1/completions",
+                         body=json.dumps({"prompt": PROMPT, "max_tokens": 8,
+                                          "stream": True}),
+                         headers={"Content-Type": "application/json",
+                                  "Idempotency-Key": "real"})
+            head, last_id, _ = _sse_read(conn.getresponse(), want=3)
+            os.kill(p1.pid, signal.SIGKILL)
+            p1.wait(timeout=30)
+            conn.close()
+            assert head == ref[:3]
+
+            p2, port2 = spawn()
+            try:
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    h = json.loads(urllib.request.urlopen(
+                        f"http://127.0.0.1:{port2}/healthz",
+                        timeout=10).read())
+                    if not h["journal"]["recovering"]:
+                        break
+                    time.sleep(0.2)
+                conn2 = http.client.HTTPConnection("127.0.0.1", port2,
+                                                   timeout=120)
+                conn2.request(
+                    "POST", "/v1/completions",
+                    body=json.dumps({"prompt": PROMPT, "max_tokens": 8,
+                                     "stream": True}),
+                    headers={"Content-Type": "application/json",
+                             "Idempotency-Key": "real",
+                             "Last-Event-ID": str(last_id)})
+                tail, _, status = _sse_read(conn2.getresponse())
+                conn2.close()
+                assert status in ("finished", "eos")
+                assert head + tail == ref
+            finally:
+                p2.terminate()
+                p2.wait(timeout=30)
+        finally:
+            for p in (p1,):
+                if p.poll() is None:
+                    p.kill()
