@@ -16,7 +16,7 @@ import os
 
 from .framework import Finding
 
-_SCHEMA = 2    # v2: Finding records carry a severity field
+_SCHEMA = 3    # v3: concurrency pass + per-pass rule-ID listings
 
 
 def default_cache_path():

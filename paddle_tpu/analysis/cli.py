@@ -32,11 +32,26 @@ import json
 import sys
 
 
+def _rule_lines():
+    """One ``name: CODE, CODE, ...`` line per registered pass (epilog,
+    --version).  Imports the built-in passes as a side effect."""
+    from . import passes as _passes  # noqa: F401 — register built-ins
+    from .framework import PASSES
+    out = []
+    for name in sorted(PASSES):
+        codes = ", ".join(PASSES[name].codes) or "(no stable rule IDs)"
+        out.append(f"  {name:24s} {codes}")
+    return out
+
+
 def _parser():
     p = argparse.ArgumentParser(
         prog="graftlint",
-        description="trace-safety, registry-parity, sharding and dtype "
-                    "static analysis for the paddle_tpu tree")
+        description="trace-safety, registry-parity, sharding, dtype and "
+                    "lock-discipline static analysis for the paddle_tpu "
+                    "tree",
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+        epilog="rule IDs by pass:\n" + "\n".join(_rule_lines()))
     p.add_argument("paths", nargs="*", default=["."],
                    help="files or directories to lint (default: .)")
     p.add_argument("--format", choices=("text", "json", "sarif"),
@@ -58,7 +73,10 @@ def _parser():
     p.add_argument("--cache", metavar="FILE",
                    help="cache file (default: $GRAFTLINT_CACHE or "
                         "~/.cache/graftlint/cache.json)")
-    p.add_argument("--list-passes", action="store_true")
+    p.add_argument("--list-passes", action="store_true",
+                   help="list registered passes with their rule IDs")
+    p.add_argument("--version", action="store_true",
+                   help="print pass versions and rule IDs, then exit")
     return p
 
 
@@ -70,11 +88,20 @@ def main(argv=None) -> int:
     args = _parser().parse_args(argv)
     from . import passes as _passes  # noqa: F401 — register built-ins
     from .framework import PASSES, run
+    if args.version:
+        from .cache import _SCHEMA
+        print(f"graftlint (cache schema v{_SCHEMA})")
+        for line in _rule_lines():
+            print(line)
+        return 0
     if args.list_passes:
         for name in sorted(PASSES):
             p = PASSES[name]
             scope = "project" if p.project_scope else "file"
+            codes = " ".join(p.codes)
             print(f"{name:24s} v{p.version} [{scope}]  {p.description}")
+            if codes:
+                print(f"{'':24s} rules: {codes}")
         return 0
     cache = None
     if not args.no_cache:

@@ -153,6 +153,7 @@ class AnalysisPass:
     name: str = ""
     version: int = 1
     description: str = ""
+    codes: tuple = ()             # rule IDs the pass can emit (CLI listing)
     project_scope: bool = False   # True -> check_project, uncacheable
 
     def check_file(self, src: SourceFile) -> list[Finding]:

@@ -1,8 +1,8 @@
 """graftlint passes — importing this package registers every built-in pass."""
-from . import (dtype_rules, jit_cache_hygiene, namespace_parity,  # noqa: F401
-               no_adhoc_telemetry, registry_parity, robustness,
-               sharding_spec, trace_safety)
+from . import (concurrency, dtype_rules, jit_cache_hygiene,  # noqa: F401
+               namespace_parity, no_adhoc_telemetry, registry_parity,
+               robustness, sharding_spec, trace_safety)
 
-__all__ = ["dtype_rules", "jit_cache_hygiene", "namespace_parity",
-           "no_adhoc_telemetry", "registry_parity", "robustness",
-           "sharding_spec", "trace_safety"]
+__all__ = ["concurrency", "dtype_rules", "jit_cache_hygiene",
+           "namespace_parity", "no_adhoc_telemetry", "registry_parity",
+           "robustness", "sharding_spec", "trace_safety"]

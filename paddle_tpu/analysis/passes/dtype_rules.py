@@ -76,6 +76,7 @@ def _arrays(obj):
 class DtypeRulesPass(AnalysisPass):
     name = "dtype-rules"
     version = 1
+    codes = ("DT101", "DT102", "DT103")
     description = ("op-table dtype checks against core.dtype promotion: "
                    "64-bit samples that narrow, float64 goldens, "
                    "non-differentiable grad samples")

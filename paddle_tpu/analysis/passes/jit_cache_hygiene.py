@@ -51,6 +51,7 @@ def _defaults(fn):
 class JitCacheHygienePass(AnalysisPass):
     name = "jit-cache-hygiene"
     version = 1
+    codes = ("JH001", "JH002", "JH003", "JH004")
     description = ("unhashable/tensor-valued defaults and non-static "
                    "containers as static args on jit entries")
 

@@ -85,6 +85,7 @@ def _static_names(tree):
 class NamespaceParityPass(AnalysisPass):
     name = "namespace-parity"
     version = 1
+    codes = ("NS001", "NS002")
     description = "__all__ entries must resolve to real module attributes"
     project_scope = True    # imports modules for ground truth
 

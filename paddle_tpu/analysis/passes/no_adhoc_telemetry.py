@@ -35,6 +35,7 @@ _HINTS = {
 class NoAdhocTelemetryPass(AnalysisPass):
     name = "no-adhoc-telemetry"
     version = 1
+    codes = ("AT101", "AT102")
     description = ("bare print() and wall-clock time.time() timing in "
                    "library code (vs logging/registry/perf_counter)")
 

@@ -66,6 +66,8 @@ class _RegCall:
 class RegistryParityPass(AnalysisPass):
     name = "registry-parity"
     version = 1
+    codes = ("RP001", "RP002", "RP003", "RP004", "RP005",
+             "RP006", "RP007", "RP008")
     description = ("op-registry consistency: resolver existence/arity, "
                    "golden references, duplicate names, categories")
     project_scope = True    # runtime half imports the live registry

@@ -285,6 +285,7 @@ def _target_released(scope, target):
 class RobustnessPass(AnalysisPass):
     name = "robustness"
     version = 5
+    codes = ("RB101", "RB102", "RB103", "RB104", "RB105")
     description = ("swallowed exceptions: broad except handlers whose "
                    "whole body is pass (RB101) or a bare "
                    "continue/break/return (RB102); orphan threads: "

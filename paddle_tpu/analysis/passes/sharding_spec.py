@@ -116,6 +116,7 @@ def _spec_axes(node, imports):
 class ShardingSpecPass(AnalysisPass):
     name = "sharding-spec-coverage"
     version = 3
+    codes = ("SS101", "SS102", "SS103", "SS104", "SS105", "SS106")
     description = ("shard_map contract checks: in/out_specs arity, spec and "
                    "collective axis names vs the mesh, collectives under "
                    "data-dependent control flow, NamedSharding/"

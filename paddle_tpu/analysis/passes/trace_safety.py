@@ -228,6 +228,7 @@ class _FuncModel:
 class TraceSafetyPass(AnalysisPass):
     name = "trace-safety"
     version = 1
+    codes = ("TS101", "TS102", "TS103", "TS104", "TS105")
     description = ("data-dependent branching, host escapes, np.* calls and "
                    "global mutation inside jit-traced code")
 

@@ -136,10 +136,14 @@ class _PyStoreServer(threading.Thread):
                             if left <= 0:
                                 break
                             self._cv.wait(left)
-                        if key in self._kv:
-                            self._reply(conn, 0, self._kv[key])
-                        else:
-                            self._reply(conn, 3 if deleted else 1)
+                        out = self._kv.get(key)
+                    # reply OUTSIDE the lock like every other command path:
+                    # sendall to one slow client must not stall the whole
+                    # store (every GET/SET/WAIT contends on this condition)
+                    if out is not None:
+                        self._reply(conn, 0, out)
+                    else:
+                        self._reply(conn, 3 if deleted else 1)
                 elif cmd == _ADD:
                     (delta,) = struct.unpack("!q", val)
                     with self._cv:
@@ -245,9 +249,12 @@ class TCPStore:
         t = self.timeout if timeout is None else timeout
         with self._lock:
             # the server enforces t; the socket deadline is a dead-server
-            # backstop with generous grace
+            # backstop with generous grace.  Socket I/O under _lock is the
+            # lock's whole purpose: one shared socket, one in-flight RPC —
+            # request/reply framing would interleave without it.
             self._sock.settimeout(t + 30)
-            self._sock.sendall(_pack_req(cmd, key, val, t))
+            self._sock.sendall(  # graftlint: disable=concurrency
+                _pack_req(cmd, key, val, t))
             status, out = _read_reply(self._sock)
         if status == 1:
             raise TimeoutError(f"TCPStore cmd {cmd} ({key!r}) timed out")

@@ -165,8 +165,11 @@ class EngineReplica:
         loop whenever the wedged step finally returns."""
         timeout = self.step_wall_timeout
         tick = max(0.01, min(0.25, timeout / 4.0))
-        while not self._stop and self.alive:
-            t0 = self._step_t0
+        # lock-free reads BY DESIGN: the wedged step owns the cv, so the
+        # watchdog must never take it.  _stop/alive are monotonic flags and
+        # a stale _step_t0 only delays the trip by one tick.
+        while not self._stop and self.alive:  # graftlint: disable=concurrency
+            t0 = self._step_t0                # graftlint: disable=concurrency
             if t0 is not None and time.monotonic() - t0 > timeout:
                 self._trip_stuck(time.monotonic() - t0)
                 return
@@ -175,11 +178,13 @@ class EngineReplica:
     def _trip_stuck(self, elapsed):
         """Lock-free replica death for a wedged step (see ``_watch_steps``):
         everything ``_die`` does except touching the engine, which stays
-        owned by the stuck step thread."""
-        self.error = StuckStepError(
+        owned by the stuck step thread.  The cv-free error/alive writes are
+        the point — taking the cv here would deadlock on the stuck step —
+        hence the concurrency pragmas."""
+        self.error = StuckStepError(  # graftlint: disable=concurrency
             f"replica {self.name!r} step exceeded step_wall_timeout="
             f"{self.step_wall_timeout}s (ran {elapsed:.2f}s)")
-        self.alive = False
+        self.alive = False            # graftlint: disable=concurrency
         _obs.FRONTEND_STUCK_STEPS.inc(replica=self.name)
         if self.router is not None:
             self.router.forget(self.name)
@@ -336,9 +341,11 @@ class EngineReplica:
     def health(self):
         with self._cv:
             h = self.engine.health()
+            # read alive/error under the cv too: the snapshot then can't
+            # pair a pre-death engine view with a post-death error
+            h["alive"] = self.alive
+            h["error"] = repr(self.error) if self.error is not None else None
         h["replica"] = self.name
-        h["alive"] = self.alive
-        h["error"] = repr(self.error) if self.error is not None else None
         return h
 
     def metrics(self):

@@ -88,6 +88,7 @@ class WorkerSupervisor:
         Synchronous and injectable-clock deterministic: a crashed child is
         either respawned (after the backoff ``sleep``) or quarantined right
         here."""
+        delay = None
         with self._lock:
             if self.stopped:
                 return STOPPED
@@ -113,14 +114,24 @@ class WorkerSupervisor:
                 # poll instead of routing to a quarantined member for the
                 # rest of the TTL
             else:
+                self.proc = None
                 streak = len(self._crashes) - 1
                 delay = min(self.max_delay,
                             self.base_delay * self.multiplier ** streak)
-                self.sleep(delay)
+        if delay is not None:
+            # backoff OUTSIDE the lock: a concurrent stop()/reset() must
+            # not block behind up to max_delay of sleep, and a stop that
+            # lands mid-backoff wins — re-check before respawning
+            self.sleep(delay)
+            with self._lock:
+                if self.stopped:
+                    return STOPPED
+                if self.quarantined:
+                    return QUARANTINED
                 self.proc = self.spawn()
                 self.restarts += 1
-                _obs.FRONTEND_RESTARTS.inc(replica=self.name)
-                return RESPAWNED
+            _obs.FRONTEND_RESTARTS.inc(replica=self.name)
+            return RESPAWNED
         if self.membership is not None:
             try:
                 self.membership.evict(self.name)

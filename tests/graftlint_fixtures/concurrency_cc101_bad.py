@@ -1,0 +1,18 @@
+"""CC101 fixture: attribute guarded in one method, naked in another."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0          # __init__ writes are exempt
+
+    def inc(self):
+        with self._lock:
+            self.value += 1     # establishes the guard
+
+    def read(self):
+        return self.value       # CC101: no lock held
+
+    def bump_unlocked(self):
+        self.value += 2         # CC101: write with no lock held

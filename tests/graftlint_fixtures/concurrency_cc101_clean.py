@@ -1,0 +1,20 @@
+"""CC101 clean fixture: every access takes the guarding lock, and the
+helper is analyzed under the lock its only callers hold."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def inc(self):
+        with self._lock:
+            self._bump()
+
+    def _bump(self):
+        self.value += 1         # caller holds the lock (inherited context)
+
+    def read(self):
+        with self._lock:
+            return self.value

@@ -1,0 +1,28 @@
+"""CC102 fixture: blocking calls under a held lock, direct and one
+call-hop deep through a same-class helper."""
+import os
+import threading
+import time
+
+
+class Checkpointer:
+    def __init__(self, sleep=time.sleep):
+        self._mu = threading.Lock()
+        self.sleep = sleep
+        self.dirty = False
+
+    def settle(self):
+        with self._mu:
+            time.sleep(0.1)        # CC102: literal sleep under the lock
+
+    def settle_injected(self):
+        with self._mu:
+            self.sleep(0.1)        # CC102: injectable sleep attribute
+
+    def flush(self, fd):
+        with self._mu:
+            self._sync(fd)         # CC102: helper fsyncs, one hop deep
+            self.dirty = False
+
+    def _sync(self, fd):
+        os.fsync(fd)

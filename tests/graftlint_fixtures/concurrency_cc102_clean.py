@@ -1,0 +1,24 @@
+"""CC102 clean fixture: snapshot under the lock, block outside it."""
+import os
+import threading
+import time
+
+
+class Checkpointer:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.dirty = False
+
+    def settle(self):
+        time.sleep(0.1)            # not under any lock
+        with self._mu:
+            self.dirty = False
+
+    def flush(self, fd):
+        with self._mu:
+            self.dirty = False
+        self._sync(fd)             # helper blocks outside the lock
+        time.sleep(0.0)
+
+    def _sync(self, fd):
+        os.fsync(fd)

@@ -1,0 +1,19 @@
+"""CC103 fixture: if-guarded wait, and notify outside the owning with."""
+import threading
+
+
+class Mailbox:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.items = []
+
+    def get(self):
+        with self._cv:
+            if not self.items:
+                self._cv.wait()          # CC103: not re-checked in a while
+            return self.items.pop()
+
+    def put(self, item):
+        with self._cv:
+            self.items.append(item)
+        self._cv.notify_all()            # CC103: lock already released

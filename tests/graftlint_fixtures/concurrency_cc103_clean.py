@@ -1,0 +1,25 @@
+"""CC103 clean fixture: while-predicate waits, notify under the cv, and
+wait_for (which embeds its predicate)."""
+import threading
+
+
+class Mailbox:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.items = []
+
+    def get(self):
+        with self._cv:
+            while not self.items:
+                self._cv.wait()
+            return self.items.pop()
+
+    def get_eventually(self):
+        with self._cv:
+            self._cv.wait_for(lambda: self.items)
+            return self.items.pop()
+
+    def put(self, item):
+        with self._cv:
+            self.items.append(item)
+            self._cv.notify_all()

@@ -1,0 +1,21 @@
+"""CC104 fixture: two locks taken in opposite orders on two paths."""
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._accounts = threading.Lock()
+        self._audit = threading.Lock()
+        self.balance = 0
+        self.trail = []
+
+    def transfer(self, n):
+        with self._accounts:
+            with self._audit:            # accounts -> audit
+                self.balance += n
+                self.trail.append(n)
+
+    def reconcile(self):
+        with self._audit:
+            with self._accounts:         # audit -> accounts: inversion
+                self.trail.append(self.balance)

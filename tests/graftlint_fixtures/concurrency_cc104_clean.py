@@ -1,0 +1,21 @@
+"""CC104 clean fixture: one global order, every path honors it."""
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._accounts = threading.Lock()
+        self._audit = threading.Lock()
+        self.balance = 0
+        self.trail = []
+
+    def transfer(self, n):
+        with self._accounts:
+            with self._audit:            # accounts -> audit everywhere
+                self.balance += n
+                self.trail.append(n)
+
+    def reconcile(self):
+        with self._accounts:
+            with self._audit:
+                self.trail.append(self.balance)

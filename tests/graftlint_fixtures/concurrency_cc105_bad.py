@@ -1,0 +1,22 @@
+"""CC105 fixture: a non-reentrant Lock re-acquired along an intra-class
+call chain (and directly, in a nested with)."""
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.n = 0
+
+    def add(self, k):
+        with self._mu:
+            self._bump(k)                # CC105: _bump retakes _mu
+
+    def add_twice(self, k):
+        with self._mu:
+            with self._mu:               # CC105: immediate re-acquire
+                self.n += 2 * k
+
+    def _bump(self, k):
+        with self._mu:
+            self.n += k

@@ -1,0 +1,23 @@
+"""CC105 clean fixture: the re-entered lock is an RLock, and the plain
+Lock is only ever taken once per call chain."""
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._mu = threading.RLock()     # reentrant: chain re-entry is fine
+        self._flat = threading.Lock()
+        self.n = 0
+        self.m = 0
+
+    def add(self, k):
+        with self._mu:
+            self._bump(k)
+
+    def _bump(self, k):
+        with self._mu:
+            self.n += k
+
+    def poke(self):
+        with self._flat:
+            self.m += 1

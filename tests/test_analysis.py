@@ -545,6 +545,214 @@ def test_dtype_rules_skips_non_registry_files(tmp_path):
     assert res.findings == []
 
 
+# ----------------------------------------------------------- concurrency
+
+def _cc(paths):
+    return run([str(p) for p in paths], select=["concurrency"])
+
+
+def test_concurrency_cc101_bad_fixture():
+    res = _cc([FIXTURES / "concurrency_cc101_bad.py"])
+    assert _codes(res) == {"CC101"}
+    # one finding per (attr, method): the naked read AND the naked write
+    assert len(res.findings) == 2
+    assert all(f.severity == "warning" for f in res.findings)
+    assert any("read with no lock held in read()" in f.message
+               for f in res.findings)
+
+
+def test_concurrency_cc101_clean_fixture():
+    # the clean fixture routes writes through a caller-holds-the-lock
+    # helper: inherited lock context must keep it silent
+    res = _cc([FIXTURES / "concurrency_cc101_clean.py"])
+    assert res.findings == []
+
+
+def test_concurrency_cc102_bad_fixture():
+    res = _cc([FIXTURES / "concurrency_cc102_bad.py"])
+    assert _codes(res) == {"CC102"}
+    msgs = "\n".join(f.message for f in res.findings)
+    assert "time.sleep()" in msgs
+    assert "injectable sleep" in msgs          # self.sleep = sleep param
+    assert "which does os.fsync()" in msgs     # one call-hop into _sync()
+
+
+def test_concurrency_cc102_clean_fixture():
+    res = _cc([FIXTURES / "concurrency_cc102_clean.py"])
+    assert res.findings == []
+
+
+def test_concurrency_cc103_bad_fixture():
+    res = _cc([FIXTURES / "concurrency_cc103_bad.py"])
+    assert _codes(res) == {"CC103"}
+    assert all(f.severity == "error" for f in res.findings)
+    msgs = "\n".join(f.message for f in res.findings)
+    assert "not inside a while loop" in msgs
+    assert "notify_all() in put() outside" in msgs
+
+
+def test_concurrency_cc103_clean_fixture():
+    # while-predicate waits, notify under the cv, and a wait_for lambda
+    # predicate (which runs WITH the lock held — no CC101 either)
+    res = _cc([FIXTURES / "concurrency_cc103_clean.py"])
+    assert res.findings == []
+
+
+def test_concurrency_cc104_bad_fixture():
+    res = _cc([FIXTURES / "concurrency_cc104_bad.py"])
+    assert _codes(res) == {"CC104"}
+    (f,) = res.findings
+    assert f.severity == "error"
+    # both sites cited by method name (messages stay line-free so the
+    # baseline fingerprint survives reformatting)
+    assert "transfer()" in f.message and "reconcile()" in f.message
+    assert "lock-order inversion" in f.message
+
+
+def test_concurrency_cc104_clean_fixture():
+    res = _cc([FIXTURES / "concurrency_cc104_clean.py"])
+    assert res.findings == []
+
+
+def test_concurrency_cc105_bad_fixture():
+    res = _cc([FIXTURES / "concurrency_cc105_bad.py"])
+    assert _codes(res) == {"CC105"}
+    msgs = "\n".join(f.message for f in res.findings)
+    assert "calls self._bump(), which acquires it again" in msgs
+    assert "re-acquired in a nested with" in msgs
+
+
+def test_concurrency_cc105_clean_fixture():
+    res = _cc([FIXTURES / "concurrency_cc105_clean.py"])
+    assert res.findings == []
+
+
+def test_concurrency_inherited_lock_context(tmp_path):
+    # a helper is only "caller holds the lock" when EVERY non-init call
+    # site holds it: one naked call site revokes the inheritance
+    res = _lint(tmp_path, """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self.n = 0
+
+            def locked_path(self):
+                with self._mu:
+                    self.n += 1
+                    self._bump()
+
+            def naked_path(self):
+                self._bump()
+
+            def _bump(self):
+                self.n += 1
+        """, select=["concurrency"])
+    assert _codes(res) == {"CC101"}
+    assert any("in _bump()" in f.message for f in res.findings)
+
+
+def test_concurrency_module_level_lock_order(tmp_path):
+    res = _lint(tmp_path, """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def forward():
+            with _a:
+                with _b:
+                    pass
+
+        def backward():
+            with _b:
+                with _a:
+                    pass
+        """, select=["concurrency"])
+    assert _codes(res) == {"CC104"}
+
+
+def test_concurrency_init_is_exempt(tmp_path):
+    # __init__ populates guarded attrs before the object is shared
+    res = _lint(tmp_path, """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self.n = 0
+                self.n += 1
+
+            def bump(self):
+                with self._mu:
+                    self.n += 1
+        """, select=["concurrency"])
+    assert res.findings == []
+
+
+def test_concurrency_nested_def_holds_nothing(tmp_path):
+    # a closure defined under the lock runs later (possibly on another
+    # thread): the sleep inside it is NOT "blocking while holding"
+    res = _lint(tmp_path, """
+        import threading
+        import time
+
+        class Box:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def arm(self):
+                with self._mu:
+                    def later():
+                        time.sleep(1.0)
+                    return later
+        """, select=["concurrency"])
+    assert res.findings == []
+
+
+def test_concurrency_pragma_and_baseline(tmp_path):
+    src = """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self.n = 0
+
+            def bump(self):
+                with self._mu:
+                    self.n += 1
+
+            def peek(self):
+                return self.n{pragma}
+        """
+    flagged = _lint(tmp_path, src.format(pragma=""))
+    assert _codes(flagged) == {"CC101"}
+    quiet = _lint(tmp_path,
+                  src.format(pragma="  # graftlint: disable=concurrency"),
+                  name="quiet.py")
+    assert quiet.findings == []
+    assert quiet.suppressed == 1
+    base = Baseline(frozenset(f.fingerprint() for f in flagged.findings))
+    absorbed = run([str(tmp_path / "fixture.py")], select=["concurrency"],
+                   baseline=base)
+    assert absorbed.findings == [] and absorbed.baselined == 1
+
+
+def test_cli_version_lists_rule_ids(capsys):
+    assert cli.main(["--version"]) == 0
+    out = capsys.readouterr().out
+    assert "concurrency" in out
+    assert "CC101, CC102, CC103, CC104, CC105" in out
+
+
+def test_every_pass_declares_rule_codes():
+    for name, p in PASSES.items():
+        assert p.codes, f"pass {name} declares no rule codes"
+        assert all(c.isalnum() for c in p.codes)
+
+
 # ------------------------------------------------------- baseline workflow
 
 def test_baseline_absorbs_recorded_findings(tmp_path):
@@ -693,7 +901,8 @@ def test_finding_dict_round_trip():
 def test_builtin_passes_registered():
     assert {"trace-safety", "registry-parity", "namespace-parity",
             "jit-cache-hygiene", "no-adhoc-telemetry",
-            "sharding-spec-coverage", "dtype-rules"} <= set(PASSES)
+            "sharding-spec-coverage", "dtype-rules", "robustness",
+            "concurrency"} <= set(PASSES)
 
 
 def test_unknown_pass_rejected(tmp_path):
@@ -749,8 +958,10 @@ def test_cli_sarif_output_valid(capsys, monkeypatch):
     assert driver["name"] == "graftlint"
     rule_ids = [r["id"] for r in driver["rules"]]
     assert rule_ids == sorted(rule_ids)
-    # findings from BOTH new passes are present
+    # findings from the newer passes are present
     assert {"SS101", "SS104", "DT101", "DT102"} <= set(rule_ids)
+    # every concurrency rule fires on its bad fixture
+    assert {"CC101", "CC102", "CC103", "CC104", "CC105"} <= set(rule_ids)
     levels = set()
     for r in sarif_run["results"]:
         assert r["ruleId"] == rule_ids[r["ruleIndex"]]
