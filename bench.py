@@ -522,6 +522,85 @@ def _serving_bench(paddle, on_tpu, budget_left_s=None):
         except Exception as e:  # noqa: BLE001
             print(f"prefix-cache serving extra failed: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
+        # KV tiers: (a) spill-vs-recompute TTFT — a one-slot pool churned
+        # by a second prompt evicts the first prompt's chain; with a host
+        # tier the re-serve restores spilled pages (a copy), without one
+        # it re-prefills (recompute); (b) fleet-wide vs per-replica hit
+        # rate — the same warm prompt skew-routed onto a cold replica
+        # with peer page pulls on vs off
+        try:
+            if not _room(2.5, "kvtier"):
+                raise _SkipExtra
+            from paddle_tpu.inference.frontend import ReplicaSet
+            from paddle_tpu.inference.frontend.router import \
+                PrefixAffinityRouter
+            # smaller pages at CPU scale so the churn prompt actually
+            # evicts (a 24-token prompt spans 3 pages, not 1)
+            ps = 16 if on_tpu else 8
+            pool = -(-(P + NEW + 8) // ps)            # one slot's pages
+            churn = rng.randint(1, cfg.vocab_size, (P,)).astype(np.int32)
+
+            def _churn_serve(host_bytes):
+                e = LLMEngine(m, max_batch=1, max_len=P + NEW + 8,
+                              page_size=ps, prefill_chunk=CHUNK,
+                              prefix_cache=True, page_pool=pool,
+                              host_cache_bytes=host_bytes)
+                # cold serve, churn out, re-serve (warms the restore
+                # path's gather/scatter compile), churn out again — the
+                # timed re-serve is compile-free on every tier path
+                for p in (prompt, churn, prompt, churn):
+                    e.add_request(p, max_new_tokens=NEW)
+                    e.run_until_done()
+                rid = e.add_request(prompt, max_new_tokens=NEW)
+                e.run_until_done()
+                return (e.ttft(rid), e._finished[rid].prefill_dispatches,
+                        e.kv_tier_stats())
+
+            t_re, d_re, _ = _churn_serve(None)      # recompute baseline
+            t_sp, d_sp, st = _churn_serve(256 << 20)
+
+            def _fleet_serve(pull):
+                engs = [LLMEngine(m, max_batch=2, max_len=P + NEW + 8,
+                                  page_size=ps, prefill_chunk=CHUNK,
+                                  prefix_cache=True) for _ in range(2)]
+                rs = ReplicaSet(engs, peer_pull=pull,
+                                router=PrefixAffinityRouter(
+                                    page_size=ps, max_load_skew=0))
+                try:
+                    h0 = rs.submit(prompt, max_new_tokens=NEW)
+                    rs.result(h0, timeout=120.0)
+                    hb = rs.submit(churn[:4], max_new_tokens=NEW * 4)
+                    h1 = rs.submit(prompt, max_new_tokens=NEW)
+                    rs.result(h1, timeout=120.0)
+                    ttft = h1.replica.ttft(h1.rid)
+                    rs.result(hb, timeout=120.0)
+                finally:
+                    rs.close()
+                hits = sum(e.prefix_cache_stats()["hits"] for e in engs)
+                miss = sum(e.prefix_cache_stats()["misses"] for e in engs)
+                pages = sum(e.kv_tier_stats()["peer_import_pages"]
+                            for e in engs)
+                return ttft, hits / max(1, hits + miss), pages
+
+            t_on, rate_on, pages_on = _fleet_serve(True)
+            t_off, rate_off, _ = _fleet_serve(False)
+            out["kvtier"] = {
+                "ttft_ms_restore": round(t_sp * 1e3, 1),
+                "ttft_ms_recompute": round(t_re * 1e3, 1),
+                "prefill_dispatches_restore": d_sp,
+                "prefill_dispatches_recompute": d_re,
+                "host_spills": st["host_spills"],
+                "host_restores": st["host_restores"],
+                "ttft_ms_peer_pulled": round(t_on * 1e3, 1),
+                "ttft_ms_peer_cold": round(t_off * 1e3, 1),
+                "peer_pages_pulled": pages_on,
+                "fleet_hit_rate_peer_pull": round(rate_on, 3),
+                "fleet_hit_rate_per_replica": round(rate_off, 3)}
+        except _SkipExtra:
+            pass
+        except Exception as e:  # noqa: BLE001
+            print(f"kvtier serving extra failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
         # observability: the timed decode re-run with the metrics registry
         # on vs off quantifies instrumentation overhead on one serving
         # config; the enabled run's registry snapshot ships in the artifact

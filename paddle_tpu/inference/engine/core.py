@@ -33,7 +33,7 @@ from ...core.retry import RetryError, RetryPolicy, retry_call
 from ...testing.faults import FAULTS as _faults
 from .compat import _LegacyDelegation
 from .metrics import _EngineMetrics
-from .pages import PagePool
+from .pages import HostPageStore, PagePool
 from .request import Request, RequestStatus
 from .runner import _MAXK, ModelRunner
 from .scheduler import Scheduler
@@ -46,6 +46,18 @@ class _TransientStep(Exception):
     """Private wrapper around a transient step error so :func:`retry_call`
     retries exactly those — any non-transient error escapes the retry loop
     unwrapped and falls through to quarantine isolation."""
+
+    def __init__(self, err):
+        super().__init__(str(err))
+        self.err = err
+
+
+class _TransientTier(Exception):
+    """Private wrapper around a transient KV-tier error (``kv.spill`` /
+    ``kv.restore`` fault points) so :func:`retry_call` retries exactly
+    those; a poison (non-transient) error escapes the retry loop and the
+    tier operation degrades to its lossless fallback — eviction on spill,
+    recompute on restore."""
 
     def __init__(self, err):
         super().__init__(str(err))
@@ -67,7 +79,8 @@ class LLMEngine(_LegacyDelegation, _SpecOrchestration):
                  kv_cache_dtype="auto", decode_block_max=32,
                  prefix_cache=False, spec_decode=None, max_waiting=None,
                  shed_min_free_ratio=0.0, default_deadline=None,
-                 step_retry=None, debug_refcount_audit=False):
+                 step_retry=None, debug_refcount_audit=False,
+                 host_cache_bytes=None):
         """page_pool: usable KV pages (the HBM budget). Defaults to the
         worst case (max_batch * ceil(max_len/page)); set it SMALLER to
         oversubscribe — on-demand growth means slots only claim what they
@@ -147,7 +160,18 @@ class LLMEngine(_LegacyDelegation, _SpecOrchestration):
         refcounts) while the rest keep serving.
         debug_refcount_audit: run :meth:`audit_refcounts` after every step
         and raise on any page-accounting violation (tier-1 chaos tests keep
-        this on to prove no failure path leaks pages)."""
+        this on to prove no failure path leaks pages).
+
+        host_cache_bytes: byte budget for the host-RAM KV spill tier
+        (requires ``prefix_cache``).  When set, LRU reclaim and preemption
+        demote page contents to host RAM (async device→host copy) instead
+        of discarding them, and an admission hit against a spilled chain
+        restores the pages via double-buffered host→device prefetch instead
+        of re-prefilling.  The tier has its own LRU within the budget;
+        every tier path is lossless-on-failure (spill failure → plain
+        eviction, restore failure → recompute) and token-exact vs the
+        recompute path.  Counters: :meth:`kv_tier_stats`; fault points:
+        ``kv.spill`` / ``kv.restore``."""
         cfg = model.config
         self.cfg = cfg
         self.max_batch = max_batch
@@ -174,12 +198,33 @@ class LLMEngine(_LegacyDelegation, _SpecOrchestration):
             use_kernel=use_kernel, kv_cache_dtype=kv_cache_dtype)
         self.pool = PagePool(self.n_pages, prefix_cache=self.prefix_cache,
                              metrics=self._m)
+        # host-RAM spill tier (HBM -> host RAM -> recompute hierarchy)
+        self.host_spills = 0            # pages demoted device -> host
+        self.host_spill_bytes = 0
+        self.host_spill_drops = 0       # spill attempts degraded to eviction
+        self.host_restores = 0          # pages promoted host -> device
+        self.host_restore_bytes = 0
+        self.host_restore_failures = 0  # restore attempts fallen to recompute
+        self.peer_exports = 0           # pull_pages RPCs served
+        self.peer_export_pages = 0
+        self.peer_imports = 0           # peer page blocks spliced in
+        self.peer_import_pages = 0
+        self._tier_retry = RetryPolicy(max_attempts=3, base_delay=0.01,
+                                       max_delay=0.25, seed=seed)
+        if host_cache_bytes is not None:
+            if not self.prefix_cache:
+                raise ValueError("host_cache_bytes requires prefix_cache "
+                                 "(spilled pages are keyed by chain hash)")
+            self.pool.attach_host(HostPageStore(int(host_cache_bytes)),
+                                  self.runner.kv_bytes_per_page())
+            self.pool.spill_page = self._spill_page
         self.sched = Scheduler(
             self.pool, max_batch=max_batch, max_len=max_len,
             page_size=page_size, pages_per_slot=self.pages_per_slot,
             prefix_cache=self.prefix_cache, copy_page=self.runner.copy_page,
             metrics=self._m, max_waiting=max_waiting,
-            shed_min_free_ratio=shed_min_free_ratio)
+            shed_min_free_ratio=shed_min_free_ratio,
+            restore_chain=self._restore_chain)
         self.prefill_dispatches = 0        # total prefill programs run
         self._next_rid = 0
         self._seed_counter = np.int64(seed) * 1_000_003
@@ -619,6 +664,8 @@ class LLMEngine(_LegacyDelegation, _SpecOrchestration):
         self._m.cached_pages.set(len(self.pool.key_page))
         self._m.reclaimable.set(len(self.pool.lru))
         self._m.free_pages.set(len(self.pool.free_pages))
+        if self.pool.host is not None:
+            self._m.host_cached.set(len(self.pool.host))
 
     def metrics(self):
         """This engine's telemetry series from the process-wide registry.
@@ -653,12 +700,206 @@ class LLMEngine(_LegacyDelegation, _SpecOrchestration):
         including int8 scales) — the unit of the page_pool budget."""
         return self.runner.kv_bytes_per_page()
 
+    # ---------------------------------------------------------- KV tiering
+    def _spill_page(self, p):
+        """Device half of a host-tier spill: gather page ``p``'s contents
+        into host RAM (injected into the pool as ``spill_page``).  The
+        ``kv.spill`` fault point sits in front of the copy: transient
+        firings retry through the tier backoff policy; a poison firing (or
+        exhausted retries) returns None and the page degrades to a plain
+        eviction — recompute on the next hit, never corruption."""
+        def attempt():
+            try:
+                if _faults.active:
+                    _faults.raise_if("kv.spill", page=int(p))
+                return self.runner.pages_to_host([int(p)])
+            except Exception as err:
+                if getattr(err, "transient", False):
+                    raise _TransientTier(err) from err
+                raise
+
+        try:
+            blk = retry_call(attempt, policy=self._tier_retry,
+                             retry_on=(_TransientTier,), op="kv.spill")
+        except Exception:  # noqa: BLE001 — lossless fallback: eviction
+            self.host_spill_drops += 1
+            return None
+        nbytes = sum(int(a.nbytes) for a in blk)
+        self.host_spills += 1
+        self.host_spill_bytes += nbytes
+        self._m.tier_spills.inc()
+        self._m.tier_spill_bytes.inc(nbytes)
+        return blk
+
+    def _restore_chain(self, keys):
+        """Host half of a spill restore (injected into the scheduler as
+        ``restore_chain``): bring the host-tier blocks for chain ``keys``
+        back into freshly-allocated device pages via double-buffered
+        host→device prefetch, and re-register them in the prefix index.
+        Returns the restored physical pages IN ORDER, referenced once each
+        for the caller's slot table — possibly shorter than ``keys`` (an
+        aged-out entry, a dry pool, or a poison ``kv.restore`` firing);
+        admission truncates its cached prefix there and the tail
+        re-prefills (recompute fallback)."""
+        host = self.pool.host
+        if host is None:
+            return []
+
+        def attempt():
+            try:
+                if _faults.active:
+                    _faults.raise_if("kv.restore", keys=list(keys))
+            except Exception as err:
+                if getattr(err, "transient", False):
+                    raise _TransientTier(err) from err
+                raise
+
+        try:
+            retry_call(attempt, policy=self._tier_retry,
+                       retry_on=(_TransientTier,), op="kv.restore")
+        except Exception:  # noqa: BLE001 — lossless fallback: recompute
+            self.host_restore_failures += 1
+            return []
+        blocks, pages = [], []
+        for key in keys:
+            blk = host.get(key)
+            if blk is None:
+                break
+            p = self.pool.alloc_page()
+            if p is None:
+                break
+            blocks.append(blk)
+            pages.append(p)
+        if not pages:
+            return []
+        try:
+            self.runner.restore_pages(pages, blocks)
+        except Exception:  # noqa: BLE001 — unwritten pages free cleanly
+            for p in pages:
+                self.pool.unref_page(p)
+            self.host_restore_failures += 1
+            return []
+        for p, key in zip(pages, keys):
+            self.pool.register(p, key)
+        nbytes = sum(HostPageStore.block_bytes(b) for b in blocks)
+        self.host_restores += len(pages)
+        self.host_restore_bytes += nbytes
+        self._m.tier_restores.inc(len(pages))
+        self._m.tier_restore_bytes.inc(nbytes)
+        return pages
+
+    def export_pages(self, keys):
+        """Serve a peer replica's ``pull_pages`` RPC: the longest prefix of
+        chain ``keys`` this engine holds in ANY tier, as one dense host
+        block (HBM pages gathered in a single dispatch, host-tier entries
+        read in place).  Returns ``{"keys": [...], "block": tuple of
+        [L, n, page, ...] numpy arrays}``, or None when even the first key
+        misses everywhere — the puller then recomputes."""
+        host = self.pool.host
+        served, dev, host_blocks = [], [], {}
+        for i, key in enumerate(keys):
+            p = self.pool.lookup(key)
+            if p is not None:
+                dev.append((i, int(p)))
+            else:
+                blk = host.get(key) if host is not None else None
+                if blk is None:
+                    break
+                host_blocks[i] = blk
+            served.append(key)
+        if not served:
+            return None
+        dev_blk = self.runner.pages_to_host([p for _, p in dev]) \
+            if dev else None
+        parts = [None] * len(served)
+        for j, (i, _) in enumerate(dev):
+            parts[i] = tuple(a[:, j:j + 1] for a in dev_blk)
+        for i, blk in host_blocks.items():
+            parts[i] = blk
+        n_comp = len(parts[0])
+        block = tuple(np.concatenate([pk[c] for pk in parts], axis=1)
+                      if len(parts) > 1 else np.ascontiguousarray(parts[0][c])
+                      for c in range(n_comp))
+        self.peer_exports += 1
+        self.peer_export_pages += len(served)
+        self._m.tier_peer_export.inc(len(served))
+        self._m.tier_peer_bytes_out.inc(sum(int(a.nbytes) for a in block))
+        return {"keys": served, "block": block}
+
+    def import_pages(self, payload):
+        """Splice a peer's exported page block into this engine's pool and
+        prefix index (the receive half of a peer pull).  Keys already
+        resident in either tier are skipped; each spliced page is
+        content-registered then immediately unreferenced into the LRU
+        (cached, refcount 0), so the next admission walk claims it as an
+        ordinary prefix hit.  Any failure stops the splice mid-chain — the
+        un-spliced tail simply recomputes.  Returns pages spliced."""
+        if not payload:
+            return 0
+        keys, block = payload["keys"], payload["block"]
+        host = self.pool.host
+        n = 0
+        for i, key in enumerate(keys):
+            if self.pool.lookup(key) is not None \
+                    or (host is not None and key in host):
+                continue
+            p = self.pool.alloc_page()
+            if p is None:
+                break
+            blk = tuple(np.ascontiguousarray(a[:, i:i + 1]) for a in block)
+            try:
+                self.runner.restore_pages([p], [blk])
+            except Exception:  # noqa: BLE001 — lossless: recompute the tail
+                self.pool.unref_page(p)
+                break
+            self.pool.register(p, key)
+            self.pool.unref_page(p)      # cached, refcount 0 -> LRU parked
+            n += 1
+        if n:
+            self.peer_imports += 1
+            self.peer_import_pages += n
+            self._m.tier_peer_import.inc(n)
+            self._m.tier_peer_bytes_in.inc(
+                sum(int(a.nbytes) for a in block) * n // max(1, len(keys)))
+        return n
+
+    def kv_tier_stats(self):
+        """Counters for the KV-cache hierarchy (HBM → host RAM → peer →
+        recompute); all zero when no tier knob is on.  The same counters
+        are exported through the registry (``serving_kv_tier_*``)."""
+        host = self.pool.host
+        return {
+            "host_spills": self.host_spills,
+            "host_spill_bytes": self.host_spill_bytes,
+            "host_spill_drops": self.host_spill_drops,
+            "host_restores": self.host_restores,
+            "host_restore_bytes": self.host_restore_bytes,
+            "host_restore_failures": self.host_restore_failures,
+            "host_cached_pages": len(host) if host is not None else 0,
+            "host_bytes": host.bytes_used if host is not None else 0,
+            "host_evictions": host.evictions if host is not None else 0,
+            "hits_hbm": self.pool.cache_hits - self.pool.host_hits,
+            "hits_host": self.pool.host_hits,
+            "peer_exports": self.peer_exports,
+            "peer_export_pages": self.peer_export_pages,
+            "peer_imports": self.peer_imports,
+            "peer_import_pages": self.peer_import_pages,
+        }
+
     def prefix_keys(self):
-        """Chain keys currently resident in the prefix cache (empty when
-        the ``prefix_cache`` knob is off).  The multi-process fleet snapshots
-        this over RPC to keep the gateway's prefix-affinity router warm for
-        replicas whose cache events it cannot observe in-process."""
-        return list(self.pool.key_page)
+        """Chain keys currently resident in the prefix cache — HBM pages
+        AND host-tier spilled chains (empty when the ``prefix_cache`` knob
+        is off).  The multi-process fleet snapshots this over RPC to keep
+        the gateway's prefix-affinity router warm for replicas whose cache
+        events it cannot observe in-process; advertising spilled chains
+        lets the router score (and peers pull) prefixes this replica can
+        restore without recompute."""
+        keys = list(self.pool.key_page)
+        if self.pool.host is not None:
+            resident = self.pool.key_page
+            keys.extend(k for k in self.pool.host.keys()
+                        if k not in resident)
+        return keys
 
     def result(self, rid):
         return self.sched.finished[rid].out
@@ -738,6 +979,11 @@ class LLMEngine(_LegacyDelegation, _SpecOrchestration):
             "free_pages": len(self.pool.free_pages),
             "reclaimable_pages": len(self.pool.lru),
             "total_pages": self.n_pages - 1,
+            "host_cached_pages": (len(self.pool.host)
+                                  if self.pool.host is not None else 0),
+            "host_headroom_pages": self.pool.host_headroom_pages(),
+            "host_bytes": (self.pool.host.bytes_used
+                           if self.pool.host is not None else 0),
             "shed_requests": self.sched.shed_requests,
             "timeouts": self.sched.timeouts,
             "cancels": self.sched.cancels,

@@ -40,6 +40,28 @@ class _EngineMetrics:
         self.cached_pages = _obs.SERVING_CACHED_PAGES.labels(**e)
         self.reclaimable = _obs.SERVING_RECLAIMABLE_PAGES.labels(**e)
         self.free_pages = _obs.SERVING_FREE_PAGES.labels(**e)
+        # KV-cache hierarchy (host spill tier + peer pulls)
+        self.tier_spills = _obs.SERVING_KV_TIER_EVENTS.labels(
+            event="spill", **e)
+        self.tier_restores = _obs.SERVING_KV_TIER_EVENTS.labels(
+            event="restore", **e)
+        self.tier_peer_export = _obs.SERVING_KV_TIER_EVENTS.labels(
+            event="peer_export", **e)
+        self.tier_peer_import = _obs.SERVING_KV_TIER_EVENTS.labels(
+            event="peer_import", **e)
+        self.tier_spill_bytes = _obs.SERVING_KV_TIER_BYTES.labels(
+            direction="spill", **e)
+        self.tier_restore_bytes = _obs.SERVING_KV_TIER_BYTES.labels(
+            direction="restore", **e)
+        self.tier_peer_bytes_out = _obs.SERVING_KV_TIER_BYTES.labels(
+            direction="peer_out", **e)
+        self.tier_peer_bytes_in = _obs.SERVING_KV_TIER_BYTES.labels(
+            direction="peer_in", **e)
+        self.tier_hits_hbm = _obs.SERVING_KV_TIER_HITS.labels(
+            tier="hbm", **e)
+        self.tier_hits_host = _obs.SERVING_KV_TIER_HITS.labels(
+            tier="host", **e)
+        self.host_cached = _obs.SERVING_HOST_CACHED_PAGES.labels(**e)
         self.verify = _obs.SERVING_DISPATCHES.labels(kind="verify", **e)
         self.spec_proposed = _obs.SERVING_SPEC_PROPOSED.labels(**e)
         self.spec_accepted = _obs.SERVING_SPEC_ACCEPTED.labels(**e)

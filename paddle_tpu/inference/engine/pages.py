@@ -5,8 +5,17 @@ per-page refcounts, the chain-hash prefix index, and the reclaimable LRU —
 while the device arrays the pages index into live in
 :class:`~.runner.ModelRunner`.  The split is the engine-core refactor's
 contract: the pool never touches a device buffer (copy-on-write's device
-copy is a callable injected by the engine), and the runner never sees a
-refcount.
+copy is a callable injected by the engine — and so is the spill tier's
+device→host gather, ``spill_page``), and the runner never sees a refcount.
+
+:class:`HostPageStore` is the host-RAM spill tier behind the LRU: when a
+host store is attached, LRU eviction copies the page's contents to host
+RAM (keyed by the same chain key) instead of discarding them, and the
+scheduler's admission walk restores spilled chains back into fresh device
+pages instead of re-prefilling.  The store has its own byte budget and LRU;
+entries are immutable host copies that no slot table ever references, so
+its refcount discipline reduces to exact byte accounting (checked by
+:meth:`HostPageStore.audit`, folded into :meth:`PagePool.audit`).
 
 Invariants (checked by :meth:`audit`):
 
@@ -14,7 +23,8 @@ Invariants (checked by :meth:`audit`):
   any in-flight handoff references the caller declares),
 - free and LRU-parked pages carry refcount 0 and never overlap,
 - no page leaks (refcount 0 yet neither free nor parked),
-- LRU pages are content-registered and the prefix key index is symmetric.
+- LRU pages are content-registered and the prefix key index is symmetric,
+- the host tier's byte ledger matches its entries and respects its budget.
 """
 from __future__ import annotations
 
@@ -24,7 +34,102 @@ import numpy as np
 
 from ...testing.faults import FAULTS as _faults
 
-__all__ = ["PagePool"]
+__all__ = ["HostPageStore", "PagePool"]
+
+
+class HostPageStore:
+    """Byte-budgeted host-RAM tier for spilled KV pages.
+
+    One entry per chain key: the page's full contents as a tuple of host
+    numpy arrays (one ``[L, 1, page, ...]`` array per cache component),
+    copied off-device with the non-blocking snapshot idiom.  Entries are
+    LRU-ordered; ``put`` evicts oldest-first until the new entry fits and
+    refuses entries larger than the whole budget.  ``on_evict(key)`` fires
+    for every evicted entry so the pool can drop the chain key from the
+    frontend router's mirror when no device copy remains."""
+
+    def __init__(self, budget_bytes, on_evict=None):
+        self.budget = int(budget_bytes)
+        self.entries: OrderedDict = OrderedDict()   # chain key -> host block
+        self.bytes_used = 0
+        self.on_evict = on_evict
+        self.spills = 0            # entries accepted by put()
+        self.spill_bytes = 0       # bytes accepted by put()
+        self.evictions = 0         # entries evicted to fit newer spills
+
+    def __contains__(self, key):
+        return key in self.entries
+
+    def __len__(self):
+        return len(self.entries)
+
+    @staticmethod
+    def block_bytes(block):
+        return sum(int(a.nbytes) for a in block)
+
+    def get(self, key):
+        """The host block for ``key`` (LRU-refreshed), or None."""
+        block = self.entries.get(key)
+        if block is not None:
+            self.entries.move_to_end(key)
+        return block
+
+    def touch(self, key):
+        """LRU-refresh ``key`` without reading it; True when present."""
+        if key in self.entries:
+            self.entries.move_to_end(key)
+            return True
+        return False
+
+    def put(self, key, block):
+        """Admit one spilled page; True when the store holds it afterwards.
+        Oldest entries are evicted until the newcomer fits; a block larger
+        than the whole budget is refused (the caller falls back to plain
+        eviction — recompute)."""
+        if key in self.entries:
+            self.entries.move_to_end(key)
+            return True
+        nbytes = self.block_bytes(block)
+        if nbytes > self.budget:
+            return False
+        while self.bytes_used + nbytes > self.budget and self.entries:
+            k, old = self.entries.popitem(last=False)
+            self.bytes_used -= self.block_bytes(old)
+            self.evictions += 1
+            if self.on_evict is not None:
+                self.on_evict(k)
+        self.entries[key] = block
+        self.bytes_used += nbytes
+        self.spills += 1
+        self.spill_bytes += nbytes
+        return True
+
+    def pop(self, key):
+        block = self.entries.pop(key, None)
+        if block is not None:
+            self.bytes_used -= self.block_bytes(block)
+        return block
+
+    def keys(self):
+        return list(self.entries)
+
+    def headroom_pages(self, bytes_per_page):
+        """How many more pages the remaining budget could absorb."""
+        if bytes_per_page <= 0:
+            return 0
+        return max(0, (self.budget - self.bytes_used) // int(bytes_per_page))
+
+    def audit(self):
+        """Byte-ledger invariants; returns problem strings (empty = clean)."""
+        problems = []
+        actual = sum(self.block_bytes(b) for b in self.entries.values())
+        if actual != self.bytes_used:
+            problems.append(f"host tier byte ledger {self.bytes_used} != "
+                            f"{actual} actual entry bytes")
+        if self.bytes_used > self.budget:
+            problems.append(f"host tier over budget: {self.bytes_used} > "
+                            f"{self.budget}")
+        return problems
 
 
 class PagePool:
@@ -57,6 +162,14 @@ class PagePool:
         self.cache_evictions = 0           # cached pages reclaimed from LRU
         self.cache_cow_copies = 0          # copy-on-write page copies
         self._m = metrics
+        # ---- optional host-RAM spill tier (attach_host) -------------------
+        self.host: HostPageStore | None = None
+        # engine-injected device→host gather: spill_page(p) -> host block or
+        # None on failure (same injection contract as the CoW copy_page — the
+        # pool never touches device buffers itself)
+        self.spill_page = None
+        self.host_hits = 0                 # admission pages restored from host
+        self._host_page_bytes = 0
 
     # ------------------------------------------------------------- refcounts
     def ref_page(self, p):
@@ -88,12 +201,55 @@ class PagePool:
             self.cache_evictions += 1
             if self._m is not None:
                 self._m.evictions.inc()
-            if self.cache_event_listener is not None:
-                self.cache_event_listener("evict", key)
+            self._spill_or_evict(p, key)
         else:
             return None
         self.page_ref[p] = 1
         return p
+
+    def _spill_or_evict(self, p, key):
+        """Demote an LRU-reclaimed page: into the host tier when one is
+        attached (the chain key survives, event "spill"), else a plain
+        eviction (event "evict").  Spill failure degrades to eviction —
+        correctness never depends on the copy."""
+        if self.host is not None:
+            if key in self.host:
+                self.host.touch(key)      # already spilled: HBM copy was a
+                spilled = True            # restore — the host copy stands
+            else:
+                blk = self.spill_page(p) if self.spill_page is not None \
+                    else None
+                spilled = blk is not None and self.host.put(key, blk)
+            if spilled:
+                if self.cache_event_listener is not None:
+                    self.cache_event_listener("spill", key)
+                return
+        if self.cache_event_listener is not None:
+            self.cache_event_listener("evict", key)
+
+    # -------------------------------------------------------- host spill tier
+    def attach_host(self, store: HostPageStore, bytes_per_page):
+        """Wire the host-RAM tier in: LRU reclaims spill through it and its
+        own evictions notify the cache-event listener (the chain is then gone
+        from every tier of this replica)."""
+        self.host = store
+        self._host_page_bytes = int(bytes_per_page)
+        store.on_evict = self._host_evicted
+
+    def _host_evicted(self, key):
+        # the host tier aged a chain key out; only announce the loss when no
+        # device page still serves that key (restore re-registered it in HBM)
+        if key not in self.key_page and self.cache_event_listener is not None:
+            self.cache_event_listener("evict", key)
+
+    def host_headroom_pages(self):
+        """Pages the host tier could still absorb without evicting — the
+        shed watermark and SLO admission count these as reclaimable-without-
+        loss headroom."""
+        if self.host is None or self._host_page_bytes <= 0:
+            return 0
+        return min(self.host.headroom_pages(self._host_page_bytes),
+                   self.n_usable)
 
     # ----------------------------------------------------------- prefix index
     def lookup(self, key):
@@ -112,13 +268,20 @@ class PagePool:
             self.cache_event_listener("register", key)
         return True
 
-    def record_admission(self, n_hits, n_misses):
-        """Admission-time hit/miss accounting (pages, not tokens)."""
+    def record_admission(self, n_hits, n_misses, n_host=0):
+        """Admission-time hit/miss accounting (pages, not tokens).
+        ``n_host`` is the subset of ``n_hits`` served by restoring spilled
+        pages from the host tier rather than from resident HBM pages."""
         self.cache_hits += n_hits
         self.cache_misses += n_misses
+        self.host_hits += n_host
         if self._m is not None:
             self._m.hits.inc(n_hits)
             self._m.misses.inc(n_misses)
+            if n_hits - n_host:
+                self._m.tier_hits_hbm.inc(n_hits - n_host)
+            if n_host:
+                self._m.tier_hits_host.inc(n_host)
 
     # ------------------------------------------------------------------ state
     @property
@@ -126,11 +289,16 @@ class PagePool:
         """Pages the budget covers (the trash page excluded)."""
         return self.n_pages - 1
 
-    def n_available(self, reserved_lru=0):
+    def n_available(self, reserved_lru=0, host_headroom=False):
         """Pages admission could newly claim: free + reclaimable, minus LRU
         pages the caller is about to re-reference (cache hits parked in the
-        LRU are NOT allocatable — they are being claimed as hits)."""
-        return len(self.free_pages) + len(self.lru) - reserved_lru
+        LRU are NOT allocatable — they are being claimed as hits).  With
+        ``host_headroom=True`` (shed-watermark accounting only), LRU pages
+        the host tier could absorb count as reclaimable-without-loss."""
+        avail = len(self.free_pages) + len(self.lru) - reserved_lru
+        if host_headroom:
+            avail += min(self.host_headroom_pages(), len(self.lru))
+        return avail
 
     # ------------------------------------------------------------------ audit
     def audit(self, expected_refs):
@@ -168,4 +336,6 @@ class PagePool:
         for key, p in self.key_page.items():
             if self.page_key.get(p) != key:
                 problems.append(f"page {p}: key->page->key asymmetric")
+        if self.host is not None:
+            problems.extend(self.host.audit())
         return problems

@@ -483,3 +483,33 @@ class ModelRunner:
         """HBM bytes one KV page costs across all layers (both K and V,
         including int8 scales) — the unit of the page_pool budget."""
         return sum(int(a.nbytes) for a in self.cache) // self.n_pages
+
+    def pages_to_host(self, page_idx):
+        """Gather ``page_idx`` pages and land them in host RAM as a tuple of
+        owned numpy arrays (one [L, n, page, ...] array per cache component)
+        — the device half of a host-tier spill.  Uses the checkpoint
+        snapshot idiom: start the non-blocking device→host DMA first, then
+        materialize owned copies (np.array, never a view) so the block
+        outlives any later donation of the cache buffers."""
+        blk = self.gather_pages(page_idx)
+        for a in blk:
+            try:
+                a.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass                      # older arrays: np.array blocks
+        return tuple(np.array(a) for a in blk)
+
+    def restore_pages(self, page_idx, host_blocks):
+        """Write host-tier page blocks back into device pages ``page_idx``
+        (one single-page block per entry, in order) — the device half of a
+        spill restore.  Double-buffered: page i+1's host→device transfer is
+        issued before page i's scatter is dispatched, so the copy hides
+        behind the previous write."""
+        if not page_idx:
+            return
+        pending = jax.device_put(host_blocks[0])
+        for i, p in enumerate(page_idx):
+            blk, pending = pending, (
+                jax.device_put(host_blocks[i + 1])
+                if i + 1 < len(page_idx) else None)
+            self.scatter_pages([p], blk)

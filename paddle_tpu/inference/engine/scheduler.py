@@ -35,7 +35,8 @@ class Scheduler:
 
     def __init__(self, pool, max_batch, max_len, page_size, pages_per_slot,
                  prefix_cache=False, copy_page=None, metrics=None,
-                 max_waiting=None, shed_min_free_ratio=0.0):
+                 max_waiting=None, shed_min_free_ratio=0.0,
+                 restore_chain=None):
         self.pool = pool
         self.max_batch = int(max_batch)
         self.max_len = int(max_len)
@@ -43,6 +44,10 @@ class Scheduler:
         self.pages_per_slot = int(pages_per_slot)
         self.prefix_cache = bool(prefix_cache)
         self._copy_page = copy_page          # device page copy (CoW)
+        # host-tier restore: restore_chain([keys]) -> physical pages it
+        # managed to bring back on-device, in order (engine-injected, same
+        # contract as copy_page — may be shorter than asked on failure)
+        self._restore_chain = restore_chain
         self._m = metrics
         self.max_waiting = None if max_waiting is None else int(max_waiting)
         self.shed_min_free_ratio = float(shed_min_free_ratio)
@@ -70,7 +75,10 @@ class Scheduler:
                 and len(self.waiting) >= self.max_waiting:
             return True
         if self.shed_min_free_ratio > 0.0 and self.waiting:
-            avail = self.pool.n_available()
+            # LRU pages the host tier could absorb are reclaimable WITHOUT
+            # recompute loss, so with a spill tier attached the same
+            # watermark sheds later
+            avail = self.pool.n_available(host_headroom=True)
             if avail < self.shed_min_free_ratio * self.pool.n_usable:
                 return True
         return False
@@ -187,31 +195,70 @@ class Scheduler:
             # prompt+max_new reservation, which gave paging no benefit)
             need = math.ceil(len(r.prompt) / self.page)
             keys = self.page_keys(r.prompt) if self.prefix_cache else []
-            hits = []
+            # the longest servable key prefix, walked across BOTH device
+            # tiers: (key, page) for a resident HBM page, (key, None) for a
+            # spilled chain entry to restore — a chain may interleave them
+            # (restored pages re-evicted while later pages stayed resident)
+            plan = []
             for key in keys:
                 p = pool.lookup(key)
-                if p is None:
+                if p is not None:
+                    plan.append((key, p))
+                elif pool.host is not None and key in pool.host \
+                        and self._restore_chain is not None:
+                    plan.append((key, None))
+                else:
                     break
-                hits.append(p)
+            n_dev = sum(1 for _, p in plan if p is not None)
             # pages admission must newly claim; hit pages sitting in the LRU
-            # are about to be re-referenced, so they are NOT allocatable
-            fresh = need - len(hits)
+            # are about to be re-referenced, so they are NOT allocatable.
+            # Host restores allocate from the same free/LRU budget as fresh
+            # pages, so they count as claims here too.
+            fresh = need - n_dev
             avail = pool.n_available(
-                reserved_lru=sum(1 for p in hits if p in pool.lru))
+                reserved_lru=sum(1 for _, p in plan
+                                 if p is not None and p in pool.lru))
             if avail < fresh:
                 break
             self.waiting.popleft()
-            pages = []
-            for p in hits:                # ref hits BEFORE allocating fresh
-                pool.ref_page(p)          # pages so eviction can't take them
-                pages.append(p)
+            for _, p in plan:             # ref HBM hits BEFORE allocating /
+                if p is not None:         # restoring so eviction can't take
+                    pool.ref_page(p)      # them out from under the plan
+            # bring spilled runs back on-device in plan order; a short
+            # restore truncates the usable cached prefix at the first gap
+            pages, n_restored, usable, i = [], 0, len(plan), 0
+            while i < usable:
+                key, p = plan[i]
+                if p is not None:
+                    pages.append(p)
+                    i += 1
+                    continue
+                run = []
+                while i + len(run) < len(plan) \
+                        and plan[i + len(run)][1] is None:
+                    run.append(plan[i + len(run)][0])
+                got = self._restore_chain(run)
+                pages.extend(got)
+                n_restored += len(got)
+                if len(got) < len(run):
+                    usable = i + len(got)
+                    # HBM hits past the gap are unreachable without it —
+                    # drop the references taken above
+                    for _, q in plan[usable:]:
+                        if q is not None:
+                            pool.unref_page(q)
+                    break
+                i += len(run)
+            cached = len(pages)
             aborted = False
-            for _ in range(fresh):
+            for _ in range(need - cached):
                 p = pool.alloc_page()
                 if p is None:
                     # allocation failed mid-admission (injected fault, or a
                     # racing claim): roll the claimed pages back and requeue
-                    # the request at the front — never a half-built table
+                    # the request at the front — never a half-built table.
+                    # Restored pages are content-registered, so unref parks
+                    # them in the LRU with their contents intact.
                     for q in pages:
                         pool.unref_page(q)
                     self.waiting.appendleft(r)
@@ -227,8 +274,9 @@ class Scheduler:
             # FINAL token always re-prefills: its logits sample the first
             # output token (a 100%-cached prompt therefore re-enters its
             # last shared page, which is the copy-on-write path).
-            skip = min(len(hits) * self.page, len(r.prompt) - 1)
-            pool.record_admission(len(hits), len(keys) - len(hits))
+            skip = min(cached * self.page, len(r.prompt) - 1)
+            pool.record_admission(cached, len(keys) - cached,
+                                  n_host=n_restored)
             r.cache_keys = keys
             r.cached_tokens = skip
             r.pos = skip
@@ -266,6 +314,16 @@ class Scheduler:
         # folding the current (possibly already-folded) prompt would
         # duplicate earlier output on a second preemption
         r.prompt = r.prompt0 + r.out
+        if self.prefix_cache and self.pool.host is not None:
+            # with a spill tier attached, content-register the victim's
+            # completed pages under the FOLDED prompt's chain keys before
+            # releasing: release then parks them in the LRU (spillable)
+            # instead of freeing them, so preemption degrades to a copy
+            # rather than a recompute when the victim re-admits
+            keys = self.page_keys(r.prompt)
+            for j in range(min(int(self.lens[slot]) // self.page,
+                               len(keys))):
+                self.pool.register(int(self.slot_tables[slot, j]), keys[j])
         self.release(slot, status=None)
         r.slot = None
         r.status = RequestStatus.QUEUED

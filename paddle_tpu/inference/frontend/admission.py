@@ -158,8 +158,15 @@ class SLOAdmission:
             return AdmissionDecision(False, "queue_full", self.retry_after)
         if self.min_free_ratio > 0.0:
             def _ratio(h):
+                # host-tier headroom counts: LRU pages a spill tier could
+                # absorb are reclaimable WITHOUT recompute loss, so a
+                # replica with host headroom sheds later (capped at the
+                # reclaimable set — headroom beyond it frees nothing)
                 total = max(1, h["total_pages"])
-                return (h["free_pages"] + h["reclaimable_pages"]) / total
+                headroom = min(h.get("host_headroom_pages") or 0,
+                               h["reclaimable_pages"])
+                return (h["free_pages"] + h["reclaimable_pages"]
+                        + headroom) / total
             if all(h["waiting"] and _ratio(h) < self.min_free_ratio
                    for h in healths):
                 return AdmissionDecision(False, "page_pressure",

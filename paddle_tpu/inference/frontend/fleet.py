@@ -40,6 +40,7 @@ from __future__ import annotations
 import threading
 import time
 
+from ...core.retry import RetryPolicy
 from ...distributed.membership import EXPIRE, JOIN, MembershipService
 from ...testing.faults import InjectedFault as _InjectedFault
 from .admission import AlwaysAdmit
@@ -123,6 +124,12 @@ class RemoteReplica:
     def prefix_keys(self):
         return self._call("prefix_keys")
 
+    def export_pages(self, keys):
+        return self._call("pull_pages", keys=list(keys))
+
+    def import_pages(self, payload):
+        return self._call("push_pages", payload=payload)
+
     def health(self):
         try:
             return self._call("health")
@@ -146,7 +153,8 @@ class FleetReplicaSet(ReplicaSet):
 
     def __init__(self, store, group="fleet", ttl=2.0, clock=time.monotonic,
                  router=None, admission=None, requeue=True, page_size=16,
-                 connect_timeout=5.0, retry_policy=None):
+                 connect_timeout=5.0, retry_policy=None, peer_pull=False,
+                 peer_pull_min_pages=1):
         # deliberately NOT calling super().__init__: the fleet starts empty
         # and fills from membership events, while the base requires engines
         self.membership = MembershipService(store, group=group, ttl=ttl,
@@ -157,6 +165,12 @@ class FleetReplicaSet(ReplicaSet):
                        else PrefixAffinityRouter(page_size=page_size))
         self.admission = admission if admission is not None else AlwaysAdmit()
         self.requeue = bool(requeue)
+        # peer KV tier over the worker RPC plane (pull_pages/push_pages);
+        # off by default — see ReplicaSet.__init__
+        self._peer_pull = bool(peer_pull)
+        self._peer_pull_min = int(peer_pull_min_pages)
+        self._pull_retry = RetryPolicy(max_attempts=3, base_delay=0.01,
+                                       max_delay=0.25)
         self.replicas = []
         self._by_name = {}
         self._connect_timeout = float(connect_timeout)
@@ -188,6 +202,9 @@ class FleetReplicaSet(ReplicaSet):
                             connect_timeout=self._connect_timeout)
         self.add_replica(rep)
         try:
+            # prefix_keys covers every tier the worker can serve without
+            # recompute — resident HBM pages AND host-RAM spilled chains —
+            # so a respawned worker rejoins as warm as its caches really are
             for key in rep.prefix_keys():
                 self.router.note_event(rep.name, "register", key)
         except ReplicaDeadError:

@@ -36,13 +36,25 @@ import threading
 import time
 
 from ... import observability as _obs
+from ...core.retry import RetryPolicy, retry_call
 from ...testing import faults as _faults
 from ..serving import RequestStatus as _RequestStatus
+from ..serving import prefix_page_keys
 from .admission import AlwaysAdmit, ShedError
 from .router import PrefixAffinityRouter
 
 __all__ = ["ReplicaDeadError", "StuckStepError", "EngineReplica",
            "RequestHandle", "ReplicaSet"]
+
+
+class _TransientPull(Exception):
+    """Private wrapper around a transient ``kv.peer_pull`` error so
+    :func:`retry_call` retries exactly those; any other failure abandons
+    the pull and the request recomputes its prefix (lossless fallback)."""
+
+    def __init__(self, err):
+        super().__init__(str(err))
+        self.err = err
 
 
 class ReplicaDeadError(RuntimeError):
@@ -338,6 +350,27 @@ class EngineReplica:
             fn = getattr(self.engine, "prefix_keys", None)
             return list(fn()) if fn is not None else []
 
+    def export_pages(self, keys):
+        """Serve a peer's page pull: the longest prefix of ``keys`` this
+        replica's engine holds in any KV tier, as a dense host block (None
+        on a full miss or an engine without the tier API)."""
+        with self._cv:
+            if not self.alive:
+                raise ReplicaDeadError(
+                    f"replica {self.name!r} is dead: {self.error!r}")
+            fn = getattr(self.engine, "export_pages", None)
+            return fn(keys) if fn is not None else None
+
+    def import_pages(self, payload):
+        """Splice a peer's exported page block into this replica's engine
+        (0 when the engine lacks the tier API)."""
+        with self._cv:
+            if not self.alive:
+                raise ReplicaDeadError(
+                    f"replica {self.name!r} is dead: {self.error!r}")
+            fn = getattr(self.engine, "import_pages", None)
+            return fn(payload) if fn is not None else 0
+
     def health(self):
         with self._cv:
             h = self.engine.health()
@@ -420,7 +453,8 @@ class ReplicaSet:
 
     def __init__(self, engines, router=None, admission=None, names=None,
                  start=True, poll_interval=0.05, requeue=False,
-                 step_wall_timeout=None):
+                 step_wall_timeout=None, peer_pull=False,
+                 peer_pull_min_pages=1):
         engines = list(engines)
         if not engines:
             raise ValueError("ReplicaSet needs at least one engine")
@@ -434,6 +468,15 @@ class ReplicaSet:
         self.router = router
         self.admission = admission if admission is not None else AlwaysAdmit()
         self.requeue = bool(requeue)
+        # peer KV tier: when routing passes over a deeper-overlap holder
+        # (router max_load_skew), cold-pull its page chain into the chosen
+        # replica before submit.  Off by default — the pull is pure warmth,
+        # never correctness, and extra RPCs would perturb seeded chaos
+        # schedules that count rpc.* fault ordinals.
+        self._peer_pull = bool(peer_pull)
+        self._peer_pull_min = int(peer_pull_min_pages)
+        self._pull_retry = RetryPolicy(max_attempts=3, base_delay=0.01,
+                                       max_delay=0.25)
         self.replicas = [
             EngineReplica(n, e, router=router, poll_interval=poll_interval,
                           step_wall_timeout=step_wall_timeout)
@@ -511,6 +554,14 @@ class ReplicaSet:
                 raise ReplicaDeadError("no live replicas")
             route = self.router.route(prompt_ids, candidates)
             rep = route.replica
+            if self._peer_pull and route.holder is not None \
+                    and route.holder is not rep \
+                    and route.holder_overlap - route.overlap \
+                    >= self._peer_pull_min:
+                # warm the chosen replica with the passed-over holder's
+                # pages BEFORE submit, so admission sees them as hits
+                self._peer_warm(rep, route.holder, prompt_ids,
+                                route.overlap, route.holder_overlap)
             if _faults.FAULTS.active:
                 _faults.FAULTS.raise_if("frontend.submit", replica=rep.name)
             try:
@@ -528,6 +579,41 @@ class ReplicaSet:
         _obs.FRONTEND_INFLIGHT.inc()
         return RequestHandle(rep, rid, prompt_ids=list(prompt_ids),
                              kw=dict(kw))
+
+    def _peer_warm(self, rep, holder, prompt_ids, lo, hi):
+        """Cold-pull the passed-over holder's cached page chain
+        ``[lo, hi)`` into the chosen replica before submit — the peer tier
+        of the KV hierarchy.  Strictly best-effort: a miss (the holder aged
+        the chain out), a dead peer, or a ``kv.peer_pull`` fault all fall
+        back to recompute; the request is submitted regardless and its
+        tokens are identical either way — only prefill work changes."""
+        page = getattr(self.router, "page", None)
+        if page is None:
+            return
+        keys = prefix_page_keys(prompt_ids, page)[lo:hi]
+        if not keys:
+            return
+
+        def attempt():
+            try:
+                if _faults.FAULTS.active:
+                    _faults.FAULTS.raise_if(
+                        "kv.peer_pull", replica=rep.name, holder=holder.name)
+                return holder.export_pages(keys)
+            except Exception as err:
+                if getattr(err, "transient", False):
+                    raise _TransientPull(err) from err
+                raise
+
+        try:
+            payload = retry_call(attempt, policy=self._pull_retry,
+                                 retry_on=(_TransientPull,),
+                                 op="kv.peer_pull")
+            n = rep.import_pages(payload) if payload else 0
+        except Exception:  # noqa: BLE001 — recompute fallback
+            _obs.FRONTEND_PEER_PULLS.inc(outcome="failed")
+            return
+        _obs.FRONTEND_PEER_PULLS.inc(outcome="ok" if n else "miss")
 
     def _account(self, handle, status):
         """First terminal observation of a request: outcome counter, inflight

@@ -40,14 +40,20 @@ __all__ = ["RouteDecision", "PrefixAffinityRouter", "RoundRobinRouter"]
 class RouteDecision:
     """Outcome of one routing call: the chosen replica, why it won
     (``affinity`` | ``least_loaded`` | ``round_robin``), and how many
-    contiguous prefix pages it already caches."""
+    contiguous prefix pages it already caches.  When load skew overrode
+    affinity (``max_load_skew``), ``holder`` names the passed-over
+    deepest-overlap replica and ``holder_overlap`` its depth — the peer
+    KV-pull seam: the chosen replica can cold-pull the holder's pages."""
 
-    __slots__ = ("replica", "reason", "overlap")
+    __slots__ = ("replica", "reason", "overlap", "holder", "holder_overlap")
 
-    def __init__(self, replica, reason, overlap=0):
+    def __init__(self, replica, reason, overlap=0, holder=None,
+                 holder_overlap=0):
         self.replica = replica
         self.reason = reason
         self.overlap = int(overlap)
+        self.holder = holder
+        self.holder_overlap = int(holder_overlap)
 
     def __repr__(self):
         return (f"RouteDecision({getattr(self.replica, 'name', self.replica)!r},"
@@ -60,8 +66,16 @@ class PrefixAffinityRouter:
     arrives from replica step threads while ``route`` runs on gateway
     threads."""
 
-    def __init__(self, page_size):
+    def __init__(self, page_size, max_load_skew=None):
+        """``max_load_skew``: load-balance override for affinity wins.  By
+        default the deepest cached prefix always wins; with a skew bound,
+        when the affinity winner's load exceeds the least-loaded replica's
+        by MORE than ``max_load_skew``, the least-loaded replica is chosen
+        instead and the affinity winner is exposed as
+        :attr:`RouteDecision.holder` so the caller can cold-pull its pages
+        (the peer KV tier)."""
         self.page = int(page_size)
+        self.max_load_skew = max_load_skew
         self._lock = threading.Lock()
         # radix node index: a chain key names a whole prefix, so the trie
         # is one flat dict of nodes with the set of replicas holding each
@@ -139,11 +153,24 @@ class PrefixAffinityRouter:
             raise ValueError("no replicas to route to")
         chain = prefix_page_keys(prompt_ids, self.page)
         overlaps = self._overlaps(chain, [r.name for r in replicas])
+        loads = {r.name: r.load() for r in replicas}
         scored = sorted(
-            ((-overlaps[r.name], r.load(), r.name, r) for r in replicas),
+            ((-overlaps[r.name], loads[r.name], r.name, r) for r in replicas),
             key=lambda t: t[:3])
-        neg_overlap, _, _, best = scored[0]
+        neg_overlap, best_load, _, best = scored[0]
         if neg_overlap < 0:
+            if self.max_load_skew is not None:
+                coldest = min(replicas,
+                              key=lambda r: (loads[r.name], r.name))
+                if coldest is not best and \
+                        best_load - loads[coldest.name] > self.max_load_skew:
+                    # the cache holder is too hot: route to the coldest
+                    # replica and expose the holder for a peer page pull
+                    _obs.FRONTEND_AFFINITY.inc(event="skew_override")
+                    return RouteDecision(
+                        coldest, "least_loaded",
+                        overlap=overlaps[coldest.name], holder=best,
+                        holder_overlap=-neg_overlap)
             _obs.FRONTEND_AFFINITY.inc(event="hit")
             return RouteDecision(best, "affinity", overlap=-neg_overlap)
         _obs.FRONTEND_AFFINITY.inc(event="miss")

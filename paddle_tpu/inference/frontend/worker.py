@@ -17,7 +17,10 @@ one process with an injected clock, and the bench does the same to measure
 degradation without TPU-sized process images.
 
 RPC ops: ``submit poll cancel status result request_error ttft tpot load
-health metrics prefix_keys ping``.  ``submit`` while draining raises
+health metrics prefix_keys pull_pages push_pages ping``.  ``pull_pages`` /
+``push_pages`` are the peer KV tier's transfer halves: a gateway pulls a
+serialized page-chain block out of the replica that holds it and pushes it
+into the replica it routed to.  ``submit`` while draining raises
 :class:`~.admission.ShedError` ("draining") so the gateway's shed path
 handles the race between drain and route.
 """
@@ -140,6 +143,10 @@ class WorkerServer:
             return rep.metrics()
         if op == "prefix_keys":
             return rep.prefix_keys()
+        if op == "pull_pages":
+            return rep.export_pages(kw["keys"])
+        if op == "push_pages":
+            return rep.import_pages(kw["payload"])
         if op == "ping":
             return {"name": self.name,
                     "epoch": self.lease.epoch if self.lease else None,

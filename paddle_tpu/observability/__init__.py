@@ -114,6 +114,23 @@ SERVING_SPEC_ACCEPTANCE = REGISTRY.histogram(
     "per-verify-step accepted/proposed draft ratio", ("engine",),
     buckets=(0.0, 0.25, 0.5, 0.75, 0.9, 1.0))
 
+# KV-cache hierarchy (HBM -> host RAM -> peer replica -> recompute)
+SERVING_KV_TIER_EVENTS = REGISTRY.counter(
+    "serving_kv_tier_events_total",
+    "KV tier page movements (spill/restore/peer_export/peer_import)",
+    ("engine", "event"))
+SERVING_KV_TIER_BYTES = REGISTRY.counter(
+    "serving_kv_tier_bytes_total",
+    "KV bytes moved between tiers, by direction "
+    "(spill/restore/peer_out/peer_in)", ("engine", "direction"))
+SERVING_KV_TIER_HITS = REGISTRY.counter(
+    "serving_kv_tier_hits_total",
+    "admission prefix-cache page hits by serving tier (hbm/host)",
+    ("engine", "tier"))
+SERVING_HOST_CACHED_PAGES = REGISTRY.gauge(
+    "serving_host_cached_pages",
+    "KV pages resident in the host-RAM spill tier", ("engine",))
+
 SERVING_TERMINALS = REGISTRY.counter(
     "serving_terminal_requests_total",
     "requests reaching a typed terminal status "
@@ -194,6 +211,11 @@ FRONTEND_STUCK_STEPS = REGISTRY.counter(
     "frontend_stuck_steps_total",
     "replica steps the wall-clock watchdog declared wedged (gray failure "
     "promoted to a typed replica death)", ("replica",))
+FRONTEND_PEER_PULLS = REGISTRY.counter(
+    "frontend_peer_pulls_total",
+    "peer-replica KV page pulls before prefill, by outcome "
+    "(ok: pages spliced; miss: holder no longer had the chain; "
+    "failed: RPC/fault — recompute fallback)", ("outcome",))
 
 # durable request plane (inference/frontend/journal.py + gateway)
 JOURNAL_APPEND_SECONDS = REGISTRY.histogram(
@@ -295,13 +317,64 @@ def render_prometheus() -> str:
     return REGISTRY.render_prometheus()
 
 
+# (shape, dtype) -> XLA-measured payload bytes; None caches a probe failure
+# so an environment without cost analysis pays the attempt exactly once
+_XLA_BYTES_CACHE: dict = {}
+
+
+def _xla_payload_bytes(payload):
+    """Payload bytes as XLA's cost analysis measures them, or None when the
+    payload is a tracer / not a jax.Array / the backend exposes no cost
+    model.  A trivial elementwise program is lowered per (shape, dtype) —
+    identity alone can be optimized to a parameter pass-through that
+    reports zero — and the operand's 'bytes accessed' is read off the
+    compiled executable; results are cached so each distinct payload shape
+    compiles the probe once."""
+    try:
+        import jax
+    except ImportError:  # no jax, no cost model
+        return None
+    if not isinstance(payload, jax.Array) \
+            or isinstance(payload, jax.core.Tracer):
+        return None
+    try:
+        key = (payload.shape, str(payload.dtype))
+    except (AttributeError, TypeError):
+        return None
+    if key in _XLA_BYTES_CACHE:
+        return _XLA_BYTES_CACHE[key]
+    nbytes = None
+    try:
+        cost = (jax.jit(lambda a: a * 1).lower(payload).compile()
+                .cost_analysis())
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
+        if cost:
+            # operand 0's bytes are exactly the payload; fall back to the
+            # output's, then to half the total (in + out) access bytes
+            for k in ("bytes accessed0{}", "bytes accessedout{}"):
+                if cost.get(k):
+                    nbytes = int(cost[k])
+                    break
+            else:
+                total = cost.get("bytes accessed")
+                nbytes = int(total) // 2 if total else None
+    except Exception:  # noqa: BLE001 — cost analysis is best-effort
+        nbytes = None
+    _XLA_BYTES_CACHE[key] = nbytes
+    return nbytes
+
+
 def record_collective(name, payload=None, traced=True, nbytes=None) -> None:
     """Count one collective invocation, with payload bytes when derivable.
 
     traced=True: the call site sits inside a traced program (shard_map body),
     so the count ticks once per trace and bytes are the per-shard aval size.
-    ``payload`` may be an array/tracer (bytes from size*itemsize) or None;
-    pass ``nbytes`` to override.
+    ``payload`` may be an array/tracer or None; pass ``nbytes`` to override.
+    Bytes come from XLA's cost analysis when the payload is a concrete
+    on-device array (what the hardware actually moves, including any layout
+    padding); tracers and off-device values fall back to the aval-derived
+    ``size * itemsize``.
     """
     if not _registry._ENABLED:
         return
@@ -309,9 +382,11 @@ def record_collective(name, payload=None, traced=True, nbytes=None) -> None:
                  else (COLLECTIVE_CALLS, COLLECTIVE_BYTES))
     calls.inc(collective=name)
     if nbytes is None and payload is not None:
-        try:
-            nbytes = int(payload.size) * payload.dtype.itemsize
-        except (AttributeError, TypeError):
-            nbytes = None
+        nbytes = _xla_payload_bytes(payload)
+        if nbytes is None:
+            try:
+                nbytes = int(payload.size) * payload.dtype.itemsize
+            except (AttributeError, TypeError):
+                nbytes = None
     if nbytes:
         by.inc(int(nbytes), collective=name)

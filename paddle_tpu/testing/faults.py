@@ -43,7 +43,15 @@ recovery fails; ctx has ``key``).  The self-healing fleet adds
 ``membership.heartbeat`` (lease registration / renewal attempts raise; ctx
 has ``group`` and ``member`` — arm ``Always`` to starve a lease to death)
 and ``rpc.send`` / ``rpc.recv`` (the worker RPC channel fails client-side
-around the request/response halves; ctx has ``op``).  The registry is
+around the request/response halves; ctx has ``op``).  The KV-cache
+hierarchy adds ``kv.spill`` (the device→host page copy behind an LRU
+reclaim raises; transient firings retry, poison degrades to a plain
+eviction — recompute on the next hit; ctx has ``page``), ``kv.restore``
+(the host→device restore of a spilled chain raises before any page is
+written; poison falls back to re-prefill; ctx has ``keys``), and
+``kv.peer_pull`` (the gateway-driven peer page pull fails before the
+export RPC; poison submits the request cold — recompute; ctx has
+``replica`` and ``holder``).  The registry is
 name-keyed and open: new subsystems add points without touching this
 module.
 """
