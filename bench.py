@@ -773,14 +773,17 @@ def _serving_bench(paddle, on_tpu, budget_left_s=None):
             print(f"degradation serving extra failed: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
         # disaggregated prefill/decode: the SAME mixed trace (decode-heavy
-        # short requests + long prompts arriving mid-stream) served by the
-        # colocated engine vs DisaggEngine.  The colocated step loop is
-        # prefill-first, so each arriving prompt stalls every in-flight
-        # decode — the disaggregated engine steps the decode slice every
-        # round, which should show up as a lower p95 inter-token gap (TPOT)
-        # at a comparable TTFT.
+        # short requests + long prompts arriving mid-stream) served three
+        # ways — colocated, 1:1 disagg with the SYNCHRONOUS blocking hop
+        # (async_handoff=False), and a 2:1 pool with the pipelined async
+        # handoff.  The colocated step loop is prefill-first, so each
+        # arriving prompt stalls every in-flight decode; the sync hop
+        # un-stalls prefill but still serializes each transfer with the
+        # decode step; the async pool hides the transfer under decode
+        # compute, which must show as the lowest p95 inter-token gap
+        # (TPOT).  Prefill-queue wait comes from handoff_stats().
         try:
-            if not _room(2.0, "disagg"):
+            if not _room(3.0, "disagg"):
                 raise _SkipExtra
             from paddle_tpu.inference.serving import DisaggEngine
             SHORT = max(2, CHUNK // 4)
@@ -791,8 +794,17 @@ def _serving_bench(paddle, on_tpu, budget_left_s=None):
             arrivals.sort(key=lambda t: t[0])
 
             def _drive(e):
-                # warm both phases' programs so the trace is compile-free
-                e.add_request(prompt, max_new_tokens=NEW)
+                # warm both phases' programs so the trace is compile-free;
+                # a pool needs one warm prompt PER prefill engine (least-
+                # loaded routing spreads these), or the cold engine would
+                # compile mid-trace and the stall would read as a gap
+                for _ in range(max(len(getattr(e, "prefills", ())), 1)):
+                    e.add_request(prompt, max_new_tokens=NEW)
+                e.run_until_done()
+                for _ in range(2):
+                    # second warm wave: short-prompt page-count sizes for
+                    # the handoff gather/scatter programs
+                    e.add_request(prompt[:SHORT], max_new_tokens=NEW)
                 e.run_until_done()
                 pend = list(arrivals)
                 rids, shorts = [], set()
@@ -822,26 +834,48 @@ def _serving_bench(paddle, on_tpu, budget_left_s=None):
 
             # decode_block pinned to 1 on both engines: per-step polling is
             # then per-token, so the gap series IS the TPOT series
-            engd = LLMEngine(m, max_batch=4, max_len=P + NEW + 8,
-                             page_size=16, prefill_chunk=CHUNK,
-                             decode_block=1)
+            dkw = dict(max_batch=4, max_len=P + NEW + 8, page_size=16,
+                       prefill_chunk=CHUNK, decode_block=1)
+            engd = LLMEngine(m, **dkw)
             cg, ct = _drive(engd)
             del engd
-            dis = DisaggEngine(m, max_batch=4, max_len=P + NEW + 8,
-                               page_size=16, prefill_chunk=CHUNK,
-                               decode_block=1)
+            dsync = DisaggEngine(m, async_handoff=False, **dkw)
+            sg, st_ = _drive(dsync)
+            sync_stats = dsync.handoff_stats()
+            del dsync
+            dis = DisaggEngine(m, n_prefill=2, n_decode=1,
+                               async_handoff=True, **dkw)
             dg, dt_ = _drive(dis)
+            async_stats = dis.handoff_stats()
+
+            def _queue_wait_ms(stats):
+                return round(stats["queue_wait_s"] * 1e3
+                             / max(stats["handoffs"], 1), 2)
+
             out["disagg"] = {
                 "colocated": {
                     "tpot_ms_p50": _pct(cg, 50), "tpot_ms_p95": _pct(cg, 95),
                     "ttft_ms_p50": _pct(ct, 50), "ttft_ms_p95": _pct(ct, 95)},
-                "disagg": {
+                "disagg_sync_1to1": {
+                    "tpot_ms_p50": _pct(sg, 50), "tpot_ms_p95": _pct(sg, 95),
+                    "ttft_ms_p50": _pct(st_, 50),
+                    "ttft_ms_p95": _pct(st_, 95),
+                    "handoffs": sync_stats["handoffs"],
+                    "queue_wait_ms_mean": _queue_wait_ms(sync_stats)},
+                "disagg_async_2to1": {
                     "tpot_ms_p50": _pct(dg, 50), "tpot_ms_p95": _pct(dg, 95),
                     "ttft_ms_p50": _pct(dt_, 50),
                     "ttft_ms_p95": _pct(dt_, 95),
-                    "handoffs": dis.handoff_stats()["handoffs"]},
+                    "handoffs": async_stats["handoffs"],
+                    "queue_wait_ms_mean": _queue_wait_ms(async_stats),
+                    "transfer_overlap_ms": round(
+                        async_stats["transfer_overlap_s"] * 1e3, 2)},
                 "p95_tpot_improvement_pct": round(
                     (float(np.percentile(cg, 95))
+                     / max(float(np.percentile(dg, 95)), 1e-9) - 1.0) * 100,
+                    1),
+                "p95_tpot_async_vs_sync_improvement_pct": round(
+                    (float(np.percentile(sg, 95))
                      / max(float(np.percentile(dg, 95)), 1e-9) - 1.0) * 100,
                     1)}
         except _SkipExtra:

@@ -9,7 +9,7 @@ from __future__ import annotations
 from ... import observability as _obs
 from .request import TERMINAL_STATUSES
 
-__all__ = ["_EngineMetrics"]
+__all__ = ["_EngineMetrics", "_PoolMetrics"]
 
 
 class _EngineMetrics:
@@ -71,3 +71,20 @@ class _EngineMetrics:
         self.step_fail = {ph: _obs.SERVING_STEP_FAILURES.labels(phase=ph, **e)
                           for ph in ("prefill", "decode", "verify")}
         self.probes = _obs.SERVING_QUARANTINE_PROBES.labels(**e)
+
+
+class _PoolMetrics:
+    """Registry children bound once per :class:`~.disagg.DisaggEngine`
+    (label ``pool=<seq>``) — the handoff seam's queue gauge plus the
+    wait/transfer histograms, split by how the block crossed (``local``:
+    jitted gather → device_put; ``cross_host``: serialized over the worker
+    RPC plane).  ``handoff_stats()`` mirrors the same numbers always-on."""
+
+    def __init__(self, label):
+        p = {"pool": label}
+        self.label = label
+        self.queue_depth = _obs.SERVING_HANDOFF_QUEUE_DEPTH.labels(**p)
+        self.wait = {path: _obs.SERVING_HANDOFF_WAIT_SECONDS.labels(
+            path=path, **p) for path in ("local", "cross_host")}
+        self.transfer = {path: _obs.SERVING_HANDOFF_TRANSFER_SECONDS.labels(
+            path=path, **p) for path in ("local", "cross_host")}

@@ -499,6 +499,17 @@ class ModelRunner:
                 pass                      # older arrays: np.array blocks
         return tuple(np.array(a) for a in blk)
 
+    def put_block(self, block):
+        """Start the transfer of a gathered page block (device arrays from
+        another runner's ``gather_pages``, or host numpy arrays off the
+        RPC plane) onto THIS runner's cache sharding.  ``device_put`` is
+        asynchronous — the returned arrays are in flight and a subsequent
+        ``scatter_pages`` chains on them, so the copy overlaps whatever
+        the caller dispatches in between."""
+        dst = self.cache_sharding if self.cache_sharding is not None \
+            else self.devices[0]
+        return tuple(jax.device_put(a, dst) for a in block)
+
     def restore_pages(self, page_idx, host_blocks):
         """Write host-tier page blocks back into device pages ``page_idx``
         (one single-page block per entry, in order) — the device half of a

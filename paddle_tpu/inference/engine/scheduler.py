@@ -412,7 +412,25 @@ class Scheduler:
         receive half of a prefill→decode handoff).  ``pages`` must already
         carry this scheduler's pool references (the caller allocated them);
         ``r.pos`` must equal ``len(r.prompt)`` so the step loop never
-        re-prefills.  Returns the slot, or None when the batch is full."""
+        re-prefills.  Returns the slot, or None when the batch is full.
+
+        Blocks arriving from ANOTHER process (the cross-host handoff) are
+        validated here — the one choke point both the local and remote
+        paths share — so a malformed transfer fails loudly instead of
+        seating a slot whose lengths and tables disagree."""
+        if not pages:
+            raise ValueError(
+                f"admit_prefilled(rid={r.rid}): no pages — a prefilled "
+                "request owns at least one KV page")
+        n_tokens = int(n_tokens)
+        if not 0 < n_tokens <= len(pages) * self.page:
+            raise ValueError(
+                f"admit_prefilled(rid={r.rid}): n_tokens={n_tokens} does "
+                f"not fit {len(pages)} pages of {self.page} tokens")
+        if r.pos != len(r.prompt):
+            raise ValueError(
+                f"admit_prefilled(rid={r.rid}): pos={r.pos} != prompt len "
+                f"{len(r.prompt)} — request was not fully prefilled")
         slot = self.free_slot()
         if slot is None:
             return None

@@ -44,6 +44,7 @@ from ...core.retry import RetryPolicy
 from ...distributed.membership import EXPIRE, JOIN, MembershipService
 from ...testing.faults import InjectedFault as _InjectedFault
 from .admission import AlwaysAdmit
+from .disagg import RemotePrefillTier
 from .replica import ReplicaDeadError, ReplicaSet
 from .router import PrefixAffinityRouter
 from .rpc import RpcClient, RpcError
@@ -173,6 +174,10 @@ class FleetReplicaSet(ReplicaSet):
                                        max_delay=0.25)
         self.replicas = []
         self._by_name = {}
+        # members advertising role == "prefill" are disaggregation prefill
+        # tiers, not serving replicas: they never enter routing; a
+        # DisaggEngine lists them via remote_prefill=[...]
+        self.prefill_tiers: dict = {}
         self._connect_timeout = float(connect_timeout)
         self._sync_thread = None
         self._sync_stop = threading.Event()
@@ -190,6 +195,15 @@ class FleetReplicaSet(ReplicaSet):
         return events
 
     def _on_join(self, member):
+        meta0 = member.meta or {}
+        if meta0.get("role") == "prefill":
+            old = self.prefill_tiers.pop(member.name, None)
+            if old is not None:
+                old.close()
+            self.prefill_tiers[member.name] = RemotePrefillTier(
+                meta0.get("host", "127.0.0.1"), meta0["port"],
+                name=member.name, connect_timeout=self._connect_timeout)
+            return
         old = self._by_name.get(member.name)
         if old is not None:
             if getattr(old, "epoch", None) == member.epoch:
@@ -211,6 +225,10 @@ class FleetReplicaSet(ReplicaSet):
             pass  # died between join and warm-up; expiry will reap it
 
     def _on_gone(self, member, expired):
+        tier = self.prefill_tiers.pop(member.name, None)
+        if tier is not None:
+            tier.close()
+            return
         rep = self._by_name.get(member.name)
         if rep is None:
             return
@@ -250,3 +268,6 @@ class FleetReplicaSet(ReplicaSet):
             self._sync_thread = None
         for r in self.replicas:
             r.close()
+        for t in self.prefill_tiers.values():
+            t.close()
+        self.prefill_tiers.clear()

@@ -3,10 +3,20 @@
 The fleet's control plane (``submit`` / ``poll`` / ``cancel`` / ``health``)
 crosses process boundaries over this: one :class:`RpcServer` per worker
 process, one :class:`RpcClient` per remote replica handle in the gateway.
-Deliberately tiny — blocking sockets, a thread per server connection, no
-framing beyond ``u32 length | pickle`` — because the payloads are token
-lists and status enums, not tensors (bulk KV traffic rides XLA collectives,
-never this channel).
+Deliberately tiny — blocking sockets, a thread per server connection,
+length-prefixed pickle frames — because the payloads are token lists and
+status enums, not tensors (bulk KV traffic rides XLA collectives or the
+disaggregation handoff, never arbitrary objects).
+
+Frame format: ``u32 pickle_len | u32 n_buffers | pickle`` followed by
+``n_buffers`` × ``u64 len | raw bytes``.  The pickle is protocol 5 with a
+``buffer_callback``, so large contiguous buffers (the numpy page blocks of
+a cross-host KV handoff, ``pull_pages``/``push_pages`` payloads) travel
+OUT-OF-BAND: the in-band pickle stays a few hundred bytes of structure
+while each buffer is sent straight from its memoryview with zero in-band
+copy, and received into exactly-sized bytearrays that ``pickle.loads``
+rehydrates in place.  Ordinary ops (ints, strings, small lists) produce
+zero out-of-band buffers and behave exactly as before.
 
 Both ends are the same codebase, so exceptions travel by pickle: a worker
 raising :class:`~.admission.ShedError` re-raises as ``ShedError`` in the
@@ -40,24 +50,46 @@ class RpcError(ConnectionError):
     itself."""
 
 
+def _encode_frame(obj):
+    """Split ``obj`` into (in-band pickle, out-of-band buffer list) — the
+    protocol-5 fast path.  Factored from the socket write so tests can
+    assert bytes-on-the-wire without a socket."""
+    bufs: list = []
+    try:
+        payload = pickle.dumps(obj, protocol=5, buffer_callback=bufs.append)
+        return payload, [b.raw() for b in bufs]
+    except BufferError:
+        # a non-contiguous buffer cannot ship raw: fall back to in-band
+        return pickle.dumps(obj, protocol=5), []
+
+
 def _send_frame(sock, obj):
-    payload = pickle.dumps(obj)
-    sock.sendall(struct.pack("!I", len(payload)) + payload)
+    payload, bufs = _encode_frame(obj)
+    sock.sendall(struct.pack("!II", len(payload), len(bufs)) + payload)
+    for raw in bufs:
+        sock.sendall(struct.pack("!Q", raw.nbytes))
+        sock.sendall(raw)             # memoryview: no in-band copy
 
 
 def _recv_frame(sock):
-    hdr = _recv_exact(sock, 4)
-    (n,) = struct.unpack("!I", hdr)
-    return pickle.loads(_recv_exact(sock, n))
+    hdr = _recv_exact(sock, 8)
+    n, nbufs = struct.unpack("!II", hdr)
+    payload = _recv_exact(sock, n)
+    bufs = []
+    for _ in range(nbufs):
+        (blen,) = struct.unpack("!Q", _recv_exact(sock, 8))
+        bufs.append(_recv_exact(sock, blen))
+    return pickle.loads(payload, buffers=bufs)
 
 
 def _recv_exact(sock, n):
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+    buf = bytearray(n)
+    view, got = memoryview(buf), 0
+    while got < n:
+        k = sock.recv_into(view[got:])
+        if not k:
             raise RpcError("rpc connection closed")
-        buf += chunk
+        got += k
     return buf
 
 

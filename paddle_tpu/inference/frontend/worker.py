@@ -23,6 +23,12 @@ serialized page-chain block out of the replica that holds it and pushes it
 into the replica it routed to.  ``submit`` while draining raises
 :class:`~.admission.ShedError` ("draining") so the gateway's shed path
 handles the race between drain and route.
+
+``role="prefill"`` turns the worker into a disaggregation prefill tier: a
+:class:`~.disagg.PrefillHandoffBuffer` hooks the engine's
+``prefill_sink``, the lease meta advertises the role, and four more ops
+serve the handoff plane — ``handoff_ready handoff_pull handoff_cancel
+handoff_audit`` (see :mod:`.disagg`).
 """
 from __future__ import annotations
 
@@ -33,6 +39,7 @@ import time
 
 from ...distributed.membership import MembershipService
 from .admission import ShedError
+from .disagg import PrefillHandoffBuffer
 from .replica import EngineReplica
 from .rpc import RpcServer
 
@@ -51,8 +58,11 @@ class WorkerServer:
     def __init__(self, name, engine, store, group="fleet", ttl=2.0,
                  host="127.0.0.1", port=0, clock=time.monotonic,
                  heartbeat_interval=None, retry_policy=None,
-                 poll_interval=0.05):
+                 poll_interval=0.05, role="serve"):
         self.name = str(name)
+        self.role = str(role)
+        self.handoff = (PrefillHandoffBuffer(engine)
+                        if self.role == "prefill" else None)
         self.replica = EngineReplica(self.name, engine,
                                      poll_interval=poll_interval)
         self.rpc = RpcServer(self._handle, host, port)
@@ -74,7 +84,7 @@ class WorkerServer:
         self.rpc.start()
         self.lease = self.membership.register(self.name, meta={
             "host": self.rpc.host, "port": self.rpc.port,
-            "pid": os.getpid()})
+            "pid": os.getpid(), "role": self.role})
         if heartbeat:
             self.lease.start_heartbeat(self._hb_interval,
                                        on_lost=self._on_lease_lost)
@@ -147,11 +157,38 @@ class WorkerServer:
             return rep.export_pages(kw["keys"])
         if op == "push_pages":
             return rep.import_pages(kw["payload"])
+        if op == "handoff_ready":
+            return self.handoff.ready() if self.handoff is not None else []
+        if op == "handoff_pull":
+            if self.handoff is None:
+                raise ValueError(
+                    f"worker {self.name!r} has role={self.role!r}, not a "
+                    "prefill tier")
+            return self.handoff.pull(kw["rid"])
+        if op == "handoff_cancel":
+            if self.handoff is not None and self.handoff.drop(kw["rid"]):
+                return True
+            return rep.cancel(kw["rid"])
+        if op == "handoff_audit":
+            return self.audit_pages()
         if op == "ping":
             return {"name": self.name,
                     "epoch": self.lease.epoch if self.lease else None,
                     "pid": os.getpid()}
         raise ValueError(f"unknown worker op {op!r}")
+
+    def audit_pages(self):
+        """Page-refcount audit of the hosted engine, under the replica's
+        engine condition — the worker-side half of a disaggregation pool's
+        combined dual-pool audit (empty list means clean)."""
+        rep = self.replica
+        with rep._cv:
+            eng = rep.engine
+            fn = getattr(eng, "audit_refcounts", None)
+            if fn is not None:
+                return list(fn())
+            return list(eng.pool.audit(
+                eng.sched.expected_refs(eng.n_pages)))
 
 
 def load_engine_factory(spec):
@@ -191,13 +228,17 @@ def main(argv=None):
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--heartbeat-interval", type=float, default=None)
     p.add_argument("--drain-timeout", type=float, default=30.0)
+    p.add_argument("--role", default="serve", choices=("serve", "prefill"),
+                   help="'prefill' parks finished prefills for a "
+                        "disaggregation pool instead of decoding")
     args = p.parse_args(argv)
 
     engine = load_engine_factory(args.engine_spec)()
     store = TCPStore(host=args.store_host, port=args.store_port)
     server = WorkerServer(args.name, engine, store, group=args.group,
                           ttl=args.ttl, host=args.host, port=args.port,
-                          heartbeat_interval=args.heartbeat_interval)
+                          heartbeat_interval=args.heartbeat_interval,
+                          role=args.role)
     server.start()
 
     stop = threading.Event()

@@ -131,6 +131,23 @@ SERVING_HOST_CACHED_PAGES = REGISTRY.gauge(
     "serving_host_cached_pages",
     "KV pages resident in the host-RAM spill tier", ("engine",))
 
+# disaggregated prefill/decode (inference/engine/disagg.py); pool labels the
+# DisaggEngine instance, path says how the KV block crossed the seam
+SERVING_HANDOFF_QUEUE_DEPTH = REGISTRY.gauge(
+    "serving_handoff_queue_depth",
+    "prefill→decode handoffs waiting in the pool's bounded queue", ("pool",))
+SERVING_HANDOFF_WAIT_SECONDS = REGISTRY.histogram(
+    "serving_handoff_wait_seconds",
+    "queue wait from prefill completion to transfer dispatch",
+    ("pool", "path"),                          # path: local | cross_host
+    buckets=(0.0001, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0))
+SERVING_HANDOFF_TRANSFER_SECONDS = REGISTRY.histogram(
+    "serving_handoff_transfer_seconds",
+    "wall time a KV handoff spent in transfer work the decode loop could "
+    "not overlap (async: dispatch+land; sync: the whole blocking hop)",
+    ("pool", "path"),
+    buckets=(0.0001, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0))
+
 SERVING_TERMINALS = REGISTRY.counter(
     "serving_terminal_requests_total",
     "requests reaching a typed terminal status "

@@ -26,7 +26,10 @@ dry), ``serving.step`` (dispatch raises :class:`InjectedFault`),
 ``serving.slow_step`` (dispatch stalls ``delay`` seconds),
 ``serving.kv_handoff`` (disaggregated prefill→decode page transfer raises
 before any page is copied, so a transient retry is idempotent; ctx has
-``rids``), ``store.connect``
+``rids`` and ``path`` — ``local`` for the in-process gather→device_put hop,
+``cross_host`` when the pool pulls a serialized block off a remote prefill
+worker, where the fault fires pool-side BEFORE the pull RPC so a retry
+re-pulls a block the worker still holds), ``store.connect``
 (client connect raises); in the serving front door, ``frontend.route``
 (gateway submit fails before routing), ``frontend.submit`` (fails after a
 replica is chosen; ctx has ``replica``), ``frontend.step`` (a replica's
