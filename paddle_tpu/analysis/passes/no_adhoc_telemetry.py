@@ -12,6 +12,14 @@ and ``trace_span`` (timeline).  Ad-hoc instrumentation rots past them:
     process to ``time.monotonic()``.  Wall-clock reads that genuinely need
     calendar time (timestamps persisted across processes) carry a line
     pragma stating so.  (AT102)
+  * An RPC ``client.call(...)`` that omits the ``ctx`` keyword silently
+    DROPS the request's trace context at the process boundary — the remote
+    span events land in a fresh (orphaned) timeline and the fleet-merged
+    chrome trace shows a hole exactly where the bug is.  Every call on a
+    client-like receiver (``client`` / ``*_client`` / ``rpc``) must pass
+    ``ctx=`` — ``wire_context()`` for request-scoped traffic, an explicit
+    ``ctx=None`` for control-plane ops that genuinely have no trace.
+    (AT103)
 
 Pure CLI front-ends (whose job *is* printing) opt out with
 ``# graftlint: disable-file=no-adhoc-telemetry``.
@@ -28,16 +36,32 @@ _HINTS = {
              "console output",
     "AT102": "time.perf_counter() for durations, time.monotonic() for "
              "deadlines; pragma genuine wall-clock (calendar) reads",
+    "AT103": "pass ctx=wire_context() to thread the ambient trace through "
+             "the frame, or an explicit ctx=None for untraced "
+             "control-plane ops",
 }
+
+# receivers treated as RPC clients: `client.call(...)`, `self.client.call`,
+# `foo_client.call`, `rpc.call`.  Purely lexical — graftlint is AST-only —
+# so a non-RPC object that happens to be named `client` needs a line pragma.
+def _is_client_receiver(expr):
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    else:
+        return False
+    name = name.lower().lstrip("_")
+    return name == "rpc" or name == "client" or name.endswith("_client")
 
 
 @register_pass
 class NoAdhocTelemetryPass(AnalysisPass):
     name = "no-adhoc-telemetry"
-    version = 1
-    codes = ("AT101", "AT102")
-    description = ("bare print() and wall-clock time.time() timing in "
-                   "library code (vs logging/registry/perf_counter)")
+    version = 2
+    codes = ("AT101", "AT102", "AT103")
+    description = ("bare print(), wall-clock time.time() timing, and RPC "
+                   "client.call() sites that drop the trace-context field")
 
     def check_file(self, src) -> list[Finding]:
         findings: list[Finding] = []
@@ -68,4 +92,12 @@ class NoAdhocTelemetryPass(AnalysisPass):
                     self.name, "AT102", src.path, node.lineno,
                     f"{f.id}() (time.time) is wall clock — intervals jump "
                     "on NTP steps", _HINTS["AT102"]))
+            elif (isinstance(f, ast.Attribute) and f.attr == "call"
+                  and _is_client_receiver(f.value)
+                  and not any(k.arg == "ctx" for k in node.keywords)):
+                findings.append(Finding(
+                    self.name, "AT103", src.path, node.lineno,
+                    "RpcClient.call without ctx= drops the request's trace "
+                    "context at the process boundary — remote spans orphan "
+                    "into a fresh timeline", _HINTS["AT103"]))
         return findings

@@ -29,6 +29,7 @@ import numpy as np
 import jax.numpy as jnp  # noqa: F401  (re-exported for monkeypatch parity)
 
 from ... import observability as _obs
+from ...observability import flight as _flight
 from ...core.retry import RetryError, RetryPolicy, retry_call
 from ...testing.faults import FAULTS as _faults
 from .compat import _LegacyDelegation
@@ -310,6 +311,14 @@ class LLMEngine(_LegacyDelegation, _SpecOrchestration):
                     top_p=top_p, top_k=top_k, seed=seed, deadline=deadline,
                     resume_tokens=resume_tokens)
         self._next_rid += 1
+        ctx = _flight.current()
+        if ctx is not None:
+            # adopt the ambient trace (gateway-minted, or RPC-delivered by
+            # the worker's server thread) so every scheduler phase records
+            r.trace_id = ctx.trace_id
+            _flight.record("queued", rid=r.rid, trace_id=r.trace_id,
+                           prompt_tokens=len(r.prompt),
+                           max_new=r.max_new, resumed=bool(r.resumed_from))
         if r.resumed_from:
             self.resume_admissions += 1
         if deadline is not None:
@@ -352,11 +361,16 @@ class LLMEngine(_LegacyDelegation, _SpecOrchestration):
         r.prefill_dispatches += 1
         self.prefill_dispatches += 1
         self._m.prefill.inc()
+        t0 = time.perf_counter()
         with _obs.trace_span("serving.prefill"):
             nxt = self.runner.run_prefill(
                 toks, start, sched.slot_tables[slot], n,
                 0 if r.do_sample else 1, r.temperature, r.top_p, r.top_k,
                 self._next_seed(r))
+        if r.trace_id is not None:
+            _flight.record("prefill", rid=r.rid, trace_id=r.trace_id,
+                           dur=time.perf_counter() - t0, tokens=n,
+                           start=start)
         r.pos += n
         sched.lens[slot] = start + n
         if self.prefix_cache:
@@ -464,6 +478,11 @@ class LLMEngine(_LegacyDelegation, _SpecOrchestration):
                 k, tokens, sched.lens, sched.slot_tables, active,
                 greedy, temp, topp, topk, seeds, fold)       # [k, B]
         dt = time.perf_counter() - t0
+        if _flight.enabled():
+            for slot, r in live:
+                if r.trace_id is not None:
+                    _flight.record("decode", rid=r.rid, trace_id=r.trace_id,
+                                   dur=dt, block=k)
         if self._auto_block and not compile_call:
             # host sync above makes the wall time a true dispatch sample
             self._record_block_sample(k, dt)
@@ -557,7 +576,12 @@ class LLMEngine(_LegacyDelegation, _SpecOrchestration):
     def _quarantine(self, slot, err):
         """Finalize the slot's request FAILED — the error is recorded on the
         request, its pages return through the refcounts (shared prefix-cache
-        pages other slots map stay live) — and keep serving everyone else."""
+        pages other slots map stay live) — and keep serving everyone else.
+        The victim's trace is pinned in the flight recorder (and dumped when
+        a dump dir is configured) so the post-mortem survives ring churn."""
+        r = self.sched.slots[slot]
+        if r is not None and r.trace_id is not None:
+            _flight.pin(r.trace_id, "quarantine")
         self.sched.release(slot, RequestStatus.FAILED, error=err)
 
     def _decode_probe(self, slot):

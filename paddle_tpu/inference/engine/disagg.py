@@ -66,6 +66,7 @@ import numpy as np
 import jax
 
 from ... import observability as _obs
+from ...observability import flight as _flight
 from ...core.retry import RetryError, RetryPolicy, retry_call
 from ...testing.faults import FAULTS as _faults
 from .core import LLMEngine
@@ -360,6 +361,11 @@ class DisaggEngine:
             temperature=kw.get("temperature", 1.0),
             top_p=kw.get("top_p", 1.0), top_k=kw.get("top_k", 0),
             seed=kw.get("seed"), deadline=kw.get("deadline"))
+        ctx = _flight.current()
+        if ctx is not None:
+            placeholder.trace_id = ctx.trace_id
+            _flight.record("remote_submit", rid=pool_rid,
+                           trace_id=ctx.trace_id, tier=tier.name, wrid=wrid)
         self._remote_pending[pool_rid] = (t, wrid, placeholder)
         return pool_rid
 
@@ -404,6 +410,9 @@ class DisaggEngine:
         if pe.sched.slots[slot] is not r:
             return                 # max_new==1 / eos at first token: done
         req, pages, n_tokens = pe.sched.detach(slot)
+        if req.trace_id is not None:
+            _flight.record("handoff_queued", rid=req.rid,
+                           trace_id=req.trace_id, src=i, n_tokens=n_tokens)
         h = _Handoff(req, pages, n_tokens, src=i)
         self._queue.append(h)
         self._queued[req.rid] = h
@@ -520,6 +529,10 @@ class DisaggEngine:
             de.pool.unref_page(p)
         self._drop_src_pages(h)
         de.sched.finalize(h.r, RequestStatus.FAILED, error=err)
+        if h.r.trace_id is not None:
+            # pin AFTER finalize so the dumped post-mortem includes the
+            # terminal span
+            _flight.pin(h.r.trace_id, "poison_quarantine")
 
     def _stage(self):
         """Async pipeline, send half: dispatch the transfer for every
@@ -538,6 +551,10 @@ class DisaggEngine:
                 self._quarantine(h, j, dst, err)
                 continue
             dispatch_s = time.perf_counter() - t0
+            if h.r.trace_id is not None:
+                _flight.record("handoff_dispatch", rid=h.r.rid,
+                               trace_id=h.r.trace_id, dur=dispatch_s,
+                               dst=j, path=h.path)
             # the dispatched gather owns the data: source refs can go now,
             # parking content-registered prompt pages in the prefill LRU
             self._drop_src_pages(h)
@@ -573,6 +590,10 @@ class DisaggEngine:
             self._staged_slots[s.j] -= 1
             de.runner.scatter_pages(s.dst, s.block)
             land_s = time.perf_counter() - t0
+            if s.h.r.trace_id is not None:
+                _flight.record("handoff_land", rid=s.h.r.rid,
+                               trace_id=s.h.r.trace_id, dur=land_s,
+                               dst=s.j, path=s.h.path)
             self.transfer_s += s.dispatch_s + land_s
             self.transfer_overlap_s += max(0.0, t0 - s.t_staged)
             self._pm.transfer[s.h.path].observe(s.dispatch_s + land_s)
@@ -599,6 +620,10 @@ class DisaggEngine:
             de.sched.admit_prefilled(h.r, dst, h.n_tokens)
             self._drop_src_pages(h)
             dt = time.perf_counter() - t0
+            if h.r.trace_id is not None:
+                _flight.record("handoff_land", rid=h.r.rid,
+                               trace_id=h.r.trace_id, dur=dt, dst=j,
+                               path=h.path)
             self.transfer_s += dt
             self._pm.transfer[h.path].observe(dt)
             self.handoffs += 1
@@ -691,6 +716,8 @@ class DisaggEngine:
                 pass
             self.decodes[0].sched.finalize(placeholder, RequestStatus.FAILED,
                                            error=err)
+            if placeholder.trace_id is not None:
+                _flight.pin(placeholder.trace_id, "poison_quarantine")
             return
         _, _, placeholder = self._remote_pending.pop(pool_rid)
         r = payload["req"]
@@ -700,6 +727,11 @@ class DisaggEngine:
         r.t_submit = placeholder.t_submit
         r.deadline = placeholder.deadline
         r.stream_pos = 0
+        if r.trace_id is None:
+            r.trace_id = placeholder.trace_id
+        if r.trace_id is not None:
+            _flight.record("handoff_pulled", rid=pool_rid,
+                           trace_id=r.trace_id, tier=tier.name, wrid=wrid)
         if payload["block"] is None:
             # finished at the first prefill token (max_new==1 / instant
             # eos): terminal worker-side, nothing to transfer — record the

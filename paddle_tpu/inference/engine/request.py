@@ -106,3 +106,4 @@ class Request:
         self.cached_tokens = 0       # prompt tokens served from prefix cache
         self.cache_keys = ()         # chain keys of the prompt's full pages
         self.stream_pos = 0          # tokens already handed to new_tokens()
+        self.trace_id = None         # flight-recorder trace (ambient ctx)

@@ -25,6 +25,7 @@ from collections import deque
 
 import numpy as np
 
+from ...observability import flight as _flight
 from .request import RequestStatus, prefix_page_keys
 
 __all__ = ["Scheduler"]
@@ -93,6 +94,10 @@ class Scheduler:
             r.error = f"{type(error).__name__}: {error}"
         r.t_finish = time.perf_counter()
         self.finished[r.rid] = r
+        if r.trace_id is not None:
+            _flight.record("terminal", rid=r.rid, trace_id=r.trace_id,
+                           status=status.value, error=r.error,
+                           tokens=len(r.out))
         if status is RequestStatus.SHED:
             self.shed_requests += 1
         elif status is RequestStatus.TIMEOUT:
@@ -237,7 +242,13 @@ class Scheduler:
                 while i + len(run) < len(plan) \
                         and plan[i + len(run)][1] is None:
                     run.append(plan[i + len(run)][0])
+                t0 = time.perf_counter()
                 got = self._restore_chain(run)
+                if r.trace_id is not None:
+                    _flight.record("spill_restore", rid=r.rid,
+                                   trace_id=r.trace_id,
+                                   dur=time.perf_counter() - t0,
+                                   asked=len(run), restored=len(got))
                 pages.extend(got)
                 n_restored += len(got)
                 if len(got) < len(run):
@@ -377,6 +388,9 @@ class Scheduler:
             r.ttft = time.perf_counter() - r.t_submit
             if self._m is not None:
                 self._m.ttft.observe(r.ttft)
+            if r.trace_id is not None:
+                _flight.record("first_token", rid=r.rid,
+                               trace_id=r.trace_id, ttft=r.ttft)
         hit_eos = (r.eos is not None and r.out[-1] == r.eos)
         if (len(r.out) >= r.max_new or hit_eos
                 or int(self.lens[slot]) >= self.max_len):

@@ -28,6 +28,7 @@ from __future__ import annotations
 import copy
 import threading
 
+from ...observability import flight as _flight
 from ..serving import RequestStatus
 from .rpc import RpcClient
 
@@ -74,6 +75,9 @@ class PrefillHandoffBuffer:
             eng.sched.finalize(req, RequestStatus.FINISHED)
         payload["req"].slot = None
         payload["req"].stream_pos = 0
+        if r.trace_id is not None:
+            _flight.record("handoff_parked", rid=r.rid, trace_id=r.trace_id,
+                           n_tokens=payload["n_tokens"])
         with self._lock:
             self._parked[r.rid] = payload
             self.parked_total += 1
@@ -111,21 +115,24 @@ class RemotePrefillTier:
         self._inflight = 0
 
     def submit(self, prompt_ids, **kw):
-        rid = self.client.call("submit", prompt_ids=list(prompt_ids), **kw)
+        rid = self.client.call("submit", ctx=_flight.wire_context(),
+                               prompt_ids=list(prompt_ids), **kw)
         self._inflight += 1
         return rid
 
     def poll_ready(self):
-        return self.client.call("handoff_ready")
+        return self.client.call("handoff_ready", ctx=_flight.wire_context())
 
     def pull(self, rid):
-        payload = self.client.call("handoff_pull", rid=rid)
+        payload = self.client.call("handoff_pull",
+                                   ctx=_flight.wire_context(), rid=rid)
         self._inflight = max(0, self._inflight - 1)
         return payload
 
     def cancel(self, rid):
         try:
-            return self.client.call("handoff_cancel", rid=rid)
+            return self.client.call("handoff_cancel",
+                                    ctx=_flight.wire_context(), rid=rid)
         finally:
             self._inflight = max(0, self._inflight - 1)
 
@@ -137,7 +144,17 @@ class RemotePrefillTier:
         return self._inflight
 
     def audit(self):
-        return self.client.call("handoff_audit")
+        return self.client.call("handoff_audit", ctx=_flight.wire_context())
+
+    def metrics_snapshot(self, deadline=None):
+        """The prefill worker's full registry snapshot (federation pull)."""
+        return self.client.call("metrics_snapshot", deadline=deadline,
+                                ctx=None)
+
+    def trace_events(self, trace_id=None, deadline=None):
+        """The prefill worker's flight-recorder events for ``trace_id``."""
+        return self.client.call("trace_events", deadline=deadline, ctx=None,
+                                trace_id=trace_id)
 
     def close(self):
         self.client.close()

@@ -40,8 +40,10 @@ from __future__ import annotations
 import threading
 import time
 
+from ... import observability as _obs
 from ...core.retry import RetryPolicy
 from ...distributed.membership import EXPIRE, JOIN, MembershipService
+from ...observability import flight as _flight
 from ...testing.faults import InjectedFault as _InjectedFault
 from .admission import AlwaysAdmit
 from .disagg import RemotePrefillTier
@@ -70,7 +72,11 @@ class RemoteReplica:
             raise ReplicaDeadError(
                 f"replica {self.name!r} is dead: {self.error!r}")
         try:
-            return self.client.call(op, deadline=deadline, **kw)
+            # thread the ambient trace through every frame: the worker's
+            # span events join the caller's timeline with adopted Lamport
+            # stamps (wire_context is None for untraced / disabled calls)
+            return self.client.call(op, deadline=deadline,
+                                    ctx=_flight.wire_context(), **kw)
         except (RpcError, _InjectedFault) as e:
             self.die(e)
             raise ReplicaDeadError(
@@ -143,6 +149,16 @@ class RemoteReplica:
             return self._call("metrics")
         except ReplicaDeadError:
             return {}
+
+    def metrics_snapshot(self, deadline=None):
+        """The worker PROCESS's full registry snapshot (federation pull)."""
+        return self._call("metrics_snapshot", deadline=deadline)
+
+    def trace_events(self, trace_id=None, deadline=None):
+        """The worker's flight-recorder events for ``trace_id`` (all, when
+        None) — the pull half of fleet-wide request tracing."""
+        return self._call("trace_events", deadline=deadline,
+                          trace_id=trace_id)
 
     def __repr__(self):
         return (f"RemoteReplica({self.name!r}, epoch={self.epoch}, "
@@ -238,6 +254,29 @@ class FleetReplicaSet(ReplicaSet):
                 f"replica {member.name!r} lease expired "
                 f"(epoch {member.epoch})"))
         self.remove_replica(member.name)
+
+    # ---- fleet observability -------------------------------------------------
+    def federated_snapshot(self, deadline=1.0):
+        """Extend the base scrape with the disaggregation prefill tiers:
+        they are leased members with registries of their own, just not
+        serving replicas, so routing skips them but federation must not."""
+        remotes = super().federated_snapshot(deadline)
+        for name, tier in list(self.prefill_tiers.items()):
+            try:
+                remotes[name] = tier.metrics_snapshot(deadline=deadline)
+            except Exception:  # noqa: BLE001 — scrape must never wedge
+                _obs.FRONTEND_FEDERATION_ERRORS.inc(replica=name)
+        return remotes
+
+    def trace_events_fleet(self, trace_id, deadline=1.0):
+        batches = [super().trace_events_fleet(trace_id, deadline)]
+        for name, tier in list(self.prefill_tiers.items()):
+            try:
+                batches.append(tier.trace_events(trace_id,
+                                                 deadline=deadline))
+            except Exception:  # noqa: BLE001 — scrape must never wedge
+                _obs.FRONTEND_FEDERATION_ERRORS.inc(replica=name)
+        return _flight.merge_events(*batches)
 
     # ---- lifecycle -----------------------------------------------------------
     def start_sync(self, interval=0.2):

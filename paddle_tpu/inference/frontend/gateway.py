@@ -10,8 +10,27 @@ Endpoints::
 
     POST /v1/completions   JSON body {"prompt": [token ids],
                            "max_tokens": n, "stream": bool, ...sampling}
-    GET  /healthz          per-replica health snapshots (JSON)
-    GET  /metrics          Prometheus text exposition of the registry
+    GET  /healthz          per-replica health snapshots (JSON) + a
+                           ``fleet`` rollup (alive/draining counts, epochs,
+                           pooled page/host-tier totals)
+    GET  /metrics          Prometheus text exposition — the gateway's own
+                           registry FEDERATED with every live remote
+                           member's snapshot, remote series labeled
+                           ``replica=``; a dead member is skipped within a
+                           bounded scrape deadline and counted in
+                           ``frontend_federation_errors_total``
+    GET  /v1/requests/{rid}/trace
+                           merged chrome-trace JSON for one request:
+                           span events pulled from every fleet process plus
+                           the gateway's own flight recorder, causally
+                           ordered by Lamport stamps — load it straight
+                           into chrome://tracing / Perfetto
+
+Every ``POST /v1/completions`` is assigned a request id — taken from the
+client's ``X-Request-ID`` header when present, minted otherwise — which is
+ALSO the flight-recorder trace id.  It is echoed in the ``X-Request-ID``
+response header and the JSON body (``request_id``), and is what
+``/v1/requests/{rid}/trace`` looks up.
 
 Terminal-status → HTTP mapping:
 
@@ -55,6 +74,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ... import observability as _obs
+from ...observability import flight as _flight
 from ..serving import RequestStatus
 from .admission import ShedError
 from .journal import DurableRequestPlane
@@ -100,28 +120,75 @@ class _Handler(BaseHTTPRequestHandler):
     replica_set = None       # bound per-server by start_gateway
     plane = None             # DurableRequestPlane, durable mode only
     ping_interval = 5.0      # idle seconds between SSE keep-alive comments
+    request_id = None        # per-POST trace id (X-Request-ID)
 
     # ---- GET -----------------------------------------------------------------
     def do_GET(self):  # noqa: N802 (stdlib handler API)
         path = self.path.split("?")[0]
         if path == "/healthz":
-            health = self.replica_set.health()
+            health = dict(self.replica_set.health())
             if self.plane is not None:
                 # "journal" is a reserved key in durable mode (don't name a
                 # replica that): journal depth + recovery state ride along
-                health = dict(health)
                 health["journal"] = self.plane.health()
+            # "fleet" is reserved too: the rollup external monitors page on
+            # without walking every per-replica snapshot
+            health["fleet"] = self._fleet_rollup(health)
             self._send_json(200, health)
         elif path == "/metrics":
-            body = _obs.render_prometheus().encode("utf-8")
+            # federated exposition when the replica set can scrape its
+            # members; a bare duck-typed set falls back to local-only
+            fed = getattr(self.replica_set, "metrics_exposition", None)
+            text = fed() if fed is not None else _obs.render_prometheus()
+            body = text.encode("utf-8")
             self.send_response(200)
             self.send_header("Content-Type",
                              "text/plain; version=0.0.4; charset=utf-8")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif path.startswith("/v1/requests/") and path.endswith("/trace"):
+            rid = path[len("/v1/requests/"):-len("/trace")]
+            if not rid or "/" in rid:
+                self._send_json(404, {"error": f"no route for {path}"})
+                return
+            fn = getattr(self.replica_set, "trace_events_fleet", None)
+            events = (fn(rid) if fn is not None
+                      else _flight.snapshot_events(rid))
+            if not events:
+                self._send_json(404,
+                                {"error": f"no trace for request {rid!r}"})
+                return
+            self._send_json(200, _flight.chrome_trace(events))
         else:
             self._send_json(404, {"error": f"no route for {path}"})
+
+    @staticmethod
+    def _fleet_rollup(health):
+        """Aggregate the per-replica snapshots into one fleet summary:
+        liveness/draining counts, per-replica epochs, and pooled page
+        totals (device free/reclaimable + host tier)."""
+        rollup = {"replicas": 0, "alive": 0, "draining": 0, "epochs": {},
+                  "active_slots": 0, "waiting": 0, "free_pages": 0,
+                  "reclaimable_pages": 0, "host_cached_pages": 0,
+                  "host_bytes": 0}
+        for name, snap in health.items():
+            if name in ("journal", "fleet") or not isinstance(snap, dict):
+                continue
+            rollup["replicas"] += 1
+            if snap.get("alive"):
+                rollup["alive"] += 1
+            if snap.get("draining"):
+                rollup["draining"] += 1
+            if snap.get("epoch") is not None:
+                rollup["epochs"][name] = snap["epoch"]
+            for k in ("active_slots", "waiting", "free_pages",
+                      "reclaimable_pages", "host_cached_pages",
+                      "host_bytes"):
+                v = snap.get(k)
+                if isinstance(v, (int, float)):
+                    rollup[k] += v
+        return rollup
 
     # ---- POST /v1/completions ------------------------------------------------
     def do_POST(self):  # noqa: N802 (stdlib handler API)
@@ -140,29 +207,39 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, KeyError, TypeError) as e:
             self._send_json(400, {"error": f"bad request: {e}"})
             return
-        if self.plane is not None:
-            self._durable_completion(prompt, kw, stream)
-            return
-        try:
-            handle = self.replica_set.submit(prompt, **kw)
-        except ShedError as e:
-            self._send_json(429, {"error": str(e), "reason": e.reason},
-                            headers={"Retry-After":
-                                     str(max(1, int(e.retry_after)))})
-            return
-        except ReplicaDeadError as e:
-            # dead fleet: carry Retry-After like the SHED 429 does, so
-            # clients back off instead of hot-looping on 503s
-            self._send_json(503, {"error": str(e)},
-                            headers={"Retry-After": "1"})
-            return
-        except ValueError as e:
-            self._send_json(400, {"error": str(e)})
-            return
-        if stream:
-            self._stream_response(handle)
-        else:
-            self._blocking_response(handle)
+        # one request id per accepted POST — the client's X-Request-ID when
+        # present, minted otherwise — doubling as the flight-recorder trace
+        # id; the ambient context threads it through routing, the durable
+        # plane, RPC frames, and the engines without touching signatures
+        _flight.set_proc_label("gateway")
+        ctx = _flight.mint(self.headers.get("X-Request-ID") or None)
+        self.request_id = ctx.trace_id
+        with _flight.use_context(ctx):
+            _flight.record("gateway_accept", trace_id=ctx.trace_id,
+                           prompt_tokens=len(prompt), stream=stream)
+            if self.plane is not None:
+                self._durable_completion(prompt, kw, stream)
+                return
+            try:
+                handle = self.replica_set.submit(prompt, **kw)
+            except ShedError as e:
+                self._send_json(429, {"error": str(e), "reason": e.reason},
+                                headers={"Retry-After":
+                                         str(max(1, int(e.retry_after)))})
+                return
+            except ReplicaDeadError as e:
+                # dead fleet: carry Retry-After like the SHED 429 does, so
+                # clients back off instead of hot-looping on 503s
+                self._send_json(503, {"error": str(e)},
+                                headers={"Retry-After": "1"})
+                return
+            except ValueError as e:
+                self._send_json(400, {"error": str(e)})
+                return
+            if stream:
+                self._stream_response(handle)
+            else:
+                self._blocking_response(handle)
 
     def _blocking_response(self, handle):
         rs = self.replica_set
@@ -178,8 +255,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(500, {"error": rs.request_error(handle),
                                   "status": status.value})
             return
+        _flight.record("gateway_done", trace_id=self.request_id,
+                       status=status.value, tokens=len(tokens))
         self._send_json(200, {
             "replica": handle.replica.name,
+            "request_id": self.request_id,
             "status": status.value,
             "tokens": tokens,
             "usage": {"completion_tokens": len(tokens)},
@@ -191,6 +271,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-store")
         self.send_header("Connection", "close")
+        if self.request_id is not None:
+            self.send_header("X-Request-ID", self.request_id)
         # SSE has no predeclared length; closing the socket ends the stream
         self.close_connection = True
         self.end_headers()
@@ -209,8 +291,11 @@ class _Handler(BaseHTTPRequestHandler):
                 self._sse({"token": int(tok), "index": i})
                 i += 1
             status = rs.status(handle)
+            _flight.record("gateway_done", trace_id=self.request_id,
+                           status=status.value, tokens=i)
             final = {"status": status.value,
                      "replica": handle.replica.name,
+                     "request_id": self.request_id,
                      "usage": {"completion_tokens": i}}
             if status is RequestStatus.FAILED:
                 final["error"] = rs.request_error(handle)
@@ -297,6 +382,8 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-store")
             self.send_header("Connection", "close")
+            if self.request_id is not None:
+                self.send_header("X-Request-ID", self.request_id)
             self.send_header("Idempotency-Key", req.key)
             self.close_connection = True
             self.end_headers()
@@ -342,6 +429,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if self.request_id is not None:
+            self.send_header("X-Request-ID", self.request_id)
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         self.end_headers()

@@ -36,6 +36,7 @@ import threading
 import time
 
 from ... import observability as _obs
+from ...observability import flight as _flight
 from ...core.retry import RetryPolicy, retry_call
 from ...testing import faults as _faults
 from ..serving import RequestStatus as _RequestStatus
@@ -139,6 +140,10 @@ class EngineReplica:
         return bool(eng._waiting) or any(s is not None for s in eng._slots)
 
     def _loop(self):
+        # engine-side span events (prefill/decode/first_token/terminal) all
+        # record from this thread — label them with the replica's name so a
+        # merged trace shows which replica served each phase
+        _flight.set_proc_label(f"replica:{self.name}")
         while True:
             with self._cv:
                 if self._stop:
@@ -204,6 +209,11 @@ class EngineReplica:
             for slot in self._out.values():
                 if not slot["status"].terminal:
                     slot["status"] = _RequestStatus.FAILED
+                    if slot.get("trace") is not None:
+                        # recorder lock is a leaf — safe from this cv-free
+                        # context; the victim's post-mortem survives ring
+                        # churn (and dumps when a dump dir is configured)
+                        _flight.pin(slot["trace"], "stuck_step")
             self._out_cv.notify_all()
 
     def _publish(self):
@@ -261,9 +271,14 @@ class EngineReplica:
                 raise ReplicaDeadError(
                     f"replica {self.name!r} is dead: {self.error!r}")
             rid = self.engine.add_request(prompt_ids, **kw)
+            ctx = _flight.current()
             with self._out_cv:
+                # remember the trace so lock-free anomaly paths (the stuck-
+                # step watchdog) can pin it without touching the engine
                 self._out[rid] = {"toks": [],
-                                  "status": self.engine.status(rid)}
+                                  "status": self.engine.status(rid),
+                                  "trace": None if ctx is None
+                                  else ctx.trace_id}
             self._cv.notify_all()
             return rid
 
@@ -403,11 +418,12 @@ class RequestHandle:
 
     __slots__ = ("replica", "rid", "t0", "_accounted", "prompt_ids", "kw",
                  "emitted", "requeued", "resumed", "resume_t0",
-                 "final_status", "final_error")
+                 "final_status", "final_error", "trace_id")
 
     def __init__(self, replica, rid, prompt_ids=None, kw=None):
         self.replica = replica
         self.rid = rid
+        self.trace_id = None         # flight-recorder trace (ambient ctx)
         self.t0 = time.perf_counter()
         self._accounted = False
         self.prompt_ids = prompt_ids
@@ -577,8 +593,14 @@ class ReplicaSet:
             raise ShedError("engine", decision.retry_after)
         _obs.FRONTEND_ROUTED.inc(replica=rep.name, reason=route.reason)
         _obs.FRONTEND_INFLIGHT.inc()
-        return RequestHandle(rep, rid, prompt_ids=list(prompt_ids),
-                             kw=dict(kw))
+        handle = RequestHandle(rep, rid, prompt_ids=list(prompt_ids),
+                               kw=dict(kw))
+        ctx = _flight.current()
+        if ctx is not None:
+            handle.trace_id = ctx.trace_id
+            _flight.record("routed", rid=rid, trace_id=ctx.trace_id,
+                           replica=rep.name, reason=route.reason)
+        return handle
 
     def _peer_warm(self, rep, holder, prompt_ids, lo, hi):
         """Cold-pull the passed-over holder's cached page chain
@@ -676,12 +698,21 @@ class ReplicaSet:
                              if r is not handle.replica]
                     if alive:
                         route = self.router.route(handle.prompt_ids, alive)
-                        rid = route.replica.submit(handle.prompt_ids,
-                                                   **handle.kw)
+                        # resubmit under the original trace so the survivor's
+                        # engine spans join the caller's request timeline
+                        rctx = (None if handle.trace_id is None
+                                else _flight.mint(handle.trace_id))
+                        with _flight.use_context(rctx):
+                            rid = route.replica.submit(handle.prompt_ids,
+                                                       **handle.kw)
                         if route.replica.status(rid) \
                                 is not _RequestStatus.SHED:
                             handle.replica, handle.rid = route.replica, rid
                             handle.requeued = True
+                            if handle.trace_id is not None:
+                                _flight.record("requeue", rid=rid,
+                                               trace_id=handle.trace_id,
+                                               replica=route.replica.name)
                             _obs.FRONTEND_REQUEUED.inc()
                             _obs.FRONTEND_ROUTED.inc(
                                 replica=route.replica.name, reason="requeue")
@@ -738,13 +769,23 @@ class ReplicaSet:
             # prefix pages re-prefills the least
             route = self.router.route(list(handle.prompt_ids) + emitted,
                                       alive)
-            rid = route.replica.submit(handle.prompt_ids, **kw)
+            # the resumed incarnation stays on the ORIGINAL trace — one
+            # merged timeline shows death, splice, and continuation
+            rctx = (None if handle.trace_id is None
+                    else _flight.mint(handle.trace_id))
+            with _flight.use_context(rctx):
+                rid = route.replica.submit(handle.prompt_ids, **kw)
             if route.replica.status(rid) is _RequestStatus.SHED:
                 return None
         except (ReplicaDeadError, ShedError, _faults.InjectedFault):
             return None  # the resume attempt itself died: caller pins FAILED
         handle.replica, handle.rid = route.replica, rid
         handle.resume_t0 = t_death
+        if handle.trace_id is not None:
+            _flight.record("resume", rid=rid, trace_id=handle.trace_id,
+                           replica=route.replica.name,
+                           streamed=len(emitted))
+            _flight.pin(handle.trace_id, "resume")
         _obs.FRONTEND_RESUMED.inc()
         _obs.FRONTEND_ROUTED.inc(replica=route.replica.name, reason="resume")
         return route.replica.status(rid)
@@ -854,3 +895,58 @@ class ReplicaSet:
     def metrics(self):
         """Per-replica registry snapshots keyed by replica name."""
         return {r.name: r.metrics() for r in self.replicas}
+
+    # ---- fleet observability -------------------------------------------------
+    def federated_snapshot(self, deadline=1.0):
+        """Full registry snapshots of every live member that runs in its
+        OWN process (remote workers), keyed by replica name — the scrape
+        half of metrics federation.  In-process replicas share this
+        process's registry, so they contribute through the local snapshot
+        and are skipped here.  A dead member, or one that can't answer
+        within ``deadline`` seconds, is skipped with
+        ``frontend_federation_errors_total{replica=}`` incremented — a
+        half-dead worker must never wedge the /metrics page."""
+        remotes = {}
+        for rep in list(self.replicas):
+            fn = getattr(rep, "metrics_snapshot", None)
+            if fn is None:
+                continue  # in-process: already in the local registry
+            if not getattr(rep, "alive", True):
+                _obs.FRONTEND_FEDERATION_ERRORS.inc(replica=rep.name)
+                continue
+            try:
+                remotes[rep.name] = fn(deadline=deadline)
+            except Exception:  # noqa: BLE001 — scrape must never wedge
+                _obs.FRONTEND_FEDERATION_ERRORS.inc(replica=rep.name)
+        return remotes
+
+    def metrics_exposition(self, deadline=1.0):
+        """One Prometheus page for the WHOLE fleet: this process's registry
+        merged with every live remote member's snapshot, remote series
+        relabeled ``replica=<name>``."""
+        # scrape the remotes FIRST: a member that dies mid-scrape bumps the
+        # federation error counter, and the local snapshot must be taken
+        # after that so the very page that skipped it reports the skip
+        remotes = self.federated_snapshot(deadline)
+        return _obs.render_snapshot(_obs.merge_snapshots(
+            _obs.REGISTRY.snapshot(), remotes))
+
+    def trace_events_fleet(self, trace_id, deadline=1.0):
+        """Every span event recorded for ``trace_id`` anywhere in the
+        fleet — this process's flight recorder plus each live remote
+        member's — merged, deduplicated, and causally ordered.  Dead or
+        unresponsive members are skipped (same error counter as the
+        metrics scrape)."""
+        batches = [_flight.snapshot_events(trace_id)]
+        for rep in list(self.replicas):
+            fn = getattr(rep, "trace_events", None)
+            if fn is None:
+                continue  # in-process: shares this recorder
+            if not getattr(rep, "alive", True):
+                _obs.FRONTEND_FEDERATION_ERRORS.inc(replica=rep.name)
+                continue
+            try:
+                batches.append(fn(trace_id, deadline=deadline))
+            except Exception:  # noqa: BLE001 — scrape must never wedge
+                _obs.FRONTEND_FEDERATION_ERRORS.inc(replica=rep.name)
+        return _flight.merge_events(*batches)

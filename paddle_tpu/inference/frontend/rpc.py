@@ -29,6 +29,16 @@ maps those to replica death.  Fault points ``rpc.send`` / ``rpc.recv``
 (:mod:`paddle_tpu.testing.faults`, ctx has ``op``) fire client-side around
 the request/response halves so chaos tests can sever a live worker's
 channel without touching the process.
+
+Request tracing rides the frame as a third element: a request is
+``(op, kwargs, trace_ctx)`` where ``trace_ctx`` is
+:func:`~paddle_tpu.observability.flight.wire_context`'s tiny
+``(trace_id, lamport)`` tuple or None; a reply is
+``(status, value, lamport)``.  The server adopts the sender's Lamport
+stamp and installs the context ambiently around the handler, so worker-side
+span events join the caller's trace with monotone causal ordering; the
+client folds the reply stamp back in.  Both ends still accept bare
+two-element frames from peers predating the ctx field.
 """
 from __future__ import annotations
 
@@ -37,6 +47,7 @@ import socket
 import struct
 import threading
 
+from ...observability import flight as _flight
 from ...testing import faults as _faults
 
 __all__ = ["RpcError", "RpcServer", "RpcClient"]
@@ -142,17 +153,23 @@ class RpcServer:
         try:
             while True:
                 try:
-                    op, kw = _recv_frame(conn)
+                    frame = _recv_frame(conn)
                 except (RpcError, OSError, EOFError, pickle.UnpicklingError):
                     return
+                # (op, kw, trace_ctx) since the tracing plane; accept the
+                # bare (op, kw) frame from peers predating the ctx field
+                op, kw = frame[0], frame[1]
+                ctx = _flight.adopt_wire(frame[2] if len(frame) > 2 else None)
                 try:
-                    reply = (_OK, self.handler(op, kw))
+                    with _flight.use_context(ctx):
+                        reply = (_OK, self.handler(op, kw),
+                                 _flight.wire_context())
                 except BaseException as e:  # noqa: BLE001 — RPC boundary
                     try:
                         pickle.dumps(e)
                     except Exception:
                         e = RuntimeError(f"unpicklable remote error: {e!r}")
-                    reply = (_ERR, e)
+                    reply = (_ERR, e, None)
                 try:
                     _send_frame(conn, reply)
                 except OSError:
@@ -222,11 +239,15 @@ class RpcClient:
                 return
         sock.close()
 
-    def call(self, op, deadline=None, **kw):
+    def call(self, op, deadline=None, ctx=None, **kw):
         """One round trip: returns the handler's value or re-raises its
         exception.  ``deadline`` bounds the whole call socket-side (the
         server adds no deadline of its own); it is a separate parameter so
-        ops are free to take a ``timeout`` kwarg of their own."""
+        ops are free to take a ``timeout`` kwarg of their own.  ``ctx`` is
+        the trace context to thread through the frame — pass
+        :func:`~paddle_tpu.observability.flight.wire_context`'s tuple for a
+        request-scoped call, or an explicit None for control-plane traffic
+        (graftlint AT103 flags call sites that silently drop it)."""
         sock = self._checkout()
         try:
             sock.settimeout(self.call_timeout if deadline is None
@@ -234,19 +255,22 @@ class RpcClient:
             if _faults.FAULTS.active:
                 _faults.FAULTS.raise_if("rpc.send", op=op)
             try:
-                _send_frame(sock, (op, kw))
+                _send_frame(sock, (op, kw, ctx))
             except OSError as e:
                 raise RpcError(f"rpc send failed ({op}): {e}") from e
             if _faults.FAULTS.active:
                 _faults.FAULTS.raise_if("rpc.recv", op=op)
             try:
-                status, value = _recv_frame(sock)
+                reply = _recv_frame(sock)
             except (OSError, EOFError, pickle.UnpicklingError) as e:
                 raise RpcError(f"rpc recv failed ({op}): {e}") from e
         except BaseException:
             sock.close()
             raise
         self._checkin(sock)
+        status, value = reply[0], reply[1]
+        if len(reply) > 2 and reply[2] is not None:
+            _flight.adopt_wire(reply[2])   # fold the server's clock back in
         if status == _ERR:
             raise value
         return value

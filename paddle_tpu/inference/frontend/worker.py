@@ -17,7 +17,11 @@ one process with an injected clock, and the bench does the same to measure
 degradation without TPU-sized process images.
 
 RPC ops: ``submit poll cancel status result request_error ttft tpot load
-health metrics prefix_keys pull_pages push_pages ping``.  ``pull_pages`` /
+health metrics metrics_snapshot trace_events prefix_keys pull_pages
+push_pages ping``.  ``metrics_snapshot`` returns the worker process's FULL
+metrics-registry snapshot (every family, not just the engine counters) for
+gateway-side federation, and ``trace_events`` returns the flight recorder's
+picklable span events — the pull half of fleet-wide request tracing.  ``pull_pages`` /
 ``push_pages`` are the peer KV tier's transfer halves: a gateway pulls a
 serialized page-chain block out of the replica that holds it and pushes it
 into the replica it routed to.  ``submit`` while draining raises
@@ -37,7 +41,9 @@ import signal
 import threading
 import time
 
+from ... import observability as _obs
 from ...distributed.membership import MembershipService
+from ...observability import flight as _flight
 from .admission import ShedError
 from .disagg import PrefillHandoffBuffer
 from .replica import EngineReplica
@@ -121,6 +127,9 @@ class WorkerServer:
 
     # ---- RPC dispatch --------------------------------------------------------
     def _handle(self, op, kw):
+        # RPC connection threads vary per call: label each so worker-side
+        # span events (queued, routed-to-us submits) name this worker
+        _flight.set_proc_label(f"worker:{self.name}")
         rep = self.replica
         if op == "submit":
             if self.draining:
@@ -151,6 +160,13 @@ class WorkerServer:
             return h
         if op == "metrics":
             return rep.metrics()
+        if op == "metrics_snapshot":
+            # the WHOLE process registry (engine + frontend + durable-plane
+            # families), not just the engine's counters: the gateway merges
+            # this under a replica= label for the federated /metrics page
+            return _obs.REGISTRY.snapshot()
+        if op == "trace_events":
+            return _flight.snapshot_events(kw.get("trace_id"))
         if op == "prefix_keys":
             return rep.prefix_keys()
         if op == "pull_pages":

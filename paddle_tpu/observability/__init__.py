@@ -30,16 +30,17 @@ modules share names and label schemas instead of inventing their own.
 """
 from __future__ import annotations
 
+from . import flight  # noqa: F401  (request tracing / flight recorder)
 from . import registry as _registry
 from .registry import (DEFAULT_BUCKETS, REGISTRY, MetricsRegistry,  # noqa: F401
-                       enabled)
+                       enabled, merge_snapshots, render_snapshot)
 from .tracing import SPAN_SECONDS, trace_span  # noqa: F401
 
 __all__ = [
     "MetricsRegistry", "REGISTRY", "DEFAULT_BUCKETS",
     "enable", "disable", "enabled", "reset",
-    "snapshot", "render_prometheus", "trace_span", "record_collective",
-    "start_metrics_server",
+    "snapshot", "render_prometheus", "render_snapshot", "merge_snapshots",
+    "trace_span", "record_collective", "start_metrics_server", "flight",
 ]
 
 
@@ -233,6 +234,12 @@ FRONTEND_PEER_PULLS = REGISTRY.counter(
     "peer-replica KV page pulls before prefill, by outcome "
     "(ok: pages spliced; miss: holder no longer had the chain; "
     "failed: RPC/fault — recompute fallback)", ("outcome",))
+
+# metrics federation (gateway /metrics scraping live fleet members)
+FRONTEND_FEDERATION_ERRORS = REGISTRY.counter(
+    "frontend_federation_errors_total",
+    "fleet members whose metrics/trace scrape failed and were skipped "
+    "(dead, wedged past the scrape deadline, or mid-crash)", ("replica",))
 
 # durable request plane (inference/frontend/journal.py + gateway)
 JOURNAL_APPEND_SECONDS = REGISTRY.histogram(

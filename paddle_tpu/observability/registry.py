@@ -30,7 +30,8 @@ import bisect
 import re
 import threading
 
-__all__ = ["MetricsRegistry", "REGISTRY", "enabled", "DEFAULT_BUCKETS"]
+__all__ = ["MetricsRegistry", "REGISTRY", "enabled", "DEFAULT_BUCKETS",
+           "render_snapshot", "merge_snapshots"]
 
 _ENABLED = False
 
@@ -58,6 +59,11 @@ DEFAULT_BUCKETS = (
 
 def _escape_label(v: str) -> str:
     return v.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _escape_help(v: str) -> str:
+    # HELP text escapes only backslash and newline (0.0.4 exposition spec)
+    return v.replace("\\", r"\\").replace("\n", r"\n")
 
 
 def _fmt(v) -> str:
@@ -296,28 +302,7 @@ class MetricsRegistry:
 
     def render_prometheus(self) -> str:
         """Prometheus text exposition (version 0.0.4) of every family."""
-        lines = []
-        for name, snap in self.snapshot().items():
-            if snap["help"]:
-                lines.append(f"# HELP {name} {snap['help']}")
-            lines.append(f"# TYPE {name} {snap['type']}")
-            for s in snap["series"]:
-                lbl = ",".join(f'{k}="{_escape_label(v)}"'
-                               for k, v in s["labels"].items())
-                if snap["type"] == "histogram":
-                    acc = 0
-                    for le, n in s["buckets"].items():
-                        acc += n
-                        sep = "," if lbl else ""
-                        lines.append(
-                            f'{name}_bucket{{{lbl}{sep}le="{le}"}} {acc}')
-                    brace = f"{{{lbl}}}" if lbl else ""
-                    lines.append(f"{name}_sum{brace} {_fmt(s['sum'])}")
-                    lines.append(f"{name}_count{brace} {s['count']}")
-                else:
-                    brace = f"{{{lbl}}}" if lbl else ""
-                    lines.append(f"{name}{brace} {_fmt(s['value'])}")
-        return "\n".join(lines) + ("\n" if lines else "")
+        return render_snapshot(self.snapshot())
 
     def reset(self):
         """Zero every series in place (live children stay bound)."""
@@ -325,6 +310,66 @@ class MetricsRegistry:
             fams = list(self._families.values())
         for fam in fams:
             fam._reset()
+
+
+def render_snapshot(snap: dict) -> str:
+    """Prometheus text exposition (version 0.0.4) of a snapshot dict — the
+    single render path for both a live registry and federated (merged)
+    remote snapshots, so escaping and histogram framing can't drift."""
+    lines = []
+    for name, fam in snap.items():
+        if fam["help"]:
+            lines.append(f"# HELP {name} {_escape_help(fam['help'])}")
+        lines.append(f"# TYPE {name} {fam['type']}")
+        for s in fam["series"]:
+            lbl = ",".join(f'{k}="{_escape_label(v)}"'
+                           for k, v in s["labels"].items())
+            if fam["type"] == "histogram":
+                acc = 0
+                for le, n in s["buckets"].items():
+                    acc += n
+                    sep = "," if lbl else ""
+                    lines.append(
+                        f'{name}_bucket{{{lbl}{sep}le="{le}"}} {acc}')
+                brace = f"{{{lbl}}}" if lbl else ""
+                lines.append(f"{name}_sum{brace} {_fmt(s['sum'])}")
+                lines.append(f"{name}_count{brace} {s['count']}")
+            else:
+                brace = f"{{{lbl}}}" if lbl else ""
+                lines.append(f"{name}{brace} {_fmt(s['value'])}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def merge_snapshots(local: dict, remotes: dict) -> dict:
+    """Fold remote registry snapshots into one exposition-ready dict for
+    the gateway's federated ``/metrics``.
+
+    ``remotes`` maps a replica name to that worker's full ``snapshot()``.
+    Remote series are relabeled with ``replica=<name>`` — unless the series
+    already carries a ``replica`` label (the front-door families do), so a
+    worker's own attribution is never overwritten.  Local series pass
+    through untouched.  A remote family whose type conflicts with an
+    already-merged one is skipped (first writer wins) rather than emitting
+    an exposition the scraper would reject.
+    """
+    out = {}
+    for name, fam in local.items():
+        out[name] = {"type": fam["type"], "help": fam["help"],
+                     "series": list(fam["series"])}
+    for replica, snap in sorted(remotes.items()):
+        for name, fam in snap.items():
+            dst = out.get(name)
+            if dst is None:
+                dst = out[name] = {"type": fam["type"], "help": fam["help"],
+                                   "series": []}
+            elif dst["type"] != fam["type"]:
+                continue
+            for s in fam["series"]:
+                labels = dict(s["labels"])
+                if "replica" not in labels:
+                    labels["replica"] = str(replica)
+                dst["series"].append({**s, "labels": labels})
+    return out
 
 
 REGISTRY = MetricsRegistry()

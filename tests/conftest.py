@@ -74,6 +74,41 @@ def pytest_configure(config):
         "slow: long-running tests excluded from the tier-1 gate (-m 'not slow')")
 
 
+# ------------------------------------------------- chaos post-mortem capture
+
+def _dump_chaos_artifacts(nodeid):
+    """When ``PADDLE_TPU_CHAOS_ARTIFACTS`` names a directory, drop a metrics
+    registry snapshot plus every pinned flight-recorder trace there — the
+    evidence a red chaos-matrix leg needs for a post-mortem without a rerun.
+    CI uploads the directory on failure; unset (the default) this is free."""
+    d = os.environ.get("PADDLE_TPU_CHAOS_ARTIFACTS")
+    if not d:
+        return
+    import json
+
+    from paddle_tpu import observability as obs
+    try:
+        os.makedirs(d, exist_ok=True)
+        safe = "".join(c if c.isalnum() or c in "._-" else "_"
+                       for c in nodeid)[-120:]
+        with open(os.path.join(d, f"metrics-{safe}.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(obs.snapshot(), f, indent=1, sort_keys=True)
+    except Exception:
+        pass                    # capture must never mask the real failure
+    for tid, reason in obs.flight.pinned().items():
+        try:
+            obs.flight.dump_trace(tid, obs.flight.events_for(tid),
+                                  reason=reason, out_dir=d)
+        except OSError:
+            pass
+
+
+def pytest_runtest_logreport(report):
+    if report.failed:
+        _dump_chaos_artifacts(report.nodeid)
+
+
 def _live_children():
     """pid -> state for direct children of this process (via /proc)."""
     me = os.getpid()

@@ -309,6 +309,46 @@ def test_no_adhoc_telemetry_clean_idioms_not_flagged(tmp_path):
     assert res.findings == []
 
 
+AT103_BAD = """
+    class Tier:
+        def submit(self, prompt):
+            return self.client.call("submit", prompt_ids=prompt)
+
+    def pull(rpc, rid):
+        return rpc.call("handoff_pull", rid=rid)
+
+    def scrape(metrics_client, deadline):
+        return metrics_client.call("metrics_snapshot", deadline=deadline)
+"""
+
+AT103_CLEAN = """
+    def traced(self, prompt, ctx):
+        return self.client.call("submit", ctx=ctx, prompt_ids=prompt)
+
+    def control_plane(self):
+        return self.client.call("ping", ctx=None)   # explicit: untraced
+
+    def not_rpc(self):
+        return self._exported.call(self._params)    # jit export, not RPC
+
+    def also_not_rpc(callback):
+        return callback.call()                       # no client-ish name
+"""
+
+
+def test_no_adhoc_telemetry_at103_ctx_dropped(tmp_path):
+    res = _lint(tmp_path, AT103_BAD, select=["no-adhoc-telemetry"])
+    assert _codes(res) == {"AT103"}
+    # all three client-like receivers: self.client, bare rpc, *_client
+    assert len(res.findings) == 3
+    assert all("trace context" in f.message for f in res.findings)
+
+
+def test_no_adhoc_telemetry_at103_clean_idioms(tmp_path):
+    res = _lint(tmp_path, AT103_CLEAN, select=["no-adhoc-telemetry"])
+    assert res.findings == []
+
+
 def test_no_adhoc_telemetry_line_pragma(tmp_path):
     src = """
         import time

@@ -615,8 +615,10 @@ class TestGatewayHTTP:
         gw, _ = served
         with urllib.request.urlopen(gw.url + "/healthz", timeout=30.0) as r:
             health = json.loads(r.read().decode())
-        assert set(health) == {"r0", "r1"}
-        assert all(h["alive"] for h in health.values())
+        assert set(health) == {"r0", "r1", "fleet"}
+        assert health["fleet"]["alive"] == 2
+        assert all(h["alive"] for name, h in health.items()
+                   if name != "fleet")
         with urllib.request.urlopen(gw.url + "/metrics", timeout=30.0) as r:
             text = r.read().decode()
         assert "frontend_requests_total" in text

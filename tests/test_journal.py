@@ -618,7 +618,7 @@ class TestGatewayCrashRecovery:
             assert h["journal"]["depth"] == 0
             assert h["journal"]["recovering"] is False
             assert "segments" in h["journal"]
-            assert set(h) == {"r0", "r1", "journal"}
+            assert set(h) == {"r0", "r1", "journal", "fleet"}
             # while recovery owns the fleet, submits shed with Retry-After
             gw.plane.recovering = True
             conn = http.client.HTTPConnection(gw.addr, gw.port, timeout=10)

@@ -182,6 +182,108 @@ class TestPrometheus:
         assert snap["test_round_total"]["series"][0]["value"] == 42.0
         assert 'test_round_total{k="x"} 42' in obs.render_prometheus()
 
+    def test_label_value_escaping(self, metrics):
+        # backslash, double quote and newline each escape per the 0.0.4 spec:
+        # \ -> \\   " -> \"   LF -> \n  (two characters, not a raw newline)
+        c = REGISTRY.counter("test_esc_total", "t", ("v",))
+        c.inc(v='back\\slash "quote"\nline2')
+        text = obs.render_prometheus()
+        _assert_valid_exposition(text)
+        line = next(l for l in text.splitlines()
+                    if l.startswith("test_esc_total{"))
+        assert line == 'test_esc_total{v="back\\\\slash \\"quote\\"\\nline2"} 1'
+
+    def test_help_text_escaping(self, metrics):
+        # HELP escapes only backslash and newline; a raw newline would split
+        # the comment and leave a line the scraper rejects
+        REGISTRY.counter("test_help_total", 'path C:\\tmp\nsecond line')
+        text = obs.render_prometheus()
+        _assert_valid_exposition(text)
+        help_line = next(l for l in text.splitlines()
+                         if l.startswith("# HELP test_help_total"))
+        assert help_line == ("# HELP test_help_total "
+                             "path C:\\\\tmp\\nsecond line")
+
+    def test_histogram_inf_sum_count_framing(self, metrics):
+        h = REGISTRY.histogram("test_frame_seconds", "h", buckets=(0.1, 1.0),
+                               labelnames=("op",))
+        h.observe(0.1, op="a")          # boundary lands IN the 0.1 bucket
+        h.observe(7.0, op="a")          # beyond the last bound -> +Inf only
+        text = obs.render_prometheus()
+        typed, samples = _assert_valid_exposition(text)
+        assert typed["test_frame_seconds"] == "histogram"
+        frame = [l for l in samples if l.startswith("test_frame_seconds")]
+        # exactly the spec framing: every bound plus +Inf, then _sum, _count
+        assert [l.split(" ")[0] for l in frame] == [
+            'test_frame_seconds_bucket{op="a",le="0.1"}',
+            'test_frame_seconds_bucket{op="a",le="1"}',
+            'test_frame_seconds_bucket{op="a",le="+Inf"}',
+            'test_frame_seconds_sum{op="a"}',
+            'test_frame_seconds_count{op="a"}',
+        ]
+        counts = {l.split(" ")[0]: l.rsplit(" ", 1)[1] for l in frame}
+        assert counts['test_frame_seconds_bucket{op="a",le="0.1"}'] == "1"
+        assert counts['test_frame_seconds_bucket{op="a",le="+Inf"}'] == "2"
+        assert counts['test_frame_seconds_count{op="a"}'] == "2"
+        assert float(counts['test_frame_seconds_sum{op="a"}']) == 7.1
+
+
+# ------------------------------------------------- federated snapshot merging
+
+class TestFederation:
+    def _remote(self, value=3.0, labels=None, type="counter"):
+        return {"test_fed_total": {
+            "type": type, "help": "t",
+            "series": [{"labels": dict(labels or {"op": "x"}),
+                        "value": value}]}}
+
+    def test_merge_relabels_remote_series(self, metrics):
+        c = REGISTRY.counter("test_fed_total", "t", ("op",))
+        c.inc(op="x")
+        merged = obs.merge_snapshots(obs.snapshot(prefix="test_fed"),
+                                     {"w0": self._remote(3.0)})
+        series = merged["test_fed_total"]["series"]
+        # local series untouched, remote series gains replica=<name>
+        assert {"labels": {"op": "x"}, "value": 1.0} in series
+        assert {"labels": {"op": "x", "replica": "w0"}, "value": 3.0} in series
+        text = obs.render_snapshot(merged)
+        _assert_valid_exposition(text)
+        assert 'test_fed_total{op="x",replica="w0"} 3' in text
+
+    def test_merge_keeps_existing_replica_label(self, metrics):
+        # front-door families already attribute a replica; federation must
+        # not overwrite the worker's own attribution
+        merged = obs.merge_snapshots(
+            {}, {"w0": self._remote(2.0, {"op": "x", "replica": "inner"})})
+        assert merged["test_fed_total"]["series"] == [
+            {"labels": {"op": "x", "replica": "inner"}, "value": 2.0}]
+
+    def test_merge_skips_type_conflicts(self, metrics):
+        c = REGISTRY.counter("test_fed_total", "t", ("op",))
+        c.inc(op="x")
+        merged = obs.merge_snapshots(
+            obs.snapshot(prefix="test_fed"),
+            {"w0": self._remote(9.0, type="gauge"),
+             "w1": self._remote(5.0)})
+        # w0's gauge family conflicts with the local counter and is dropped;
+        # w1's matching counter merges — and the result still renders clean
+        values = {s["labels"].get("replica"): s["value"]
+                  for s in merged["test_fed_total"]["series"]}
+        assert values == {None: 1.0, "w1": 5.0}
+        _assert_valid_exposition(obs.render_snapshot(merged))
+
+    def test_merge_of_disjoint_remote_histogram(self, metrics):
+        snap = {"test_fedh_seconds": {
+            "type": "histogram", "help": "h",
+            "series": [{"labels": {}, "buckets": {"0.1": 1, "+Inf": 1},
+                        "sum": 2.5, "count": 2}]}}
+        merged = obs.merge_snapshots({}, {"w0": snap})
+        text = obs.render_snapshot(merged)
+        typed, samples = _assert_valid_exposition(text)
+        assert typed["test_fedh_seconds"] == "histogram"
+        assert ('test_fedh_seconds_bucket{replica="w0",le="+Inf"} 2'
+                in samples)
+
 
 # ------------------------------------------------------ pull endpoint (HTTP)
 
