@@ -40,7 +40,6 @@ from __future__ import annotations
 import threading
 import time
 
-from ... import observability as _obs
 from ...core.retry import RetryPolicy
 from ...distributed.membership import EXPIRE, JOIN, MembershipService
 from ...observability import flight as _flight
@@ -256,27 +255,18 @@ class FleetReplicaSet(ReplicaSet):
         self.remove_replica(member.name)
 
     # ---- fleet observability -------------------------------------------------
-    def federated_snapshot(self, deadline=1.0):
-        """Extend the base scrape with the disaggregation prefill tiers:
-        they are leased members with registries of their own, just not
-        serving replicas, so routing skips them but federation must not."""
-        remotes = super().federated_snapshot(deadline)
+    def _federation_members(self, attr):
+        """Extend the base scrape set with the disaggregation prefill
+        tiers: they are leased members with registries of their own, just
+        not serving replicas, so routing skips them but federation must
+        not.  They ride the base class's concurrent scrape and share its
+        failure semantics."""
+        members = super()._federation_members(attr)
         for name, tier in list(self.prefill_tiers.items()):
-            try:
-                remotes[name] = tier.metrics_snapshot(deadline=deadline)
-            except Exception:  # noqa: BLE001 — scrape must never wedge
-                _obs.FRONTEND_FEDERATION_ERRORS.inc(replica=name)
-        return remotes
-
-    def trace_events_fleet(self, trace_id, deadline=1.0):
-        batches = [super().trace_events_fleet(trace_id, deadline)]
-        for name, tier in list(self.prefill_tiers.items()):
-            try:
-                batches.append(tier.trace_events(trace_id,
-                                                 deadline=deadline))
-            except Exception:  # noqa: BLE001 — scrape must never wedge
-                _obs.FRONTEND_FEDERATION_ERRORS.inc(replica=name)
-        return _flight.merge_events(*batches)
+            fn = getattr(tier, attr, None)
+            if fn is not None:
+                members.append((name, fn))
+        return members
 
     # ---- lifecycle -----------------------------------------------------------
     def start_sync(self, interval=0.2):

@@ -124,6 +124,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ---- GET -----------------------------------------------------------------
     def do_GET(self):  # noqa: N802 (stdlib handler API)
+        # instance state persists across requests on a keep-alive socket:
+        # clear the id so a GET never echoes the previous POST's header
+        self.request_id = None
         path = self.path.split("?")[0]
         if path == "/healthz":
             health = dict(self.replica_set.health())
@@ -192,6 +195,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ---- POST /v1/completions ------------------------------------------------
     def do_POST(self):  # noqa: N802 (stdlib handler API)
+        # cleared before parsing: a 400/404 on this request must not carry
+        # the prior keep-alive request's X-Request-ID
+        self.request_id = None
         if self.path.split("?")[0] != "/v1/completions":
             self._send_json(404, {"error": f"no route for {self.path}"})
             return

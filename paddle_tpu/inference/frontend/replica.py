@@ -32,8 +32,10 @@ a partially-streamed request may end FAILED).
 """
 from __future__ import annotations
 
+import functools
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor, as_completed
 
 from ... import observability as _obs
 from ...observability import flight as _flight
@@ -897,28 +899,57 @@ class ReplicaSet:
         return {r.name: r.metrics() for r in self.replicas}
 
     # ---- fleet observability -------------------------------------------------
-    def federated_snapshot(self, deadline=1.0):
-        """Full registry snapshots of every live member that runs in its
-        OWN process (remote workers), keyed by replica name — the scrape
-        half of metrics federation.  In-process replicas share this
-        process's registry, so they contribute through the local snapshot
-        and are skipped here.  A dead member, or one that can't answer
-        within ``deadline`` seconds, is skipped with
+    def _federation_members(self, attr):
+        """``(name, bound scrape method)`` for every live member that runs
+        in its OWN process and exposes ``attr`` (in-process replicas share
+        this registry/recorder and contribute through the local snapshot).
+        Members already known dead are skipped WITHOUT touching the error
+        counter — their failure was counted once, when it was detected, and
+        re-counting per /metrics scrape would turn the counter's rate into
+        a dead-member clock — tallied instead in the
+        ``frontend_federation_skipped`` gauge."""
+        members, skipped = [], 0
+        for rep in list(self.replicas):
+            fn = getattr(rep, attr, None)
+            if fn is None:
+                continue  # in-process: already in the local snapshot
+            if not getattr(rep, "alive", True):
+                skipped += 1
+                continue
+            members.append((rep.name, fn))
+        _obs.FRONTEND_FEDERATION_SKIPPED.set(skipped)
+        return members
+
+    @staticmethod
+    def _scrape_fleet(jobs):
+        """Run per-member scrape thunks CONCURRENTLY so the page's worst
+        case is ~one deadline, not one deadline per member, and return
+        {name: result} for the members that answered.  A thunk that raises
+        (dead mid-scrape, wedged past its deadline) is dropped with
         ``frontend_federation_errors_total{replica=}`` incremented — a
         half-dead worker must never wedge the /metrics page."""
-        remotes = {}
-        for rep in list(self.replicas):
-            fn = getattr(rep, "metrics_snapshot", None)
-            if fn is None:
-                continue  # in-process: already in the local registry
-            if not getattr(rep, "alive", True):
-                _obs.FRONTEND_FEDERATION_ERRORS.inc(replica=rep.name)
-                continue
-            try:
-                remotes[rep.name] = fn(deadline=deadline)
-            except Exception:  # noqa: BLE001 — scrape must never wedge
-                _obs.FRONTEND_FEDERATION_ERRORS.inc(replica=rep.name)
-        return remotes
+        if not jobs:
+            return {}
+        results = {}
+        with ThreadPoolExecutor(max_workers=min(16, len(jobs)),
+                                thread_name_prefix="fed-scrape") as pool:
+            futures = {pool.submit(fn): name for name, fn in jobs.items()}
+            for fut in as_completed(futures):
+                name = futures[fut]
+                try:
+                    results[name] = fut.result()
+                except Exception:  # noqa: BLE001 — scrape must never wedge
+                    _obs.FRONTEND_FEDERATION_ERRORS.inc(replica=name)
+        return results
+
+    def federated_snapshot(self, deadline=1.0):
+        """Full registry snapshots of every live own-process member (remote
+        workers), keyed by replica name — the scrape half of metrics
+        federation.  Dead-member and failure semantics per
+        :meth:`_federation_members` / :meth:`_scrape_fleet`."""
+        return self._scrape_fleet({
+            name: functools.partial(fn, deadline=deadline)
+            for name, fn in self._federation_members("metrics_snapshot")})
 
     def metrics_exposition(self, deadline=1.0):
         """One Prometheus page for the WHOLE fleet: this process's registry
@@ -935,18 +966,10 @@ class ReplicaSet:
         """Every span event recorded for ``trace_id`` anywhere in the
         fleet — this process's flight recorder plus each live remote
         member's — merged, deduplicated, and causally ordered.  Dead or
-        unresponsive members are skipped (same error counter as the
-        metrics scrape)."""
-        batches = [_flight.snapshot_events(trace_id)]
-        for rep in list(self.replicas):
-            fn = getattr(rep, "trace_events", None)
-            if fn is None:
-                continue  # in-process: shares this recorder
-            if not getattr(rep, "alive", True):
-                _obs.FRONTEND_FEDERATION_ERRORS.inc(replica=rep.name)
-                continue
-            try:
-                batches.append(fn(trace_id, deadline=deadline))
-            except Exception:  # noqa: BLE001 — scrape must never wedge
-                _obs.FRONTEND_FEDERATION_ERRORS.inc(replica=rep.name)
-        return _flight.merge_events(*batches)
+        unresponsive members are skipped (same semantics as the metrics
+        scrape)."""
+        pulled = self._scrape_fleet({
+            name: functools.partial(fn, trace_id, deadline=deadline)
+            for name, fn in self._federation_members("trace_events")})
+        return _flight.merge_events(_flight.snapshot_events(trace_id),
+                                    *pulled.values())

@@ -238,8 +238,14 @@ FRONTEND_PEER_PULLS = REGISTRY.counter(
 # metrics federation (gateway /metrics scraping live fleet members)
 FRONTEND_FEDERATION_ERRORS = REGISTRY.counter(
     "frontend_federation_errors_total",
-    "fleet members whose metrics/trace scrape failed and were skipped "
-    "(dead, wedged past the scrape deadline, or mid-crash)", ("replica",))
+    "fleet members whose metrics/trace scrape FAILED (wedged past the "
+    "scrape deadline, or died mid-scrape); members already known dead are "
+    "not re-counted per scrape", ("replica",))
+FRONTEND_FEDERATION_SKIPPED = REGISTRY.gauge(
+    "frontend_federation_skipped",
+    "fleet members skipped on the last federation scrape because they "
+    "were already known dead (their failure was counted once, when "
+    "detected)")
 
 # durable request plane (inference/frontend/journal.py + gateway)
 JOURNAL_APPEND_SECONDS = REGISTRY.histogram(

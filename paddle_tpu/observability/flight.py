@@ -38,8 +38,10 @@ Events are plain dicts so a worker can ship them over the RPC plane
 from __future__ import annotations
 
 import contextvars
+import hashlib
 import json
 import os
+import re
 import threading
 import time
 import uuid
@@ -105,10 +107,29 @@ _proc_label: contextvars.ContextVar = contextvars.ContextVar(
     "paddle_tpu_trace_proc", default=None)
 
 
+# Trace ids become dump filenames (``trace-<id>.json`` under the dump dir),
+# and the gateway adopts the client-supplied X-Request-ID as the id — so a
+# hostile header must never smuggle path syntax into os.replace.
+_SAFE_ID = re.compile(r"[A-Za-z0-9._-]{1,120}")
+
+
+def _safe_trace_id(trace_id) -> str:
+    """Allowlisted id verbatim; anything else (path separators, overlong,
+    control bytes) is replaced by a stable hash of itself, so a hostile
+    client still gets a usable — and still collision-resistant — trace id."""
+    tid = str(trace_id)
+    if _SAFE_ID.fullmatch(tid):
+        return tid
+    digest = hashlib.sha256(tid.encode("utf-8", "surrogatepass")).hexdigest()
+    return f"h{digest[:16]}"
+
+
 def mint(trace_id=None) -> TraceContext:
-    """New context: adopt the caller-supplied id (``X-Request-ID``) or mint
-    a fresh one."""
-    return TraceContext(trace_id or uuid.uuid4().hex[:16], _tick())
+    """New context: adopt the caller-supplied id (``X-Request-ID``),
+    sanitized for filesystem safety, or mint a fresh one."""
+    if not trace_id:
+        return TraceContext(uuid.uuid4().hex[:16], _tick())
+    return TraceContext(_safe_trace_id(trace_id), _tick())
 
 
 def current():
@@ -167,6 +188,7 @@ _DEFAULT_RING = 4096
 _ring_lock = threading.Lock()
 _ring: deque = deque(maxlen=_DEFAULT_RING)
 _pinned: dict = {}            # trace_id -> {"reason", "events": [...]}
+_PINNED_MAX = 256             # oldest pin evicted past this (anomaly churn)
 _rid_to_trace: dict = {}      # rid -> trace_id (bounded, insertion order)
 _RID_MAP_MAX = 4096
 _dump_dir = None              # configure() override; else env var
@@ -291,6 +313,12 @@ def pin(trace_id, reason) -> bool:
     record("pinned", trace_id=trace_id, reason=str(reason))
     events = events_for(trace_id)
     with _ring_lock:
+        # bounded like _rid_to_trace: replica churn pins every resumed
+        # request, and a long-lived process must not leak anomaly captures —
+        # past the cap the oldest pin falls out (its dump file, if any,
+        # already made it to disk)
+        if trace_id not in _pinned and len(_pinned) >= _PINNED_MAX:
+            _pinned.pop(next(iter(_pinned)))
         _pinned[trace_id] = {"reason": str(reason), "events": events}
     d = _dump_dir or os.environ.get("PADDLE_TPU_TRACE_DUMP_DIR")
     if d:
@@ -314,6 +342,10 @@ def dump_trace(trace_id, events, reason=None, out_dir=None) -> str:
     d = out_dir or _dump_dir or os.environ.get("PADDLE_TPU_TRACE_DUMP_DIR")
     if not d:
         raise OSError("no trace dump directory configured")
+    # mint() sanitizes every adopted id, but this is the write site: refuse
+    # any id that could escape the dump dir rather than trust every caller
+    if not _SAFE_ID.fullmatch(str(trace_id)):
+        raise OSError(f"unsafe trace id for dump: {str(trace_id)!r}")
     os.makedirs(d, exist_ok=True)
     doc = chrome_trace(events)
     if reason is not None:

@@ -98,6 +98,20 @@ class TestTraceContext:
         clone = pickle.loads(pickle.dumps(ctx))
         assert (clone.trace_id, clone.clock) == (ctx.trace_id, ctx.clock)
 
+    def test_hostile_client_id_is_sanitized(self):
+        # the gateway adopts X-Request-ID verbatim as the trace id, and
+        # trace ids become dump FILENAMES: path syntax must never survive
+        evil = "../../etc/cron.d/evil"
+        tid = flight.mint(evil).trace_id
+        assert "/" not in tid and "\\" not in tid and ".." not in tid
+        # hashing is stable, so retries of the same hostile id correlate
+        assert flight.mint(evil).trace_id == tid
+        # distinct hostile ids stay distinct
+        assert flight.mint("../../other").trace_id != tid
+        # conforming ids pass through untouched; overlong ones are hashed
+        assert flight.mint("req_A.1-b").trace_id == "req_A.1-b"
+        assert flight.mint("x" * 200).trace_id != "x" * 200
+
 
 # ----------------------------------------------------------- flight recorder
 
@@ -144,6 +158,40 @@ class TestFlightRecorder:
     def test_pin_unknown_rid_is_false(self, recorder):
         assert not flight.pin_rid(999999, "whatever")
         assert flight.pinned() == {}
+
+    def test_pinned_store_is_bounded(self, recorder):
+        # replica churn pins every resumed request: the anomaly store must
+        # evict like the ring does, not grow for the life of the process
+        last = flight._PINNED_MAX + 9
+        for i in range(last + 1):
+            flight.record("queued", trace_id=f"anom{i}", rid=i)
+            assert flight.pin(f"anom{i}", "stuck_step")
+        pins = flight.pinned()
+        assert len(pins) == flight._PINNED_MAX
+        assert "anom0" not in pins             # oldest pins fell out
+        assert f"anom{last}" in pins
+        # re-pinning a resident trace updates in place — no eviction
+        assert flight.pin(f"anom{last}", "again")
+        assert len(flight.pinned()) == flight._PINNED_MAX
+        assert flight.pinned()[f"anom{last}"] == "again"
+
+    def test_hostile_pin_cannot_escape_dump_dir(self, recorder, tmp_path,
+                                                monkeypatch):
+        dumps = tmp_path / "dumps"
+        monkeypatch.setenv("PADDLE_TPU_TRACE_DUMP_DIR", str(dumps))
+        ctx = flight.mint("../../escape")      # hostile X-Request-ID shape
+        with flight.use_context(ctx):
+            flight.record("queued", rid=1)
+        assert flight.pin(ctx.trace_id, "quarantine")
+        # the dump landed INSIDE the configured dir, nowhere else
+        assert sorted(p.name for p in dumps.iterdir()) == [
+            f"trace-{ctx.trace_id}.json"]
+        assert not (tmp_path / "escape").exists()
+        # defense in depth: the write site refuses a raw unsanitized id
+        with pytest.raises(OSError):
+            flight.dump_trace("../../escape", [], out_dir=str(dumps))
+        with pytest.raises(OSError):
+            flight.dump_trace("a/b", [], out_dir=str(dumps))
 
     def test_pin_dumps_valid_chrome_trace(self, recorder, tmp_path,
                                           monkeypatch):
@@ -374,6 +422,39 @@ class TestGatewayObservability:
         code, doc = _get(gw.url, f"/v1/requests/{rid}/trace")
         assert code == 200 and doc["traceEvents"]
 
+    def test_keepalive_never_echoes_a_stale_request_id(self, served):
+        """handler instances persist across requests on one HTTP/1.1
+        socket: a follow-up GET, or a POST that 400s before minting, must
+        not inherit the previous POST's X-Request-ID."""
+        import http.client
+        gw, _ = served
+        conn = http.client.HTTPConnection(gw.addr, gw.port, timeout=60)
+        try:
+            conn.request("POST", "/v1/completions",
+                         body=json.dumps({"prompt": [1, 2, 3],
+                                          "max_tokens": 2}).encode(),
+                         headers={"Content-Type": "application/json",
+                                  "X-Request-ID": "staleid01"})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert resp.getheader("X-Request-ID") == "staleid01"
+            resp.read()
+            # same socket: the health probe owns no request id
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert resp.getheader("X-Request-ID") is None
+            resp.read()
+            # same socket: a 400 before mint carries no id either
+            conn.request("POST", "/v1/completions", body=b"{}",
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 400
+            assert resp.getheader("X-Request-ID") is None
+            resp.read()
+        finally:
+            conn.close()
+
     def test_unknown_trace_is_404(self, served):
         gw, _ = served
         with pytest.raises(urllib.error.HTTPError) as ei:
@@ -434,6 +515,11 @@ class TestFleetFederation:
         fam = snap.get("frontend_federation_errors_total", {"series": []})
         return {s["labels"]["replica"]: s["value"] for s in fam["series"]}
 
+    def _skipped(self):
+        snap = obs.snapshot(prefix="frontend_federation_skipped")
+        fam = snap.get("frontend_federation_skipped", {"series": []})
+        return sum(s["value"] for s in fam["series"])
+
     def test_metrics_federate_and_survive_member_death(self, fleet):
         from paddle_tpu.inference.frontend import start_gateway
         from tests.test_observability import _assert_valid_exposition
@@ -464,6 +550,18 @@ class TestFleetFederation:
             assert self._errors().get("w1", 0) >= 1
             assert ('frontend_federation_errors_total{replica="w1"}'
                     in text)
+            # the failure marked w1 dead: further scrapes SKIP it without
+            # re-counting (the counter's rate must mean "new failures",
+            # not "a dead member still lingers in the set") — the skip
+            # shows up in the gauge instead
+            after_death = self._errors()["w1"]
+            for _ in range(2):
+                with urllib.request.urlopen(f"{gw.url}/metrics",
+                                            timeout=30) as r:
+                    text = r.read().decode()
+            assert self._errors()["w1"] == after_death
+            assert self._skipped() == 1
+            assert "frontend_federation_skipped 1" in text
         finally:
             gw.close()
 
