@@ -4,6 +4,14 @@ Keyed on (absolute path, content sha1, pass name, pass version): re-linting an
 unchanged tree is pure cache replay.  Project-scope passes (registry-parity,
 namespace-parity) are never cached — they depend on cross-file state.
 
+Summary-scope passes (contracts) ARE cached, two ways at once: each file
+carries a ``summary`` slot (its extracted interprocedural summary, keyed on
+content sha + summary schema) and each of the pass's finding records carries
+a ``deps`` dict — the per-domain digests of every file contributing facts the
+pass consulted.  A hit requires the deps to match the digests of the
+*current* tree, so editing ``rpc.py`` invalidates its summary dependents'
+entries while edits to fact-free files replay everything else from cache.
+
 Location: ``$GRAFTLINT_CACHE`` if set, else
 ``~/.cache/graftlint/cache.json``.  The file is best-effort: unreadable or
 corrupt caches are ignored, and write failures never fail the lint run.
@@ -16,7 +24,7 @@ import os
 
 from .framework import Finding
 
-_SCHEMA = 3    # v3: concurrency pass + per-pass rule-ID listings
+_SCHEMA = 4    # v4: interprocedural summary slots + dep-keyed pass entries
 
 
 def default_cache_path():
@@ -48,24 +56,56 @@ class FileCache:
             self._sha[src.path] = sha
         return sha
 
-    def get(self, src, pass_obj) -> list[Finding] | None:
-        entry = self._data.get(os.path.abspath(src.path))
-        if not entry or entry.get("sha") != self._digest(src):
+    def _entry(self, src, create=False):
+        key = os.path.abspath(src.path)
+        entry = self._data.get(key)
+        sha = self._digest(src)
+        if entry is not None and entry.get("sha") == sha:
+            return entry
+        if not create:
+            return None
+        entry = self._data[key] = {"sha": sha, "passes": {}}
+        return entry
+
+    def get(self, src, pass_obj, deps: dict | None = None) \
+            -> list[Finding] | None:
+        """Cached findings for ``(src, pass)``; ``deps`` (summary-scope
+        passes) must equal the record's stored dep digests — a changed
+        cross-file fact domain is a miss even though ``src`` is unchanged."""
+        entry = self._entry(src)
+        if entry is None:
             return None
         rec = entry.get("passes", {}).get(pass_obj.name)
         if not rec or rec.get("version") != pass_obj.version:
             return None
+        if rec.get("deps") != deps:
+            return None
         return [Finding.from_dict(d) for d in rec.get("findings", [])]
 
-    def put(self, src, pass_obj, findings: list[Finding]):
-        key = os.path.abspath(src.path)
-        entry = self._data.get(key)
-        sha = self._digest(src)
-        if not entry or entry.get("sha") != sha:
-            entry = self._data[key] = {"sha": sha, "passes": {}}
-        entry["passes"][pass_obj.name] = {
-            "version": pass_obj.version,
-            "findings": [f.to_dict() for f in findings]}
+    def put(self, src, pass_obj, findings: list[Finding],
+            deps: dict | None = None):
+        rec = {"version": pass_obj.version,
+               "findings": [f.to_dict() for f in findings]}
+        if deps is not None:
+            rec["deps"] = deps
+        self._entry(src, create=True)["passes"][pass_obj.name] = rec
+        self._dirty = True
+
+    # ---- interprocedural summary slots --------------------------------------
+    def get_summary(self, src) -> dict | None:
+        from .summaries import SUMMARY_SCHEMA
+        entry = self._entry(src)
+        if entry is None:
+            return None
+        slot = entry.get("summary")
+        if not slot or slot.get("schema") != SUMMARY_SCHEMA:
+            return None
+        return slot.get("data")
+
+    def put_summary(self, src, data: dict):
+        from .summaries import SUMMARY_SCHEMA
+        self._entry(src, create=True)["summary"] = {
+            "schema": SUMMARY_SCHEMA, "data": data}
         self._dirty = True
 
     def save(self):

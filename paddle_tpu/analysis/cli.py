@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -75,6 +76,9 @@ def _parser():
                         "~/.cache/graftlint/cache.json)")
     p.add_argument("--list-passes", action="store_true",
                    help="list registered passes with their rule IDs")
+    p.add_argument("--explain", metavar="CODE",
+                   help="print a rule's doc, severity and its minimal "
+                        "bad/clean fixture example, then exit")
     p.add_argument("--version", action="store_true",
                    help="print pass versions and rule IDs, then exit")
     return p
@@ -84,7 +88,60 @@ def _split(s):
     return [x.strip() for x in s.split(",") if x.strip()] if s else None
 
 
+def _fixture_pair(code):
+    """(bad_path, clean_path) for ``code``'s fixture pair under
+    ``tests/graftlint_fixtures`` when the repo checkout is present."""
+    import glob
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    fixtures = os.path.join(repo, "tests", "graftlint_fixtures")
+    bad = sorted(glob.glob(os.path.join(fixtures, f"*{code}_bad.py")))
+    clean = sorted(glob.glob(os.path.join(fixtures, f"*{code}_clean.py")))
+    return (bad[0] if bad else None), (clean[0] if clean else None)
+
+
+def _explain(code) -> int:
+    from . import passes as _passes  # noqa: F401 — register built-ins
+    from .framework import PASSES
+    code = code.upper()
+    for name in sorted(PASSES):
+        p = PASSES[name]
+        if code not in p.codes:
+            continue
+        print(f"{code} [{name} v{p.version}]")
+        print(f"severity: {p.rule_severities.get(code, 'error')}")
+        doc = p.rule_docs.get(code) or p.description
+        print(f"\n{doc}\n")
+        bad, clean = _fixture_pair(code.lower())
+        for label, path in (("bad", bad), ("clean", clean)):
+            if path is None:
+                continue
+            with open(path, encoding="utf-8") as f:
+                body = f.read().rstrip()
+            print(f"--- {label} example ({path.rsplit('/', 1)[-1]}) ---")
+            print(body)
+            print()
+        if bad is None and clean is None:
+            print("(no fixture pair found — repo checkout required for "
+                  "examples)")
+        return 0
+    print(f"graftlint: unknown rule code {code!r}", file=sys.stderr)
+    return 2
+
+
 def main(argv=None) -> int:
+    try:
+        return _main(argv)
+    except BrokenPipeError:
+        # reader (head, less …) went away mid-report; the EPIPE is theirs
+        # to cause, not ours to traceback over.  Re-point stdout at
+        # /dev/null so the interpreter's exit-time flush doesn't raise too.
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
+
+
+def _main(argv=None) -> int:
     args = _parser().parse_args(argv)
     from . import passes as _passes  # noqa: F401 — register built-ins
     from .framework import PASSES, run
@@ -94,10 +151,13 @@ def main(argv=None) -> int:
         for line in _rule_lines():
             print(line)
         return 0
+    if args.explain:
+        return _explain(args.explain)
     if args.list_passes:
         for name in sorted(PASSES):
             p = PASSES[name]
-            scope = "project" if p.project_scope else "file"
+            scope = ("project" if p.project_scope
+                     else "summary" if p.summary_scope else "file")
             codes = " ".join(p.codes)
             print(f"{name:24s} v{p.version} [{scope}]  {p.description}")
             if codes:

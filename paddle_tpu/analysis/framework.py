@@ -8,10 +8,14 @@ registry drift, stale ``__all__`` exports, and JAX trace-unsafe idioms that
 silently recompile or leak tracers.
 
 Pass contract: subclass :class:`AnalysisPass` and register with
-:func:`register_pass`.  A pass implements either
+:func:`register_pass`.  A pass implements one of
 
-  * ``check_file(source_file) -> list[Finding]``   (per-file; cacheable), or
-  * ``check_project(project) -> list[Finding]``    (whole-tree; never cached)
+  * ``check_file(source_file) -> list[Finding]``   (per-file; cacheable),
+  * ``check_project(project) -> list[Finding]``    (whole-tree; never cached),
+  * ``check_summaries(source_file, index) -> list[Finding]``
+    (``summary_scope``: per-file findings against the whole-program
+    :class:`~.summaries.SummaryIndex`; cacheable with cross-file dep
+    digests so editing a fact-contributing module re-lints its dependents)
 
 Suppression pragmas (the clang-tidy ``NOLINT`` analog):
 
@@ -154,12 +158,19 @@ class AnalysisPass:
     version: int = 1
     description: str = ""
     codes: tuple = ()             # rule IDs the pass can emit (CLI listing)
+    rule_docs: dict = {}          # code -> explanation (CLI --explain)
+    rule_severities: dict = {}    # code -> severity note (CLI --explain)
     project_scope: bool = False   # True -> check_project, uncacheable
+    summary_scope: bool = False   # True -> check_summaries, dep-cached
+    summary_domains: tuple = ()   # SummaryIndex fact domains consulted
 
     def check_file(self, src: SourceFile) -> list[Finding]:
         return []
 
     def check_project(self, project: Project) -> list[Finding]:
+        return []
+
+    def check_summaries(self, src: SourceFile, index) -> list[Finding]:
         return []
 
 
@@ -226,20 +237,26 @@ def run(paths, select=None, disable=None, cache=None,
             raw.append(Finding("framework", "GL000", f.path,
                                f.syntax_error.lineno or 1,
                                f"syntax error: {f.syntax_error.msg}"))
+    index = None
+    if any(PASSES[n].summary_scope for n in names):
+        from .summaries import SummaryIndex
+        index = SummaryIndex(project, cache=cache)
     for n in names:
         p = PASSES[n]
         if p.project_scope:
             raw.extend(p.check_project(project))
             continue
+        deps = index.pass_deps(p) if p.summary_scope else None
         for f in files:
-            cached = cache.get(f, p) if cache is not None else None
+            cached = cache.get(f, p, deps=deps) if cache is not None else None
             if cached is not None:
                 result.cache_hits += 1
                 raw.extend(cached)
                 continue
-            found = p.check_file(f)
+            found = p.check_summaries(f, index) if p.summary_scope \
+                else p.check_file(f)
             if cache is not None:
-                cache.put(f, p, found)
+                cache.put(f, p, found, deps=deps)
             raw.extend(found)
     for fd in raw:
         src = project.by_path.get(fd.path)

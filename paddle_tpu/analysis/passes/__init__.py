@@ -1,8 +1,10 @@
 """graftlint passes — importing this package registers every built-in pass."""
-from . import (concurrency, dtype_rules, jit_cache_hygiene,  # noqa: F401
-               namespace_parity, no_adhoc_telemetry, registry_parity,
-               robustness, sharding_spec, trace_safety)
+from . import (concurrency, contracts, dtype_rules,  # noqa: F401
+               jit_cache_hygiene, namespace_parity, no_adhoc_telemetry,
+               registry_parity, resource_lifecycle, robustness,
+               sharding_spec, trace_safety)
 
-__all__ = ["concurrency", "dtype_rules", "jit_cache_hygiene",
+__all__ = ["concurrency", "contracts", "dtype_rules", "jit_cache_hygiene",
            "namespace_parity", "no_adhoc_telemetry", "registry_parity",
-           "robustness", "sharding_spec", "trace_safety"]
+           "resource_lifecycle", "robustness", "sharding_spec",
+           "trace_safety"]

@@ -1,0 +1,218 @@
+"""contracts — cross-process contract parity over the summary index.
+
+A multi-process fleet's contracts are stringly-typed: RPC op names, fault
+point names, metric family names, and the implicit "exceptions travel by
+pickle" rule of the worker RPC plane.  No single file sees both halves of
+any of them, so these rules run against the whole-program
+:class:`~..summaries.SummaryIndex` instead of one AST:
+
+* **CT101 — RPC op parity.**  Every constant-string op reaching an
+  ``RpcClient.call`` (directly or through a forwarder like
+  ``RemoteReplica._call``) must be handled by some registered dispatcher's
+  ``op == "..."`` table; an op a *closed* dispatcher (one whose handler
+  ends by raising on unknown ops) handles but nobody calls is dead
+  protocol surface.  Files registering an *open* dispatcher (a test fake
+  whose handler accepts anything) are exempt from site checks.
+* **CT102 — pickle-safe RPC errors.**  An exception class raised anywhere
+  in a dispatcher's import closure crosses the process boundary by value.
+  That round-trips only if the class defines ``__reduce__`` or its
+  ``__init__`` forwards its parameters verbatim (in order, positionally)
+  to ``super().__init__`` — otherwise the server degrades it to
+  ``RuntimeError(repr)`` and the client loses the type and its fields.
+* **CT103 — fault-point parity.**  Every ``FAULTS.raise_if("x")`` /
+  ``maybe_fire`` / ``fire`` string must appear in ``KNOWN_POINTS``
+  (``testing/faults.py``), and every declared point must be fired
+  somewhere and armed by at least one ``injected("x", ...)`` in the
+  analyzed tree — an untested fault point is dead chaos surface.
+* **CT104 — metric-family discipline.**  Family names must be literal
+  (cardinality belongs in labels, not f-string names), valid Prometheus
+  names, and keep one metric type per name across all modules.
+"""
+from __future__ import annotations
+
+import re
+
+from ..framework import AnalysisPass, Finding, register_pass
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+_HINTS = {
+    "CT101": "add an `op == \"...\"` arm to the worker dispatcher (or drop "
+             "the dead arm); op strings are the wire protocol",
+    "CT102": "give the class a __reduce__, or make __init__ forward its "
+             "params verbatim to super().__init__",
+    "CT103": "declare the point in testing/faults.py KNOWN_POINTS and arm "
+             "it with injected(\"...\", schedule) in a chaos test",
+    "CT104": "declare each family once, with a literal valid name and one "
+             "type; put the varying part in labelnames",
+}
+
+_DOCS = {
+    "CT101": "RPC op parity: every constant-string op reaching an "
+             "RpcClient.call (directly or via a forwarder method) must "
+             "have a dispatcher arm, and every closed-dispatcher arm must "
+             "have a caller — op strings are the cross-process protocol, "
+             "and drift on either side is invisible to unit tests.",
+    "CT102": "Pickle-safe RPC errors: exceptions raised under a server "
+             "handler travel to the client by pickle.  Without __reduce__ "
+             "or a verbatim-forwarding __init__, the default reduce "
+             "replays cls(*self.args) with the wrong arguments and the "
+             "server degrades the error to RuntimeError(repr).",
+    "CT103": "Fault-point parity: a FAULTS.maybe_fire/raise_if/fire string "
+             "must be declared in KNOWN_POINTS and exercised by at least "
+             "one injected(...) in the analyzed tree; an undeclared point "
+             "is a typo magnet and an unexercised one is dead chaos "
+             "surface.",
+    "CT104": "Metric-family discipline: family names must be literal, "
+             "valid Prometheus names, with exactly one metric type per "
+             "name across every module that declares into the registry.",
+}
+
+
+@register_pass
+class ContractsPass(AnalysisPass):
+    name = "contracts"
+    version = 1
+    codes = ("CT101", "CT102", "CT103", "CT104")
+    rule_docs = _DOCS
+    rule_severities = {
+        "CT101": "error (unhandled op) / warning (dead dispatcher arm)",
+        "CT102": "warning",
+        "CT103": "error (fired-but-undeclared) / warning (non-literal, "
+                 "never-fired, or uncovered point)",
+        "CT104": "error",
+    }
+    summary_scope = True
+    summary_domains = ("rpc", "exceptions", "faults", "metrics")
+    description = ("cross-process contract parity: RPC ops, pickle-safe "
+                   "errors, fault points, metric families")
+
+    def check_summaries(self, src, index) -> list[Finding]:
+        findings: list[Finding] = []
+        self._ct101(src, index, findings)
+        self._ct102(src, index, findings)
+        self._ct103(src, index, findings)
+        self._ct104(src, index, findings)
+        return findings
+
+    # ---- CT101: RPC op parity ------------------------------------------------
+    def _ct101(self, src, index, findings):
+        if not index.has_dispatchers:
+            return
+        if src.path not in index.open_dispatcher_paths:
+            for path, line, op in index.op_sites:
+                if path != src.path or op in index.handled_ops:
+                    continue
+                findings.append(Finding(
+                    self.name, "CT101", path, line,
+                    f"RPC op {op!r} has no registered server handler — the "
+                    "call raises 'unknown worker op' at runtime",
+                    _HINTS["CT101"]))
+        if index.has_op_sites:
+            called = {op for _, _, op in index.op_sites}
+            for d in index.dispatchers:
+                if d["path"] != src.path or not d["closed"]:
+                    continue
+                for op, line in d["ops"]:
+                    if op not in called:
+                        findings.append(Finding(
+                            self.name, "CT101", d["path"], line,
+                            f"dispatcher op {op!r} has no call site anywhere "
+                            "— dead protocol surface", _HINTS["CT101"],
+                            severity="warning"))
+
+    # ---- CT102: pickle-safe RPC errors ---------------------------------------
+    def _ct102(self, src, index, findings):
+        if not index.has_dispatchers:
+            return
+        for key in index.raised_in_closure:
+            if key[0] != src.path or key not in index.exception_classes:
+                continue
+            c = index.classes[key]
+            if c["has_reduce"] or c["init_safe"]:
+                continue
+            findings.append(Finding(
+                self.name, "CT102", key[0], c["init_line"],
+                f"exception {c['name']!r} is raised under the RPC dispatch "
+                "closure but cannot travel by value: __init__ does not "
+                "forward its args verbatim and there is no __reduce__ — it "
+                "degrades to RuntimeError(repr) at the client",
+                _HINTS["CT102"], severity="warning"))
+
+    # ---- CT103: fault-point parity -------------------------------------------
+    def _ct103(self, src, index, findings):
+        declared = index.declared_points
+        if src.path not in index.decl_paths:
+            # a point this file both arms (injected/install) and fires is a
+            # self-contained ad-hoc point — the injector's own unit tests do
+            # this; production files never arm points, so the parity check
+            # stays strict there
+            summary = index.summaries.get(src.path) or {}
+            self_armed = {c["point"] for c in summary.get("fault_coverage", ())
+                          if c["point"] is not None}
+            for path, line, api, point in index.fault_fires:
+                if path != src.path:
+                    continue
+                if point is None:
+                    findings.append(Finding(
+                        self.name, "CT103", path, line,
+                        f"FAULTS.{api} with a non-literal point name — "
+                        "parity with KNOWN_POINTS cannot be checked",
+                        _HINTS["CT103"], severity="warning"))
+                elif declared and point not in declared \
+                        and point not in self_armed:
+                    findings.append(Finding(
+                        self.name, "CT103", path, line,
+                        f"fault point {point!r} is fired but not declared "
+                        "in KNOWN_POINTS", _HINTS["CT103"]))
+            return
+        # the declaring module owns the decl-side findings
+        if not index.has_outside_fires:
+            return
+        fired = {pt for p, _, _, pt in index.fault_fires
+                 if pt is not None and p not in index.decl_paths}
+        for path, line, names in index.fault_decls:
+            if path != src.path:
+                continue
+            for n in names:
+                if n not in fired:
+                    findings.append(Finding(
+                        self.name, "CT103", path, line,
+                        f"declared fault point {n!r} is never fired — dead "
+                        "chaos surface", _HINTS["CT103"],
+                        severity="warning"))
+                elif index.has_fault_coverage and \
+                        n not in index.fault_coverage:
+                    findings.append(Finding(
+                        self.name, "CT103", path, line,
+                        f"declared fault point {n!r} has no injected(...) "
+                        "chaos coverage", _HINTS["CT103"],
+                        severity="warning"))
+
+    # ---- CT104: metric-family discipline -------------------------------------
+    def _ct104(self, src, index, findings):
+        for m in index.metric_decls:
+            if m["path"] != src.path:
+                continue
+            if not m["literal"]:
+                findings.append(Finding(
+                    self.name, "CT104", m["path"], m["line"],
+                    f"metric family declared with a non-literal name "
+                    f"({m['kind']}) — computed names explode cardinality "
+                    "and defeat cross-module type checks", _HINTS["CT104"]))
+                continue
+            name = m["metric"]
+            if name is None:
+                continue
+            if not _NAME_RE.match(name):
+                findings.append(Finding(
+                    self.name, "CT104", m["path"], m["line"],
+                    f"metric family {name!r} is not a valid Prometheus "
+                    "name", _HINTS["CT104"]))
+            first = index.metric_kinds.get(name)
+            if first is not None and first["kind"] != m["kind"]:
+                findings.append(Finding(
+                    self.name, "CT104", m["path"], m["line"],
+                    f"metric family {name!r} redeclared as {m['kind']} but "
+                    f"first declared as {first['kind']} — one type per "
+                    "family", _HINTS["CT104"]))

@@ -1,0 +1,414 @@
+"""resource-lifecycle — acquire/release discipline on exception paths.
+
+The runtime audits resources dynamically (``PagePool.audit_refcounts``,
+lease heartbeats); these rules are the static counterpart, catching the
+paths a chaos run only hits when the fault lands exactly between an
+acquire and its release:
+
+* **RL101** — a socket / file / thread acquired into a local or
+  ``self.*`` name, followed by calls that can raise before any
+  ``close()``/``join()`` is guaranteed by a ``with``, ``try/finally`` or
+  an ``except`` that releases it.  A constructor (`__init__`) that raises
+  after acquiring leaks unconditionally: the caller never gets an object
+  to close.
+* **RL102** — a ``PagePool`` ``alloc_page``/``ref_page`` whose matching
+  ``unref_page``/``free`` is separated from it by calls that can raise,
+  with no ``except``/``finally`` rollback in between — the static shadow
+  of ``audit_refcounts``.
+* **RL103** — a class that registers a membership lease
+  (``self.lease = membership.register(...)``) but whose shutdown methods
+  (``close``/``stop``/``drain``/...) never reach a ``release()``/
+  ``evict()``: the lease survives the owner and routes traffic at a
+  corpse until TTL expiry.
+
+Scope: production code and lint fixtures; files under ``tests/`` (except
+``graftlint_fixtures``) are skipped — tests hold resources deliberately
+and die with the process.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..framework import AnalysisPass, Finding, norm_path, register_pass
+from ..resolve import Imports
+
+_ACQUIRE_KINDS = (
+    ("socket.socket", "socket"),
+    ("socket.create_connection", "socket"),
+    ("socket.socketpair", "socket"),
+    ("threading.Thread", "thread"),
+)
+_RELEASE = {"file": ("close",), "socket": ("close",), "thread": ("join",)}
+_POOL_ACQ = ("alloc_page", "ref_page")
+_POOL_REL = ("unref_page", "free_page", "release_page", "free")
+_SHUTDOWN_NAMES = ("close", "stop", "shutdown", "drain", "release",
+                   "terminate", "__exit__")
+
+_HINTS = {
+    "RL101": "wrap the risky calls in try/except that closes the resource "
+             "(or use `with`); a constructor that raises after acquiring "
+             "leaks the resource unconditionally",
+    "RL102": "move the page ops into a try whose except/finally rolls the "
+             "ref back (unref_page/free), or reorder so nothing can raise "
+             "between them",
+    "RL103": "release or evict the lease from the owner's close()/stop() "
+             "path so membership sees `leave` instead of a TTL expiry",
+}
+
+_DOCS = {
+    "RL101": "Acquire-without-guaranteed-release: a socket/file/thread "
+             "bound to a name, then calls that can raise before any close "
+             "is guaranteed.  On the exception path the resource leaks — "
+             "fd exhaustion under retry loops, EADDRINUSE on respawn.",
+    "RL102": "PagePool ref/alloc without a guarded rollback: if a call "
+             "raises between alloc_page/ref_page and its unref, the page's "
+             "refcount is permanently high and audit_refcounts only finds "
+             "it after the capacity is already gone.",
+    "RL103": "Lease registered with no release reachable from shutdown: "
+             "the membership plane keeps routing to the dead owner until "
+             "TTL expiry instead of seeing a clean `leave`.",
+}
+
+
+def _terminal_name(expr):
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _is_poolish(expr):
+    name = _terminal_name(expr)
+    return name is not None and "pool" in name.lower().lstrip("_")
+
+
+def _call_desc(call):
+    """Short stable spelling of a call's target for messages."""
+    parts = []
+    f = call.func
+    while isinstance(f, ast.Attribute):
+        parts.append(f.attr)
+        f = f.value
+    if isinstance(f, ast.Name):
+        parts.append(f.id)
+    return ".".join(reversed(parts)) or "<call>"
+
+
+def _own_nodes(func):
+    """All nodes of ``func`` excluding nested function/lambda bodies."""
+    out = []
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _same_target(expr, target):
+    """``expr`` spells the same Name / self.attr as ``target``."""
+    if isinstance(target, ast.Name):
+        return isinstance(expr, ast.Name) and expr.id == target.id
+    if isinstance(target, ast.Attribute):
+        return (isinstance(expr, ast.Attribute)
+                and expr.attr == target.attr
+                and isinstance(expr.value, ast.Name)
+                and isinstance(target.value, ast.Name)
+                and expr.value.id == target.value.id)
+    return False
+
+
+def _contains_target(node, target):
+    return any(_same_target(n, target) for n in ast.walk(node))
+
+
+class _FuncCtx:
+    """Parent links and try-guard queries within one function."""
+
+    def __init__(self, func):
+        self.func = func
+        self.nodes = _own_nodes(func)
+        self.parent: dict = {}
+        stack = [func]
+        while stack:
+            n = stack.pop()
+            for c in ast.iter_child_nodes(n):
+                self.parent[c] = n
+                stack.append(c)
+
+    def ancestors(self, node):
+        while node in self.parent:
+            node = self.parent[node]
+            yield node
+
+    def in_handler_of_try_containing(self, node, other):
+        """``node`` sits in an except-handler/orelse of a Try whose body
+        contains ``other`` (i.e. runs only when ``other``'s region threw)."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, ast.Try):
+                in_body = any(other is n or other in ast.walk(s)
+                              for s in anc.body for n in ast.walk(s))
+                if in_body:
+                    in_rescue = any(
+                        node in ast.walk(h)
+                        for h in list(anc.handlers) + list(anc.orelse))
+                    if in_rescue:
+                        return True
+        return False
+
+    def guarded_by_release(self, node, release_pred):
+        """Some ancestor Try holds ``node`` in its body and releases the
+        resource in an except-handler or finally block."""
+        child = node
+        for anc in self.ancestors(node):
+            if isinstance(anc, ast.Try):
+                in_body = any(child is s or any(child is n for n in
+                                                ast.walk(s))
+                              for s in anc.body)
+                if in_body:
+                    rescue = list(anc.finalbody)
+                    for h in anc.handlers:
+                        rescue.extend(h.body)
+                    for s in rescue:
+                        for n in ast.walk(s):
+                            if isinstance(n, ast.Call) and release_pred(n):
+                                return True
+            child = anc
+        return False
+
+
+@register_pass
+class ResourceLifecyclePass(AnalysisPass):
+    name = "resource_lifecycle"
+    version = 1
+    codes = ("RL101", "RL102", "RL103")
+    rule_docs = _DOCS
+    rule_severities = {"RL101": "warning", "RL102": "warning",
+                       "RL103": "warning"}
+    description = ("socket/file/thread leaks on exception paths, unguarded "
+                   "PagePool ref/alloc, leases with no shutdown release")
+
+    def check_file(self, src) -> list[Finding]:
+        rel = norm_path(src.path)
+        if rel.startswith("tests/") and "graftlint_fixtures" not in rel:
+            return []
+        imports = Imports(src.tree, None)
+        findings: list[Finding] = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ctx = _FuncCtx(node)
+                self._rl101(src, imports, ctx, findings)
+                self._rl102(src, ctx, findings)
+            elif isinstance(node, ast.ClassDef):
+                self._rl103(src, node, findings)
+        return findings
+
+    # ---- RL101: acquire without guaranteed release ---------------------------
+    def _acquire_kind(self, imports, call):
+        canon = imports.canonical(call.func)
+        if canon == "open":
+            return "file"
+        for key, kind in _ACQUIRE_KINDS:
+            if canon == key or (canon and canon.endswith("." + key)):
+                if kind == "thread" and any(
+                        k.arg == "daemon" and isinstance(k.value, ast.Constant)
+                        and k.value.value for k in call.keywords):
+                    return None            # daemon thread: fire-and-forget
+                return kind
+        return None
+
+    def _rl101(self, src, imports, ctx, findings):
+        func = ctx.func
+        for stmt in ctx.nodes:
+            if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.value, ast.Call)):
+                continue
+            kind = self._acquire_kind(imports, stmt.value)
+            if kind is None:
+                continue
+            target = stmt.targets[0]
+            if isinstance(target, ast.Name):
+                self._rl101_check(src, ctx, stmt, target, kind, findings,
+                                  ctor=False)
+            elif (isinstance(target, ast.Attribute)
+                  and isinstance(target.value, ast.Name)
+                  and target.value.id == "self"
+                  and func.name == "__init__"):
+                self._rl101_check(src, ctx, stmt, target, kind, findings,
+                                  ctor=True)
+
+    def _rl101_check(self, src, ctx, acq_stmt, target, kind, findings, ctor):
+        release_names = _RELEASE[kind]
+        acq_call = acq_stmt.value
+
+        def is_release(call):
+            return (isinstance(call.func, ast.Attribute)
+                    and call.func.attr in release_names
+                    and _same_target(call.func.value, target))
+
+        releases, escapes, managed = [], [], False
+        for n in ctx.nodes:
+            if isinstance(n, ast.withitem) and \
+                    _contains_target(n.context_expr, target):
+                managed = True
+            elif isinstance(n, (ast.Return, ast.Yield, ast.YieldFrom)):
+                if n.value is not None and _contains_target(n.value, target):
+                    escapes.append(n.lineno)
+            elif isinstance(n, ast.Call):
+                if is_release(n):
+                    releases.append(n)
+                elif any(_contains_target(a, target)
+                         for a in list(n.args) + [k.value
+                                                  for k in n.keywords]):
+                    escapes.append(n.lineno)
+            elif isinstance(n, ast.Assign) and n is not acq_stmt and \
+                    _contains_target(n.value, target):
+                escapes.append(n.lineno)       # aliased or stored
+        if managed:
+            return
+        straight_rel = [r.lineno for r in releases
+                        if not any(isinstance(a, ast.ExceptHandler)
+                                   for a in ctx.ancestors(r))
+                        and not self._in_finalbody(ctx, r)]
+        boundary = min(straight_rel + escapes + [float("inf")])
+        risky = []
+        for n in ctx.nodes:
+            if not isinstance(n, ast.Call) or n is acq_call:
+                continue
+            if not (acq_stmt.lineno < n.lineno < boundary):
+                continue
+            if is_release(n):
+                continue
+            if kind == "thread" and isinstance(n.func, ast.Attribute) and \
+                    _same_target(n.func.value, target):
+                continue                       # t.start() before join is fine
+            if ctx.in_handler_of_try_containing(n, acq_call):
+                continue                       # runs only if acquire threw
+            risky.append(n)
+        unprotected = [n for n in risky
+                       if not ctx.guarded_by_release(n, is_release)]
+        desc = _terminal_name(target) or "resource"
+        if unprotected:
+            first = min(unprotected, key=lambda n: n.lineno)
+            where = ("constructor raises after acquiring — the caller "
+                     "never gets an object to close" if ctor else
+                     "no try/finally or closing except guards it")
+            findings.append(Finding(
+                self.name, "RL101", src.path, acq_stmt.lineno,
+                f"{kind} {desc!r} can leak: {_call_desc(first)}(...) may "
+                f"raise before {release_names[0]}() is guaranteed ({where})",
+                _HINTS["RL101"], severity="warning"))
+        elif not ctor and not releases and not escapes:
+            findings.append(Finding(
+                self.name, "RL101", src.path, acq_stmt.lineno,
+                f"{kind} {desc!r} is never released on any path "
+                f"(no {release_names[0]}(), with-block, or handoff)",
+                _HINTS["RL101"], severity="warning"))
+
+    @staticmethod
+    def _in_finalbody(ctx, node):
+        child = node
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.Try) and any(
+                    child is s or any(child is n for n in ast.walk(s))
+                    for s in anc.finalbody):
+                return True
+            child = anc
+        return False
+
+    # ---- RL102: PagePool ref/alloc without guarded rollback ------------------
+    def _rl102(self, src, ctx, findings):
+        def is_pool_release(call):
+            return (isinstance(call.func, ast.Attribute)
+                    and call.func.attr in _POOL_REL
+                    and _is_poolish(call.func.value))
+
+        for site in ctx.nodes:
+            if not (isinstance(site, ast.Call)
+                    and isinstance(site.func, ast.Attribute)
+                    and site.func.attr in _POOL_ACQ
+                    and _is_poolish(site.func.value)):
+                continue
+            parent = ctx.parent.get(site)
+            if isinstance(parent, ast.Return):
+                continue                       # caller owns the ref
+            if ctx.guarded_by_release(site, is_pool_release):
+                continue
+            rel_after = [n.lineno for n in ctx.nodes
+                         if isinstance(n, ast.Call) and is_pool_release(n)
+                         and n.lineno > site.lineno]
+            boundary = min(rel_after + [float("inf")])
+            risky = [n for n in ctx.nodes
+                     if isinstance(n, ast.Call)
+                     and site.lineno < n.lineno < boundary
+                     and not (isinstance(n.func, ast.Attribute)
+                              and _is_poolish(n.func.value))
+                     and not ctx.guarded_by_release(n, is_pool_release)]
+            if risky:
+                first = min(risky, key=lambda n: n.lineno)
+                findings.append(Finding(
+                    self.name, "RL102", src.path, site.lineno,
+                    f"{site.func.attr}() ref can strand: "
+                    f"{_call_desc(first)}(...) may raise before the "
+                    "matching unref/free reaches an except/finally",
+                    _HINTS["RL102"], severity="warning"))
+
+    # ---- RL103: lease with no shutdown release -------------------------------
+    def _rl103(self, src, cls, findings):
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        leases = []                            # (attr_name, line)
+        for m in methods.values():
+            for n in _own_nodes(m):
+                if (isinstance(n, ast.Assign) and len(n.targets) == 1
+                        and isinstance(n.targets[0], ast.Attribute)
+                        and isinstance(n.targets[0].value, ast.Name)
+                        and n.targets[0].value.id == "self"
+                        and isinstance(n.value, ast.Call)
+                        and isinstance(n.value.func, ast.Attribute)
+                        and n.value.func.attr == "register"):
+                    recv = (_terminal_name(n.value.func.value) or "").lower()
+                    if "member" in recv or "lease" in recv:
+                        leases.append((n.targets[0].attr, n.lineno))
+        if not leases:
+            return
+        shutdown = [m for name, m in methods.items()
+                    if name in _SHUTDOWN_NAMES]
+        # intra-class closure from the shutdown methods
+        reachable, frontier = set(), [m.name for m in shutdown]
+        while frontier:
+            name = frontier.pop()
+            if name in reachable or name not in methods:
+                continue
+            reachable.add(name)
+            for n in _own_nodes(methods[name]):
+                if (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and isinstance(n.func.value, ast.Name)
+                        and n.func.value.id == "self"):
+                    frontier.append(n.func.attr)
+        for attr, line in leases:
+            released = False
+            for name in reachable:
+                for n in _own_nodes(methods[name]):
+                    if not (isinstance(n, ast.Call)
+                            and isinstance(n.func, ast.Attribute)):
+                        continue
+                    if n.func.attr in ("release", "evict", "stop_heartbeat"):
+                        recv = n.func.value
+                        if _same_target(recv, ast.Attribute(
+                                value=ast.Name(id="self"), attr=attr)) or \
+                                n.func.attr == "evict":
+                            released = True
+            if not released:
+                why = ("no release()/evict() reachable from its shutdown "
+                       "methods" if shutdown else
+                       "the class has no shutdown method at all")
+                findings.append(Finding(
+                    self.name, "RL103", src.path, line,
+                    f"membership lease 'self.{attr}' is registered but "
+                    f"{why} — the fleet sees a TTL expiry, not a clean "
+                    "leave", _HINTS["RL103"], severity="warning"))

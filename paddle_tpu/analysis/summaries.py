@@ -1,0 +1,662 @@
+"""Interprocedural summaries — the whole-program half of graftlint.
+
+Per-file passes see one AST at a time, but the fleet's worst bugs live
+*between* files: an RPC op string emitted in ``fleet.py`` must meet an
+``op == "..."`` comparison in ``worker.py``; a fault point fired in
+``engine/core.py`` must be declared in ``testing/faults.py`` and armed by
+some ``injected(...)`` in ``tests/``.  This module extracts a small,
+JSON-serializable **summary** from every file (functions and their
+parameters, calls whose first argument is a constant string or a forwarded
+parameter, dispatcher registrations, exception classes, metric-family and
+fault-point facts) and folds them into a :class:`SummaryIndex` — the fact
+tables summary-scope passes query.
+
+Caching/invalidation contract (cache schema v4):
+
+* each file's summary is cached next to its per-pass findings, keyed on the
+  file's content sha and :data:`SUMMARY_SCHEMA`;
+* each *domain* of facts (``rpc``, ``exceptions``, ``faults``, ``metrics``)
+  has a **digest** over the ``(path, sha)`` pairs of every file that
+  contributes facts to it;
+* a summary-scope pass's cache entries record the digests of the domains it
+  consults.  Editing ``rpc.py`` (an rpc-domain contributor) changes that
+  digest and re-lints every dependent file; editing a file with no rpc
+  facts leaves the digest — and every other file's cache entry — intact.
+"""
+from __future__ import annotations
+
+import ast
+import builtins
+import hashlib
+
+from .framework import Project, norm_path
+from .resolve import Imports, _match
+
+SUMMARY_SCHEMA = 1
+
+# canonical-path suffixes that mean "a fault-injector probe"
+_FAULT_FIRE_SUFFIXES = ("FAULTS.fire", "FAULTS.raise_if", "FAULTS.maybe_fire")
+_FAULT_COVER_SUFFIXES = ("FAULTS.install", "faults.injected",
+                         "testing.injected", "injected")
+_METRIC_KINDS = ("counter", "gauge", "histogram")
+
+_BUILTIN_EXCEPTIONS = frozenset(
+    n for n in dir(builtins)
+    if isinstance(getattr(builtins, n), type)
+    and issubclass(getattr(builtins, n), BaseException))
+
+
+def _const_str(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _is_client_receiver(expr):
+    """Same lexical client heuristic as the no-adhoc-telemetry pass:
+    ``client.call`` / ``self.client.call`` / ``foo_client.call`` /
+    ``rpc.call``."""
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    else:
+        return False
+    name = name.lower().lstrip("_")
+    return name == "rpc" or name == "client" or name.endswith("_client")
+
+
+def _registry_receiver(imports, expr):
+    """True when ``expr`` is the metrics registry (``REGISTRY.counter`` /
+    ``_registry.REGISTRY.counter`` under any import spelling)."""
+    canon = imports.canonical(expr)
+    return bool(canon) and (canon == "REGISTRY" or canon.endswith(".REGISTRY"))
+
+
+def _value_params(params, is_method):
+    """The parameters that carry caller values (drop self/cls)."""
+    return params[1:] if is_method and params else params
+
+
+class _Extractor(ast.NodeVisitor):
+    """One pass over a module AST, tracking class/function nesting."""
+
+    def __init__(self, tree, module):
+        self.imports = Imports(tree, module)
+        self.class_stack: list[str] = []
+        self.func_stack: list[str] = []
+        # names bound from an RpcClient(...) constructor — `c = RpcClient(
+        # host, port); c.call("op")` is an op site even though `c` is not a
+        # lexically client-ish name
+        self.client_vars: set[str] = set()
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.value, ast.Call)
+                    and _match(self.imports.canonical(node.value.func),
+                               ("RpcClient",))):
+                tgt = node.targets[0]
+                name = tgt.id if isinstance(tgt, ast.Name) else (
+                    tgt.attr if isinstance(tgt, ast.Attribute) else None)
+                if name:
+                    self.client_vars.add(name)
+        # qualname -> {"params", "method", "ends_raise", "eq": {param: [...]}}
+        self.functions: dict[str, dict] = {}
+        self.call_records: list[dict] = []
+        self.dispatchers: list[dict] = []
+        self.metric_decls: list[dict] = []
+        self.fault_fires: list[dict] = []
+        self.fault_coverage: list[dict] = []
+        self.fault_decls: list[dict] = []
+        self.classes: dict[str, dict] = {}
+        self.raises: list[dict] = []
+        self.imported: list[str] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                self.imported.extend(a.name for a in node.names)
+            elif isinstance(node, ast.ImportFrom):
+                base = self.imports._from_base(node)
+                if base:
+                    self.imported.append(base)
+                    self.imported.extend(f"{base}.{a.name}" for a in node.names
+                                         if a.name != "*")
+        self.visit(tree)
+
+    # ---- scope tracking ------------------------------------------------------
+    def _qual(self, name):
+        return ".".join(self.class_stack + self.func_stack + [name])
+
+    def visit_ClassDef(self, node):
+        self._record_class(node)
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _visit_func(self, node):
+        params = [a.arg for a in node.args.posonlyargs + node.args.args]
+        is_method = bool(self.class_stack) and not self.func_stack and bool(
+            params) and not any(
+                isinstance(d, ast.Name) and d.id == "staticmethod"
+                for d in node.decorator_list)
+        qual = self._qual(node.name)
+        body = node.body
+        self.functions[qual] = {
+            "params": params, "method": is_method, "line": node.lineno,
+            "ends_raise": bool(body) and isinstance(body[-1], ast.Raise),
+            "eq": self._eq_strings(node, params),
+        }
+        self.func_stack.append(node.name)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    @staticmethod
+    def _eq_strings(func, params):
+        """``param == "lit"`` / ``param in ("a", "b")`` comparisons in
+        ``func``'s own body (nested defs summarize separately)."""
+        out: dict[str, list] = {}
+        pset = set(params)
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+                continue
+            left, op, right = node.left, node.ops[0], node.comparators[0]
+            if not (isinstance(left, ast.Name) and left.id in pset):
+                continue
+            lits = []
+            if isinstance(op, ast.Eq):
+                s = _const_str(right)
+                if s is not None:
+                    lits = [s]
+            elif isinstance(op, ast.In) and isinstance(
+                    right, (ast.Tuple, ast.List, ast.Set)):
+                lits = [s for s in map(_const_str, right.elts)
+                        if s is not None]
+            for s in lits:
+                out.setdefault(left.id, []).append([s, node.lineno])
+        return out
+
+    # ---- fact extraction -----------------------------------------------------
+    def visit_Assign(self, node):
+        # module-level KNOWN_POINTS = frozenset({...}) fault-point table
+        if (not self.class_stack and not self.func_stack
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "KNOWN_POINTS"):
+            val = node.value
+            if (isinstance(val, ast.Call) and isinstance(val.func, ast.Name)
+                    and val.func.id in ("frozenset", "set") and val.args):
+                val = val.args[0]
+            if isinstance(val, (ast.Set, ast.Tuple, ast.List)):
+                names = [s for s in map(_const_str, val.elts) if s is not None]
+                if names:
+                    self.fault_decls.append(
+                        {"names": names, "line": node.lineno})
+        self.generic_visit(node)
+
+    def visit_Raise(self, node):
+        exc = node.exc
+        if isinstance(exc, ast.Call):
+            exc = exc.func
+        canon = self.imports.canonical(exc) if exc is not None else None
+        if canon:
+            self.raises.append({"name": canon, "line": node.lineno})
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        self._record_call(node)
+        self.generic_visit(node)
+
+    def _enclosing(self):
+        if not self.func_stack:
+            return None, None
+        qual = ".".join(self.class_stack + self.func_stack)
+        return qual, self.functions.get(qual)
+
+    def _record_call(self, node):
+        func = node.func
+        canon = self.imports.canonical(func)
+        arg0 = node.args[0] if node.args else None
+        lit = _const_str(arg0) if arg0 is not None else None
+        line = node.lineno
+
+        # metric-family declarations on the registry
+        if (isinstance(func, ast.Attribute) and func.attr in _METRIC_KINDS
+                and _registry_receiver(self.imports, func.value)):
+            name = lit
+            if name is None:
+                for kw in node.keywords:
+                    if kw.arg == "name":
+                        name = _const_str(kw.value)
+            literal = lit is not None or name is not None
+            self.metric_decls.append({"kind": func.attr, "metric": name,
+                                      "literal": literal, "line": line})
+
+        # fault-injector probes and chaos coverage
+        if _match(canon, _FAULT_FIRE_SUFFIXES):
+            self.fault_fires.append(
+                {"api": canon.rsplit(".", 1)[-1], "point": lit, "line": line})
+        elif _match(canon, _FAULT_COVER_SUFFIXES) and lit is not None:
+            self.fault_coverage.append({"point": lit, "line": line})
+
+        # dispatcher registration: RpcServer(handler, ...)
+        if _match(canon, ("RpcServer",)) and node.args:
+            handler = node.args[0]
+            ref = None
+            if (isinstance(handler, ast.Attribute)
+                    and isinstance(handler.value, ast.Name)
+                    and handler.value.id == "self" and self.class_stack):
+                ref = {"kind": "method", "cls": self.class_stack[-1],
+                       "name": handler.attr}
+            elif isinstance(handler, ast.Name):
+                ref = {"kind": "func", "name": handler.id,
+                       "scope": ".".join(self.class_stack + self.func_stack)}
+            elif isinstance(handler, ast.Lambda):
+                params = [a.arg for a in handler.args.args]
+                eq = self._eq_strings(handler, params)
+                ops = eq.get(params[0], []) if params else []
+                ref = {"kind": "inline", "ops": ops}
+            if ref is not None:
+                ref["line"] = line
+                self.dispatchers.append(ref)
+
+        # first-arg tracking for RPC op parity (CT101): constant-string and
+        # forwarded-parameter arg0 calls on clients / self-methods / dotted
+        # callees
+        enc_qual, enc = self._enclosing()
+        arg0_kind, arg0_val = None, None
+        if lit is not None:
+            arg0_kind, arg0_val = "str", lit
+        elif (isinstance(arg0, ast.Name) and enc is not None
+              and arg0.id in enc["params"]):
+            arg0_kind, arg0_val = "param", arg0.id
+        if arg0_kind is None:
+            return
+        if (isinstance(func, ast.Attribute) and func.attr == "call"
+                and (_is_client_receiver(func.value)
+                     or self._is_client_var(func.value))):
+            callee_kind, callee_key = "client", "call"
+        elif (isinstance(func, ast.Attribute)
+              and isinstance(func.value, ast.Name)
+              and func.value.id == "self" and self.class_stack):
+            callee_kind = "self"
+            callee_key = f"{self.class_stack[-1]}.{func.attr}"
+        elif canon:
+            callee_kind, callee_key = "dotted", canon
+        else:
+            return
+        self.call_records.append(
+            {"enc": enc_qual, "callee_kind": callee_kind,
+             "callee": callee_key, "arg0_kind": arg0_kind,
+             "arg0": arg0_val, "line": line})
+
+    def _is_client_var(self, expr):
+        """Receiver was bound from ``RpcClient(...)`` somewhere in this
+        module (``c = RpcClient(h, p); c.call("op")``)."""
+        if isinstance(expr, ast.Name):
+            return expr.id in self.client_vars
+        if isinstance(expr, ast.Attribute):
+            return expr.attr in self.client_vars
+        return False
+
+    def _record_class(self, node):
+        bases = [c for c in (self.imports.canonical(b) for b in node.bases)
+                 if c]
+        has_reduce = any(
+            isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n.name in ("__reduce__", "__reduce_ex__")
+            for n in node.body)
+        init = next((n for n in node.body
+                     if isinstance(n, ast.FunctionDef)
+                     and n.name == "__init__"), None)
+        init_safe, init_line = True, node.lineno
+        if init is not None:
+            init_line = init.lineno
+            init_safe = self._init_forwards_args(init)
+        qual = ".".join(self.class_stack + [node.name])
+        self.classes[qual] = {
+            "name": node.name, "line": node.lineno, "bases": bases,
+            "has_reduce": has_reduce, "init_safe": init_safe,
+            "init_line": init_line}
+
+    @staticmethod
+    def _init_forwards_args(init):
+        """True when ``__init__`` re-raisable by value: every declared
+        parameter is forwarded verbatim, in order, as a positional argument
+        of ``super().__init__`` (the default ``__reduce__`` replays
+        ``cls(*self.args)``, so args must round-trip)."""
+        params = [a.arg for a in init.args.posonlyargs + init.args.args][1:]
+        required = len(params) - len(init.args.defaults)
+        if any(d is None for d in init.args.kw_defaults):
+            return False                 # required kw-only: cls(*args) fails
+        for node in ast.walk(init):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "__init__"
+                    and isinstance(node.func.value, ast.Call)
+                    and isinstance(node.func.value.func, ast.Name)
+                    and node.func.value.func.id == "super"):
+                pos = []
+                for a in node.args:
+                    if isinstance(a, ast.Name):
+                        pos.append(a.id)
+                    elif (isinstance(a, ast.Starred)
+                          and isinstance(a.value, ast.Name)):
+                        pos.append("*" + a.value.id)
+                    else:
+                        return False
+                want = list(params)
+                if init.args.vararg is not None:
+                    want.append("*" + init.args.vararg.arg)
+                # a verbatim in-order prefix covering every required param
+                # round-trips: the default __reduce__ replays cls(*self.args)
+                return pos == want[:len(pos)] and len(pos) >= required
+        # no super().__init__ call: BaseException.__new__ already stored the
+        # constructor args verbatim, so the default __reduce__ round-trips
+        return True
+
+
+def summarize(src, module=None) -> dict:
+    """Extract ``src``'s JSON-serializable module summary."""
+    if module is None:
+        module = Project.module_name(src.path)
+    ex = _Extractor(src.tree, module)
+    return {
+        "schema": SUMMARY_SCHEMA,
+        "module": module,
+        "functions": ex.functions,
+        "calls": ex.call_records,
+        "dispatchers": ex.dispatchers,
+        "metric_decls": ex.metric_decls,
+        "fault_fires": ex.fault_fires,
+        "fault_coverage": ex.fault_coverage,
+        "fault_decls": ex.fault_decls,
+        "classes": ex.classes,
+        "raises": ex.raises,
+        "imports": sorted(set(ex.imported)),
+    }
+
+
+class SummaryIndex:
+    """Project-wide fact tables folded from per-file summaries.
+
+    Construction consults (and fills) the :class:`~.cache.FileCache`'s
+    summary slots when a cache is given; the per-domain digests it computes
+    are what summary-scope passes record as their cache dependencies.
+    """
+
+    DOMAINS = ("rpc", "exceptions", "faults", "metrics")
+
+    def __init__(self, project: Project, cache=None):
+        self.project = project
+        self.summaries: dict[str, dict] = {}
+        self._sha: dict[str, str] = {}
+        for f in project.files:
+            data = cache.get_summary(f) if cache is not None else None
+            if data is None:
+                data = summarize(f)
+                if cache is not None:
+                    cache.put_summary(f, data)
+            self.summaries[f.path] = data
+            self._sha[f.path] = hashlib.sha1(
+                f.text.encode("utf-8")).hexdigest()
+        self._build_functions()
+        self._build_rpc()
+        self._build_faults()
+        self._build_metrics()
+        self._build_exceptions()
+        self._digests = {d: self._digest(c) for d, c in (
+            ("rpc", self._rpc_contributors),
+            ("exceptions", self._exc_contributors),
+            ("faults", self._fault_contributors),
+            ("metrics", self._metric_contributors))}
+
+    # ---- dependency digests --------------------------------------------------
+    def _digest(self, paths) -> str:
+        lines = sorted(f"{norm_path(p)}:{self._sha[p]}" for p in paths)
+        return hashlib.sha1("\n".join(lines).encode("utf-8")).hexdigest()[:16]
+
+    def domain_digest(self, domain: str) -> str:
+        return self._digests[domain]
+
+    def pass_deps(self, pass_obj) -> dict:
+        """The dep record a summary pass's cache entries carry: schema plus
+        one digest per consulted fact domain."""
+        deps = {"summary_schema": SUMMARY_SCHEMA}
+        for d in getattr(pass_obj, "summary_domains", ()) or self.DOMAINS:
+            deps[d] = self._digests[d]
+        return deps
+
+    # ---- function / forwarder resolution -------------------------------------
+    def _build_functions(self):
+        # (path, qualname) function table + name indexes for resolution
+        self.functions: dict[tuple, dict] = {}
+        self._by_method: dict[str, list] = {}     # "Cls.meth" -> [(path, qual)]
+        self._by_name: dict[str, list] = {}       # trailing name -> [...]
+        for path, s in self.summaries.items():
+            for qual, fn in s["functions"].items():
+                self.functions[(path, qual)] = fn
+                name = qual.rsplit(".", 1)[-1]
+                self._by_name.setdefault(name, []).append((path, qual))
+                if fn["method"] and "." in qual:
+                    cls = qual.rsplit(".", 2)[-2]
+                    self._by_method.setdefault(
+                        f"{cls}.{name}", []).append((path, qual))
+
+    def _resolve_callee(self, path, rec):
+        """Resolve a call record's callee to a (path, qualname) function key,
+        preferring same-file definitions; None when unknown."""
+        kind, key = rec["callee_kind"], rec["callee"]
+        if kind == "client":
+            return None
+        if kind == "self":
+            cands = self._by_method.get(key, [])
+        else:                                   # dotted canonical
+            name = key.rsplit(".", 1)[-1]
+            cands = [c for c in self._by_name.get(name, [])
+                     if self._dotted_matches(c, key)]
+        if not cands:
+            return None
+        same = [c for c in cands if c[0] == path]
+        return (same or cands)[0]
+
+    def _dotted_matches(self, cand, canon):
+        path, qual = cand
+        mod = self.summaries[path]["module"]
+        full = f"{mod}.{qual}" if mod else qual
+        return full == canon or full.endswith("." + canon) \
+            or canon.endswith("." + qual) or canon == qual
+
+    def _first_value_param(self, key):
+        fn = self.functions[key]
+        vp = _value_params(fn["params"], fn["method"])
+        return vp[0] if vp else None
+
+    # ---- rpc domain ----------------------------------------------------------
+    def _build_rpc(self):
+        # forwarders: functions whose first value param flows into a client
+        # call's (or another forwarder's) first argument — fixpoint so
+        # multi-hop forwarding chains resolve
+        forwarders: set = set()
+        recs = [(path, rec) for path, s in self.summaries.items()
+                for rec in s["calls"]]
+        changed = True
+        while changed:
+            changed = False
+            for path, rec in recs:
+                if rec["arg0_kind"] != "param" or rec["enc"] is None:
+                    continue
+                enc_key = (path, rec["enc"])
+                if enc_key in forwarders or enc_key not in self.functions:
+                    continue
+                if rec["arg0"] != self._first_value_param(enc_key):
+                    continue
+                if rec["callee_kind"] == "client":
+                    forwarders.add(enc_key)
+                    changed = True
+                else:
+                    callee = self._resolve_callee(path, rec)
+                    if callee in forwarders:
+                        forwarders.add(enc_key)
+                        changed = True
+        self.forwarders = forwarders
+
+        # op sites: constant-string first args reaching a client call,
+        # directly or through a forwarder
+        self.op_sites: list[tuple] = []           # (path, line, op)
+        for path, rec in recs:
+            if rec["arg0_kind"] != "str":
+                continue
+            if rec["callee_kind"] == "client" or \
+                    self._resolve_callee(path, rec) in self.forwarders:
+                self.op_sites.append((path, rec["line"], rec["arg0"]))
+
+        # dispatchers resolved to their op tables
+        self.dispatchers: list[dict] = []         # {path,line,ops,closed}
+        for path, s in self.summaries.items():
+            for d in s["dispatchers"]:
+                if d["kind"] == "inline":
+                    self.dispatchers.append(
+                        {"path": path, "line": d["line"], "ops": d["ops"],
+                         "closed": False})
+                    continue
+                if d["kind"] == "method":
+                    key = f"{d['cls']}.{d['name']}"
+                    cands = [c for c in self._by_method.get(key, [])
+                             if c[0] == path] or self._by_method.get(key, [])
+                else:
+                    cands = [c for c in self._by_name.get(d["name"], [])
+                             if c[0] == path]
+                    scope = d.get("scope", "")
+                    if len(cands) > 1 and scope:
+                        inner = [c for c in cands
+                                 if c[1].startswith(scope + ".")]
+                        cands = inner or cands
+                if not cands:
+                    continue
+                key = cands[0]
+                fn = self.functions[key]
+                op_param = self._first_value_param(key)
+                ops = fn["eq"].get(op_param, []) if op_param else []
+                self.dispatchers.append(
+                    {"path": key[0], "line": d["line"], "ops": ops,
+                     "closed": fn["ends_raise"]})
+        self.handled_ops: dict[str, list] = {}
+        for d in self.dispatchers:
+            for op, line in d["ops"]:
+                self.handled_ops.setdefault(op, []).append((d["path"], line))
+        self.open_dispatcher_paths = {d["path"] for d in self.dispatchers
+                                      if not d["closed"]}
+        self._rpc_contributors = (
+            {p for p, r in recs
+             if r["callee_kind"] == "client"
+             or (p, r["enc"]) in self.forwarders
+             or self._resolve_callee(p, r) in self.forwarders}
+            | {d["path"] for d in self.dispatchers}
+            | {k[0] for k in self.forwarders}
+            | {p for p, s in self.summaries.items() if s["dispatchers"]})
+
+    # ---- faults domain -------------------------------------------------------
+    def _build_faults(self):
+        self.fault_decls: list[tuple] = []        # (path, line, names)
+        self.fault_fires: list[tuple] = []        # (path, line, api, point)
+        self.fault_coverage: set = set()
+        self._fault_contributors = set()
+        for path, s in self.summaries.items():
+            for d in s["fault_decls"]:
+                self.fault_decls.append((path, d["line"], d["names"]))
+            for f in s["fault_fires"]:
+                self.fault_fires.append(
+                    (path, f["line"], f["api"], f["point"]))
+            for c in s["fault_coverage"]:
+                self.fault_coverage.add(c["point"])
+            if s["fault_decls"] or s["fault_fires"] or s["fault_coverage"]:
+                self._fault_contributors.add(path)
+        self.declared_points = {n for _, _, names in self.fault_decls
+                                for n in names}
+        self.decl_paths = {p for p, _, _ in self.fault_decls}
+        self.has_fault_coverage = any(
+            s["fault_coverage"] for s in self.summaries.values())
+        self.has_outside_fires = any(
+            p not in self.decl_paths for p, _, _, _ in self.fault_fires)
+
+    # ---- metrics domain ------------------------------------------------------
+    def _build_metrics(self):
+        self.metric_decls: list[dict] = []
+        self._metric_contributors = set()
+        for path, s in self.summaries.items():
+            for m in s["metric_decls"]:
+                self.metric_decls.append(dict(m, path=path))
+            if s["metric_decls"]:
+                self._metric_contributors.add(path)
+        # first declaration wins the family's type; later conflicts flag
+        self.metric_decls.sort(key=lambda m: (norm_path(m["path"]),
+                                              m["line"]))
+        self.metric_kinds: dict[str, dict] = {}
+        for m in self.metric_decls:
+            if m["metric"] is not None:
+                self.metric_kinds.setdefault(m["metric"], m)
+
+    # ---- exceptions domain ---------------------------------------------------
+    def _build_exceptions(self):
+        # which project classes are exceptions (fixpoint over base chains)
+        self.classes: dict[tuple, dict] = {}      # (path, qual) -> info
+        by_name: dict[str, list] = {}
+        for path, s in self.summaries.items():
+            for qual, c in s["classes"].items():
+                self.classes[(path, qual)] = c
+                by_name.setdefault(c["name"], []).append((path, qual))
+        exceptional: set = set()
+        changed = True
+        while changed:
+            changed = False
+            for key, c in self.classes.items():
+                if key in exceptional:
+                    continue
+                for b in c["bases"]:
+                    tail = b.rsplit(".", 1)[-1]
+                    if tail in _BUILTIN_EXCEPTIONS or any(
+                            k in exceptional for k in by_name.get(tail, [])):
+                        exceptional.add(key)
+                        changed = True
+                        break
+        self.exception_classes = exceptional
+
+        # transitive project-module closure from every dispatcher module
+        mod_paths: dict[str, str] = {}
+        for path, s in self.summaries.items():
+            if s["module"]:
+                mod_paths[s["module"]] = path
+        closure: set = {d["path"] for d in self.dispatchers}
+        frontier = list(closure)
+        while frontier:
+            path = frontier.pop()
+            for target in self.summaries[path]["imports"]:
+                hit = mod_paths.get(target) or \
+                    mod_paths.get(target.rsplit(".", 1)[0])
+                if hit is not None and hit not in closure:
+                    closure.add(hit)
+                    frontier.append(hit)
+        self.dispatch_closure = closure
+
+        # exception classes raised anywhere in the closure, resolved to
+        # their defining file (same-file first, then by class name)
+        self.raised_in_closure: set = set()
+        for path in closure:
+            for r in self.summaries[path]["raises"]:
+                name = r["name"].rsplit(".", 1)[-1]
+                cands = by_name.get(name, [])
+                same = [c for c in cands if c[0] == path]
+                for key in (same or cands)[:1]:
+                    self.raised_in_closure.add(key)
+        self._exc_contributors = (
+            closure | {k[0] for k in exceptional}
+            | {p for p, s in self.summaries.items() if s["raises"]})
+
+    @property
+    def has_dispatchers(self):
+        return bool(self.dispatchers)
+
+    @property
+    def has_op_sites(self):
+        return bool(self.op_sites)

@@ -130,9 +130,8 @@ class MembershipService:
         lease record, and add the name to the index.  Returns the
         :class:`Lease` whose heartbeat keeps the membership alive."""
         name = str(name)
-        if _faults.active:
-            _faults.raise_if("membership.register", group=self.group,
-                             member=name)
+        _faults.maybe_fire("membership.register", group=self.group,
+                           member=name)
         epoch = int(self.store.add(self._k_epoch(name), 1))
         expires_at = self._write_record(name, epoch, meta)
         self._index_update(lambda names: names | {name})
@@ -232,9 +231,8 @@ class Lease:
         t0 = time.perf_counter()
 
         def attempt():
-            if _faults.active:
-                _faults.raise_if("membership.heartbeat", group=svc.group,
-                                 member=self.name)
+            _faults.maybe_fire("membership.heartbeat", group=svc.group,
+                               member=self.name)
             return svc._write_record(self.name, self.epoch, self.meta)
 
         try:

@@ -345,10 +345,6 @@ def serve(port, data_dir, host="127.0.0.1", ready_file=None, load_dir=None):
     os.makedirs(data_dir, exist_ok=True)
     shards: dict[str, SparseShard] = {}
     create_lock = threading.Lock()  # create is idempotent under concurrency
-    srv = socket.socket()
-    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    srv.bind((host, port))
-    srv.listen(64)
     stop = threading.Event()
 
     def handle(conn):
@@ -431,20 +427,29 @@ def serve(port, data_dir, host="127.0.0.1", ready_file=None, load_dir=None):
         finally:
             conn.close()
 
-    if ready_file:
-        # the launcher polls for this file's existence; publish it
-        # atomically so it can never observe an empty/torn pid
-        with open(ready_file + ".tmp", "w") as f:
-            f.write(str(os.getpid()))
-        os.replace(ready_file + ".tmp", ready_file)
-    srv.settimeout(0.2)
-    while not stop.is_set():
-        try:
-            conn, _ = srv.accept()
-        except socket.timeout:
-            continue
-        threading.Thread(target=handle, args=(conn,), daemon=True).start()
-    srv.close()
+    srv = socket.socket()
+    try:
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(64)
+        if ready_file:
+            # the launcher polls for this file's existence; publish it
+            # atomically so it can never observe an empty/torn pid
+            with open(ready_file + ".tmp", "w") as f:
+                f.write(str(os.getpid()))
+            os.replace(ready_file + ".tmp", ready_file)
+        srv.settimeout(0.2)
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            threading.Thread(target=handle, args=(conn,),
+                             daemon=True).start()
+    finally:
+        # bind failure (port in use) or a ready-file error must not leak
+        # the listener fd
+        srv.close()
 
 
 def start_server_process(port, data_dir, ready_timeout=30.0):
@@ -491,8 +496,15 @@ class SparsePsClient:
                 try:
                     s = socket.create_connection(self.endpoints[si],
                                                  timeout=5)
-                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                    s.settimeout(None)
+                    try:
+                        s.setsockopt(socket.IPPROTO_TCP,
+                                     socket.TCP_NODELAY, 1)
+                        s.settimeout(None)
+                    except OSError:
+                        # the retry loop would otherwise leak one connected
+                        # fd per failed attempt
+                        s.close()
+                        raise
                     self._socks[si] = s
                     break
                 except OSError:

@@ -71,10 +71,16 @@ class _Agent(threading.Thread):
     def __init__(self):
         super().__init__(daemon=True)
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._srv.bind(("0.0.0.0", 0))
-        self._srv.listen(64)
-        self.port = self._srv.getsockname()[1]
+        try:
+            self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._srv.bind(("0.0.0.0", 0))
+            self._srv.listen(64)
+            self.port = self._srv.getsockname()[1]
+        except OSError:
+            # a bind failure must not leak the listener fd: the caller
+            # never gets an agent to close()
+            self._srv.close()
+            raise
 
     def run(self):
         while True:
@@ -109,11 +115,9 @@ def _local_ip(master_host):
     if master_host in ("127.0.0.1", "localhost", "0.0.0.0"):
         return "127.0.0.1"
     try:
-        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        s.connect((master_host, 1))
-        ip = s.getsockname()[0]
-        s.close()
-        return ip
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect((master_host, 1))
+            return s.getsockname()[0]
     except OSError:
         return "127.0.0.1"
 
@@ -156,7 +160,12 @@ def _connect(to):
             if sock is None:
                 sock = socket.create_connection((info.ip, info.port),
                                                 timeout=30)
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                try:
+                    sock.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                except OSError:
+                    sock.close()
+                    raise
                 with _conn_lock:
                     _conns[to] = sock
     return sock, lock

@@ -88,10 +88,16 @@ class _PyStoreServer(threading.Thread):
         self._dels = {}        # key -> deletion generation (see GET/DELETE)
         self._cv = threading.Condition()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._srv.bind((host, port))
-        self._srv.listen(128)
-        self.port = self._srv.getsockname()[1]
+        try:
+            self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._srv.bind((host, port))
+            self._srv.listen(128)
+            self.port = self._srv.getsockname()[1]
+        except OSError:
+            # bind failure (EADDRINUSE on master restart) must not leak
+            # the listener fd: the caller never gets a server to stop
+            self._srv.close()
+            raise
 
     def run(self):
         while True:
@@ -236,13 +242,16 @@ class TCPStore:
         self._lock = threading.Lock()
 
     def _connect(self):
-        if _faults.active:
-            _faults.raise_if("store.connect", host=self.host, port=self.port)
+        _faults.maybe_fire("store.connect", host=self.host, port=self.port)
         sock = socket.create_connection((self.host, self.port), timeout=5)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        # blocking get/wait time out SERVER-side (protocol timeout field);
-        # the connect timeout must not cap recv
-        sock.settimeout(None)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # blocking get/wait time out SERVER-side (protocol timeout
+            # field); the connect timeout must not cap recv
+            sock.settimeout(None)
+        except OSError:
+            sock.close()
+            raise
         return sock
 
     def _rpc(self, cmd, key, val=b"", timeout=None):

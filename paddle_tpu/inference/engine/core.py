@@ -346,8 +346,7 @@ class LLMEngine(_LegacyDelegation, _SpecOrchestration):
         sched = self.sched
         r = sched.slots[slot]
         self._step_phase = ("prefill", (slot,))
-        if _faults.active:
-            _faults.raise_if("serving.step", rids=[r.rid], phase="prefill")
+        _faults.maybe_fire("serving.step", rids=[r.rid], phase="prefill")
         start = r.pos
         n = min(self.chunk, len(r.prompt) - start)
         if self.prefix_cache:
@@ -467,9 +466,8 @@ class LLMEngine(_LegacyDelegation, _SpecOrchestration):
             seeds[slot] = self._next_seed(r)
             fold[slot] = 1 if r.seed is None else 0
         self._step_phase = ("decode", tuple(s for s, _ in live))
-        if _faults.active:
-            _faults.raise_if("serving.step", rids=[r.rid for _, r in live],
-                             phase="decode")
+        _faults.maybe_fire("serving.step", rids=[r.rid for _, r in live],
+                           phase="decode")
         compile_call = not self.runner.has_decode_program(k)
         self._m.decode.inc()
         t0 = time.perf_counter()
@@ -592,8 +590,7 @@ class LLMEngine(_LegacyDelegation, _SpecOrchestration):
         sched = self.sched
         r = sched.slots[slot]
         self._step_phase = ("decode", (slot,))
-        if _faults.active:
-            _faults.raise_if("serving.step", rids=[r.rid], phase="decode")
+        _faults.maybe_fire("serving.step", rids=[r.rid], phase="decode")
         sched.ensure_page(slot, ahead=1)
         if sched.slots[slot] is not r:
             return                # growth preempted the probe target
@@ -734,8 +731,7 @@ class LLMEngine(_LegacyDelegation, _SpecOrchestration):
         eviction — recompute on the next hit, never corruption."""
         def attempt():
             try:
-                if _faults.active:
-                    _faults.raise_if("kv.spill", page=int(p))
+                _faults.maybe_fire("kv.spill", page=int(p))
                 return self.runner.pages_to_host([int(p)])
             except Exception as err:
                 if getattr(err, "transient", False):
@@ -771,8 +767,7 @@ class LLMEngine(_LegacyDelegation, _SpecOrchestration):
 
         def attempt():
             try:
-                if _faults.active:
-                    _faults.raise_if("kv.restore", keys=list(keys))
+                _faults.maybe_fire("kv.restore", keys=list(keys))
             except Exception as err:
                 if getattr(err, "transient", False):
                     raise _TransientTier(err) from err
@@ -785,18 +780,18 @@ class LLMEngine(_LegacyDelegation, _SpecOrchestration):
             self.host_restore_failures += 1
             return []
         blocks, pages = [], []
-        for key in keys:
-            blk = host.get(key)
-            if blk is None:
-                break
-            p = self.pool.alloc_page()
-            if p is None:
-                break
-            blocks.append(blk)
-            pages.append(p)
-        if not pages:
-            return []
         try:
+            for key in keys:
+                blk = host.get(key)
+                if blk is None:
+                    break
+                p = self.pool.alloc_page()
+                if p is None:
+                    break
+                blocks.append(blk)
+                pages.append(p)
+            if not pages:
+                return []
             self.runner.restore_pages(pages, blocks)
         except Exception:  # noqa: BLE001 — unwritten pages free cleanly
             for p in pages:
@@ -867,10 +862,12 @@ class LLMEngine(_LegacyDelegation, _SpecOrchestration):
             if self.pool.lookup(key) is not None \
                     or (host is not None and key in host):
                 continue
+            # slice the peer block BEFORE allocating: a malformed payload
+            # raising here must not strand a referenced page
+            blk = tuple(np.ascontiguousarray(a[:, i:i + 1]) for a in block)
             p = self.pool.alloc_page()
             if p is None:
                 break
-            blk = tuple(np.ascontiguousarray(a[:, i:i + 1]) for a in block)
             try:
                 self.runner.restore_pages([p], [blk])
             except Exception:  # noqa: BLE001 — lossless: recompute the tail

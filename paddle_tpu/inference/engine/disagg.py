@@ -476,9 +476,8 @@ class DisaggEngine:
         shared policy; the fault fires before any copy, so a retry is
         idempotent."""
         def attempt():
-            if _faults.active:
-                _faults.raise_if("serving.kv_handoff", rids=[h.r.rid],
-                                 path=h.path)
+            _faults.maybe_fire("serving.kv_handoff", rids=[h.r.rid],
+                               path=h.path)
             if h.host_block is not None:
                 return self.decodes[j].runner.put_block(h.host_block)
             block = self.prefills[h.src].runner.gather_pages(h.pages)
@@ -691,9 +690,8 @@ class DisaggEngine:
     def _pull_one(self, t, tier, wrid, pool_rid):
         def pull():
             try:
-                if _faults.active:
-                    _faults.raise_if("serving.kv_handoff", rids=[pool_rid],
-                                     path="cross_host")
+                _faults.maybe_fire("serving.kv_handoff", rids=[pool_rid],
+                                   path="cross_host")
                 return tier.pull(wrid)
             except Exception as err:
                 if getattr(err, "transient", False):

@@ -162,7 +162,9 @@ class Scheduler:
         for j in range(start // self.page, (start + n - 1) // self.page + 1):
             p = int(self.slot_tables[slot, j])
             while int(pool.page_ref[p]) > 1:
-                q = pool.alloc_page()
+                # RL102 sees preempt_youngest between alloc and rollback,
+                # but it only runs while q is None (nothing held)
+                q = pool.alloc_page()  # graftlint: disable=resource_lifecycle
                 if q is None:
                     # preemption may release the OTHER reference, making the
                     # copy unnecessary — the while re-checks
@@ -171,7 +173,11 @@ class Scheduler:
                             "page pool exhausted during copy-on-write — "
                             "engine misconfigured (max_len vs page pool)")
                     continue
-                self._copy_page(p, q)
+                try:
+                    self._copy_page(p, q)
+                except BaseException:
+                    pool.unref_page(q)   # unwritten copy frees cleanly
+                    raise
                 pool.cache_cow_copies += 1
                 if self._m is not None:
                     self._m.cow.inc()
@@ -226,9 +232,13 @@ class Scheduler:
             if avail < fresh:
                 break
             self.waiting.popleft()
-            for _, p in plan:             # ref HBM hits BEFORE allocating /
-                if p is not None:         # restoring so eviction can't take
-                    pool.ref_page(p)      # them out from under the plan
+            # ref HBM hits BEFORE allocating/restoring so eviction can't
+            # take them out from under the plan.  RL102 can't follow the
+            # branch-aware rollbacks: the short-restore path unrefs past
+            # the gap below, and the alloc-fail path unrefs everything
+            for _, p in plan:
+                if p is not None:
+                    pool.ref_page(p)  # graftlint: disable=resource_lifecycle
             # bring spilled runs back on-device in plan order; a short
             # restore truncates the usable cached prefix at the first gap
             pages, n_restored, usable, i = [], 0, len(plan), 0
@@ -349,7 +359,9 @@ class Scheduler:
         youngest other slot if the pool is dry."""
         needed = (int(self.lens[slot]) + ahead + self.page - 1) // self.page
         while int(self.n_alloc[slot]) < needed:
-            p = self.pool.alloc_page()
+            # RL102 sees preempt_youngest between alloc and the slot-table
+            # store, but it only runs while p is None (nothing held)
+            p = self.pool.alloc_page()  # graftlint: disable=resource_lifecycle
             if p is None:
                 if not self.preempt_youngest(excluding=slot):
                     raise RuntimeError(

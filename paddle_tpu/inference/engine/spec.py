@@ -155,9 +155,8 @@ class _SpecOrchestration:
             seeds[slot] = self._next_seed(r)
             fold[slot] = 1 if r.seed is None else 0
         self._step_phase = ("verify", tuple(s for s, _ in live))
-        if _faults.active:
-            _faults.raise_if("serving.step", rids=[r.rid for _, r in live],
-                             phase="verify")
+        _faults.maybe_fire("serving.step", rids=[r.rid for _, r in live],
+                           phase="verify")
         compile_call = not self.runner.has_verify_program(Kv)
         self.spec_dispatches += 1
         self._m.verify.inc()

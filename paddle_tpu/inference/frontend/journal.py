@@ -165,7 +165,7 @@ class RequestJournal:
         self._fh = open(self._seg_path(self._seg_index), "ab")
 
     def _fsync_fh(self):
-        _faults.FAULTS.raise_if("journal.fsync")
+        _faults.FAULTS.maybe_fire("journal.fsync")
         self._fh.flush()
         os.fsync(self._fh.fileno())
 
@@ -188,7 +188,7 @@ class RequestJournal:
     def _append(self, payload, critical):
         t0 = time.perf_counter()
         kind = payload["k"]
-        _faults.FAULTS.raise_if("journal.append", kind=_KIND_NAMES[kind])
+        _faults.FAULTS.maybe_fire("journal.append", kind=_KIND_NAMES[kind])
         with self._mu:
             if self._fh is None:
                 raise RuntimeError("journal is closed")
@@ -626,7 +626,7 @@ class DurableRequestPlane:
             kw["max_new_tokens"] = remaining
             kw["resume_tokens"] = emitted
         try:
-            _faults.FAULTS.raise_if("gateway.recover", key=req.key)
+            _faults.FAULTS.maybe_fire("gateway.recover", key=req.key)
             req.handle = self.replica_set.submit(req.prompt, **kw)
         except (ShedError, ReplicaDeadError, _faults.InjectedFault) as e:
             # the fleet would not take it back: fail it durably rather than

@@ -56,7 +56,9 @@ class _TransientPull(Exception):
     the pull and the request recomputes its prefix (lossless fallback)."""
 
     def __init__(self, err):
-        super().__init__(str(err))
+        # forward err itself (str() is identical for a 1-arg Exception) so
+        # the default __reduce__ round-trips the wrapper by value (CT102)
+        super().__init__(err)
         self.err = err
 
 
@@ -154,9 +156,8 @@ class EngineReplica:
                     self._cv.wait(self._poll)
                     continue
                 try:
-                    if _faults.FAULTS.active:
-                        _faults.FAULTS.raise_if("frontend.step",
-                                                replica=self.name)
+                    _faults.FAULTS.maybe_fire("frontend.step",
+                                              replica=self.name)
                     self._step_t0 = time.monotonic()
                     self.engine.step()
                 except Exception as e:  # noqa: BLE001 — replica death boundary
@@ -552,8 +553,7 @@ class ReplicaSet:
         :class:`RequestHandle`.  Raises :class:`~.admission.ShedError` on
         admission refusal and :class:`ReplicaDeadError` with no live
         replicas."""
-        if _faults.FAULTS.active:
-            _faults.FAULTS.raise_if("frontend.route")
+        _faults.FAULTS.maybe_fire("frontend.route")
         alive = self.alive_replicas()
         if not alive:
             raise ReplicaDeadError("no live replicas")
@@ -580,8 +580,7 @@ class ReplicaSet:
                 # pages BEFORE submit, so admission sees them as hits
                 self._peer_warm(rep, route.holder, prompt_ids,
                                 route.overlap, route.holder_overlap)
-            if _faults.FAULTS.active:
-                _faults.FAULTS.raise_if("frontend.submit", replica=rep.name)
+            _faults.FAULTS.maybe_fire("frontend.submit", replica=rep.name)
             try:
                 rid = rep.submit(prompt_ids, **kw)
                 break
@@ -620,9 +619,8 @@ class ReplicaSet:
 
         def attempt():
             try:
-                if _faults.FAULTS.active:
-                    _faults.FAULTS.raise_if(
-                        "kv.peer_pull", replica=rep.name, holder=holder.name)
+                _faults.FAULTS.maybe_fire(
+                    "kv.peer_pull", replica=rep.name, holder=holder.name)
                 return holder.export_pages(keys)
             except Exception as err:
                 if getattr(err, "transient", False):
@@ -760,9 +758,8 @@ class ReplicaSet:
         # the pre-recovery tokens
         kw["resume_tokens"] = list(kw.get("resume_tokens") or []) + emitted
         try:
-            if _faults.FAULTS.active:
-                _faults.FAULTS.raise_if("frontend.resume",
-                                        replica=handle.replica.name)
+            _faults.FAULTS.maybe_fire("frontend.resume",
+                                      replica=handle.replica.name)
             alive = [r for r in self.alive_replicas()
                      if r is not handle.replica]
             if not alive:

@@ -116,10 +116,16 @@ class RpcServer:
     def __init__(self, handler, host="127.0.0.1", port=0):
         self.handler = handler
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._srv.bind((host, port))
-        self._srv.listen(128)
-        self.host, self.port = host, self._srv.getsockname()[1]
+        try:
+            self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._srv.bind((host, port))
+            self._srv.listen(128)
+            self.host, self.port = host, self._srv.getsockname()[1]
+        except OSError:
+            # bind/listen failure (EADDRINUSE on a worker respawn) must not
+            # leak the listener fd: the caller never gets a server to close
+            self._srv.close()
+            raise
         self._accept_thread = None
         self._closing = False
         self._conns = set()
@@ -229,7 +235,11 @@ class RpcClient:
         except OSError as e:
             raise RpcError(
                 f"cannot reach worker at {self.host}:{self.port}: {e}") from e
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            sock.close()                # a leaked fd per failed checkout
+            raise                       # starves the pool under retry loops
         return sock
 
     def _checkin(self, sock):
@@ -252,14 +262,12 @@ class RpcClient:
         try:
             sock.settimeout(self.call_timeout if deadline is None
                             else float(deadline))
-            if _faults.FAULTS.active:
-                _faults.FAULTS.raise_if("rpc.send", op=op)
+            _faults.FAULTS.maybe_fire("rpc.send", op=op)
             try:
                 _send_frame(sock, (op, kw, ctx))
             except OSError as e:
                 raise RpcError(f"rpc send failed ({op}): {e}") from e
-            if _faults.FAULTS.active:
-                _faults.FAULTS.raise_if("rpc.recv", op=op)
+            _faults.FAULTS.maybe_fire("rpc.recv", op=op)
             try:
                 reply = _recv_frame(sock)
             except (OSError, EOFError, pickle.UnpicklingError) as e:

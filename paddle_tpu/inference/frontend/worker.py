@@ -187,7 +187,9 @@ class WorkerServer:
             return rep.cancel(kw["rid"])
         if op == "handoff_audit":
             return self.audit_pages()
-        if op == "ping":
+        # liveness probe for operators and the fleet tests — the gateway
+        # itself never calls it, so CT101 sees no site in paddle_tpu/
+        if op == "ping":  # graftlint: disable=contracts
             return {"name": self.name,
                     "epoch": self.lease.epoch if self.lease else None,
                     "pid": os.getpid()}

@@ -6,8 +6,8 @@ threaded through the serving engine and the control-plane store, driven by
 seeded schedules so every failure path is exercised deterministically (see
 :mod:`.faults`).
 """
-from .faults import (FAULTS, Always, FailNth, FailProb,  # noqa: F401
-                     FaultInjector, InjectedFault, Never, injected)
+from .faults import (FAULTS, KNOWN_POINTS, Always, FailNth,  # noqa: F401
+                     FailProb, FaultInjector, InjectedFault, Never, injected)
 
-__all__ = ["FAULTS", "FaultInjector", "InjectedFault", "FailNth",
-           "FailProb", "Always", "Never", "injected"]
+__all__ = ["FAULTS", "KNOWN_POINTS", "FaultInjector", "InjectedFault",
+           "FailNth", "FailProb", "Always", "Never", "injected"]

@@ -54,9 +54,12 @@ eviction — recompute on the next hit; ctx has ``page``), ``kv.restore``
 written; poison falls back to re-prefill; ctx has ``keys``), and
 ``kv.peer_pull`` (the gateway-driven peer page pull fails before the
 export RPC; poison submits the request cold — recompute; ctx has
-``replica`` and ``holder``).  The registry is
-name-keyed and open: new subsystems add points without touching this
-module.
+``replica`` and ``holder``).  The registry itself stays name-keyed and
+open, but every point production code fires must be listed in
+:data:`KNOWN_POINTS` — graftlint's ``contracts`` pass (CT103) checks that
+each fired string is declared here and that each declared string has
+chaos coverage, so the table is the single source of truth for the
+fault-point protocol.
 """
 from __future__ import annotations
 
@@ -65,7 +68,33 @@ import threading
 from contextlib import contextmanager
 
 __all__ = ["InjectedFault", "FailNth", "FailProb", "Always", "Never",
-           "FaultPoint", "FaultInjector", "FAULTS", "injected"]
+           "FaultPoint", "FaultInjector", "FAULTS", "KNOWN_POINTS",
+           "injected"]
+
+# the declared fault-point protocol: every name production code fires.
+# graftlint CT103 enforces parity both ways (fired => declared here,
+# declared => fired somewhere and armed by an injected(...) chaos test).
+KNOWN_POINTS = frozenset({
+    "serving.page_alloc",
+    "serving.step",
+    "serving.slow_step",
+    "serving.kv_handoff",
+    "store.connect",
+    "frontend.route",
+    "frontend.submit",
+    "frontend.step",
+    "frontend.resume",
+    "journal.append",
+    "journal.fsync",
+    "gateway.recover",
+    "membership.register",
+    "membership.heartbeat",
+    "rpc.send",
+    "rpc.recv",
+    "kv.spill",
+    "kv.restore",
+    "kv.peer_pull",
+})
 
 
 class InjectedFault(RuntimeError):
@@ -201,6 +230,18 @@ class FaultInjector:
         point = self.fire(name, **ctx)
         if point is not None:
             raise InjectedFault(name, transient=point.transient)
+
+    def maybe_fire(self, name, **ctx):
+        """The one-line production probe: :meth:`raise_if` behind the
+        idle-path emptiness check, replacing the
+        ``if FAULTS.active: FAULTS.raise_if(...)`` boilerplate at every
+        fault point.  With nothing installed this is a single dict-emptiness
+        read; armed, it raises :class:`InjectedFault` when ``name`` fires.
+        One call shape also gives graftlint CT103 a single pattern to
+        match for fault-point parity."""
+        if not self._points:  # graftlint: disable=concurrency
+            return
+        self.raise_if(name, **ctx)
 
 
 FAULTS = FaultInjector()

@@ -1,0 +1,21 @@
+"""CT101 bad: op drift on both sides of the worker RPC protocol."""
+from paddle_tpu.inference.frontend.rpc import RpcClient, RpcServer
+
+
+class Worker:
+    def serve(self):
+        self.srv = RpcServer(self._handle)
+        return self.srv
+
+    def _handle(self, op, kw):
+        if op == "submit":
+            return kw["rid"]
+        if op == "audit":                  # CT101 warning: nobody calls it
+            return []
+        raise ValueError(f"unknown worker op {op!r}")
+
+
+def gateway(host, port):
+    client = RpcClient(host, port)
+    client.call("submit", rid=1)
+    return client.call("cancel", rid=1)    # CT101 error: no handler arm

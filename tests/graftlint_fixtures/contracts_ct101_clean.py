@@ -1,0 +1,31 @@
+"""CT101 clean: op parity both ways, including a forwarder-resolved site."""
+from paddle_tpu.inference.frontend.rpc import RpcClient, RpcServer
+
+
+class Worker:
+    def serve(self):
+        self.srv = RpcServer(self._handle)
+        return self.srv
+
+    def _handle(self, op, kw):
+        if op == "submit":
+            return kw["rid"]
+        if op == "cancel":
+            return True
+        raise ValueError(f"unknown worker op {op!r}")
+
+
+class Remote:
+    """The op string flows through a forwarder before hitting the client."""
+
+    def __init__(self, host, port):
+        self.client = RpcClient(host, port)
+
+    def _call(self, op, **kw):
+        return self.client.call(op, **kw)
+
+    def submit(self, rid):
+        return self._call("submit", rid=rid)
+
+    def cancel(self, rid):
+        return self._call("cancel", rid=rid)

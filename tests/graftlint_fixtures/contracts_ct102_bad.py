@@ -1,0 +1,22 @@
+"""CT102 bad: an exception raised under the dispatch closure that cannot
+travel by pickle — its __init__ mangles the constructor args, so the
+default __reduce__ replays cls(*args) with the wrong values."""
+from paddle_tpu.inference.frontend.rpc import RpcServer
+
+
+class QuotaError(RuntimeError):
+    def __init__(self, limit, used):
+        super().__init__(f"quota exceeded: {used}/{limit}")   # not verbatim
+        self.limit = limit
+        self.used = used
+
+
+class Worker:
+    def serve(self):
+        self.srv = RpcServer(self._handle)
+        return self.srv
+
+    def _handle(self, op, kw):
+        if op == "reserve":
+            raise QuotaError(8, kw["n"])
+        raise ValueError(f"unknown worker op {op!r}")

@@ -1,0 +1,38 @@
+"""CT102 clean: both pickle-safe shapes — a verbatim-forwarding __init__
+and an explicit __reduce__."""
+from paddle_tpu.inference.frontend.rpc import RpcServer
+
+
+class QuotaError(RuntimeError):
+    def __init__(self, limit, used):
+        super().__init__(limit, used)      # verbatim: default reduce works
+        self.limit = limit
+        self.used = used
+
+
+class LeaseGone(RuntimeError):
+    def __init__(self, epoch):
+        super().__init__(f"lease lost at epoch {epoch}")
+        self.epoch = epoch
+
+    def __reduce__(self):
+        return (LeaseGone, (self.epoch,))
+
+
+class Bare(RuntimeError):
+    """No __init__ at all: BaseException stores args verbatim."""
+
+
+class Worker:
+    def serve(self):
+        self.srv = RpcServer(self._handle)
+        return self.srv
+
+    def _handle(self, op, kw):
+        if op == "reserve":
+            raise QuotaError(8, kw["n"])
+        if op == "renew":
+            raise LeaseGone(kw["epoch"])
+        if op == "probe":
+            raise Bare("nope")
+        raise ValueError(f"unknown worker op {op!r}")

@@ -1,0 +1,21 @@
+"""CT103 bad: fault-point protocol drift (lint together with
+contracts_ct103_decl.py, which declares KNOWN_POINTS)."""
+from paddle_tpu.testing.faults import FAULTS, FailNth, injected
+
+
+def step(rid):
+    FAULTS.maybe_fire("engine.step", rid=rid)
+
+
+def flush():
+    FAULTS.raise_if("engine.flush")
+
+
+def rollout(point):
+    FAULTS.fire(point)                     # CT103 warning: non-literal name
+    FAULTS.maybe_fire("engine.stray")      # CT103 error: not in KNOWN_POINTS
+
+
+def chaos_test():
+    with injected("engine.step", FailNth(1)):
+        step(1)

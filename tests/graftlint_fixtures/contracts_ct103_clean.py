@@ -1,0 +1,19 @@
+"""CT103 clean: full fault-point parity (lint together with
+contracts_ct103_decl_ok.py) — every fired point is declared, every declared
+point is fired and armed by an injected(...) chaos test."""
+from paddle_tpu.testing.faults import FAULTS, FailNth, injected
+
+
+def step(rid):
+    FAULTS.maybe_fire("engine.step", rid=rid)
+
+
+def flush():
+    FAULTS.raise_if("engine.flush")
+
+
+def chaos_test():
+    with injected("engine.step", FailNth(1)):
+        step(1)
+    with injected("engine.flush", FailNth(1)):
+        flush()

@@ -1,0 +1,8 @@
+"""Support file for the CT103 bad fixture: the declaring module.  Lint it
+TOGETHER with contracts_ct103_bad.py — 'engine.flush' is fired there but
+never armed, and 'engine.retire' is never fired at all."""
+KNOWN_POINTS = frozenset({
+    "engine.step",        # fired and chaos-covered: clean
+    "engine.flush",       # CT103 warning: no injected(...) coverage
+    "engine.retire",      # CT103 warning: never fired — dead chaos surface
+})

@@ -1,0 +1,6 @@
+"""Support file for the CT103 clean fixture: every declared point is fired
+and chaos-covered in contracts_ct103_clean.py."""
+KNOWN_POINTS = frozenset({
+    "engine.step",
+    "engine.flush",
+})

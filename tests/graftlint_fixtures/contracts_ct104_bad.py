@@ -1,0 +1,10 @@
+"""CT104 bad: metric-family indiscipline — invalid name, computed name,
+and a cross-declaration type conflict."""
+from paddle_tpu.observability import REGISTRY
+
+
+def setup(shard):
+    REGISTRY.counter("fleet requests")              # CT104: invalid name
+    REGISTRY.counter(f"fleet_{shard}_total")        # CT104: non-literal
+    REGISTRY.counter("fleet_steps_total")
+    REGISTRY.gauge("fleet_steps_total")             # CT104: type conflict

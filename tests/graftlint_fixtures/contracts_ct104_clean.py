@@ -1,0 +1,13 @@
+"""CT104 clean: literal valid names, one type per family, cardinality in
+labels instead of the name."""
+from paddle_tpu.observability import REGISTRY
+
+REQS = REGISTRY.counter("fleet_requests_total", "requests by op",
+                        labelnames=("op",))
+INFLIGHT = REGISTRY.gauge("fleet_inflight", "in-flight requests")
+STEP_S = REGISTRY.histogram("fleet_step_seconds", "step latency")
+
+
+def observe(op, dur):
+    REQS.inc(op=op)
+    STEP_S.observe(dur)

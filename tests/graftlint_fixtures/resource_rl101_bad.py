@@ -1,0 +1,19 @@
+"""RL101 bad: sockets acquired, then calls that can raise before any close
+is guaranteed — including the unconditional constructor leak."""
+import socket
+
+
+def connect(host, port):
+    sock = socket.create_connection((host, port), timeout=5)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)   # may raise
+    return sock
+
+
+class Server:
+    def __init__(self, host, port):
+        self._srv = socket.socket()
+        self._srv.bind((host, port))    # raises -> caller has nothing to close
+        self._srv.listen(8)
+
+    def close(self):
+        self._srv.close()

@@ -1,0 +1,40 @@
+"""RL101 clean: every acquire is guarded — closing except, with-block,
+daemon thread, joined thread."""
+import socket
+import threading
+
+
+def connect(host, port):
+    sock = socket.create_connection((host, port), timeout=5)
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:
+        sock.close()
+        raise
+    return sock
+
+
+class Server:
+    def __init__(self, host, port):
+        self._srv = socket.socket()
+        try:
+            self._srv.bind((host, port))
+            self._srv.listen(8)
+        except OSError:
+            self._srv.close()
+            raise
+
+    def close(self):
+        self._srv.close()
+
+
+def snapshot(path):
+    with open(path) as f:
+        return f.read()
+
+
+def run_workers(fn):
+    threading.Thread(target=fn, daemon=True).start()
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()

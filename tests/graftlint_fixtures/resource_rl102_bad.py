@@ -1,0 +1,15 @@
+"""RL102 bad: a PagePool ref separated from its unref by a call that can
+raise, with no except/finally rollback — the static shadow of
+audit_refcounts."""
+
+
+class Engine:
+    def __init__(self, pool, runner):
+        self.pool = pool
+        self.runner = runner
+
+    def splice(self, blk, key):
+        p = self.pool.alloc_page()
+        self.runner.restore_pages([p], [blk])   # raises -> ref strands
+        self.pool.register(p, key)
+        self.pool.unref_page(p)

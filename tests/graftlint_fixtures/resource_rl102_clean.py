@@ -1,0 +1,21 @@
+"""RL102 clean: the risky write is guarded by a rollback try, and a ref
+returned to the caller transfers ownership."""
+
+
+class Engine:
+    def __init__(self, pool, runner):
+        self.pool = pool
+        self.runner = runner
+
+    def splice(self, blk, key):
+        p = self.pool.alloc_page()
+        try:
+            self.runner.restore_pages([p], [blk])
+        except Exception:
+            self.pool.unref_page(p)     # unwritten page frees cleanly
+            raise
+        self.pool.register(p, key)
+        self.pool.unref_page(p)
+
+    def claim(self):
+        return self.pool.alloc_page()   # caller owns the ref

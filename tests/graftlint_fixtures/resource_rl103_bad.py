@@ -1,0 +1,12 @@
+"""RL103 bad: a membership lease registered in the constructor but no
+release()/evict() reachable from any shutdown method — the fleet keeps
+routing to the corpse until TTL expiry."""
+
+
+class Worker:
+    def __init__(self, membership, group, name):
+        self.lease = membership.register(group, name)
+        self.closed = False
+
+    def close(self):
+        self.closed = True

@@ -1,0 +1,15 @@
+"""RL103 clean: the lease release is reachable from close() through an
+intra-class call."""
+
+
+class Worker:
+    def __init__(self, membership, group, name):
+        self.lease = membership.register(group, name)
+        self.closed = False
+
+    def close(self):
+        self._leave()
+        self.closed = True
+
+    def _leave(self):
+        self.lease.release()

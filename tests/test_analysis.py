@@ -780,6 +780,224 @@ def test_concurrency_pragma_and_baseline(tmp_path):
     assert absorbed.findings == [] and absorbed.baselined == 1
 
 
+# ---------------------------------------- contracts (summary-scope) fixtures
+
+def _ct(paths):
+    return run([str(p) for p in paths], select=["contracts"])
+
+
+def _rl(paths):
+    return run([str(p) for p in paths], select=["resource_lifecycle"])
+
+
+def test_contracts_ct101_bad_fixture():
+    res = _ct([FIXTURES / "contracts_ct101_bad.py"])
+    assert _codes(res) == {"CT101"}
+    sev = {f.severity for f in res.findings}
+    assert sev == {"error", "warning"}      # unhandled op + dead arm
+    msgs = "\n".join(f.message for f in res.findings)
+    assert "'cancel' has no registered server handler" in msgs
+    assert "'audit' has no call site anywhere" in msgs
+
+
+def test_contracts_ct101_clean_fixture():
+    # parity both ways, with one op site resolved through a forwarder
+    # method (Remote._call) and a client bound to a plain local name
+    res = _ct([FIXTURES / "contracts_ct101_clean.py"])
+    assert res.findings == []
+
+
+def test_contracts_ct102_bad_fixture():
+    res = _ct([FIXTURES / "contracts_ct102_bad.py"])
+    assert _codes(res) == {"CT102"}
+    assert "QuotaError" in res.findings[0].message
+    assert res.findings[0].severity == "warning"
+
+
+def test_contracts_ct102_clean_fixture():
+    # verbatim-forwarding __init__, explicit __reduce__, and no __init__
+    # at all are the three pickle-safe shapes
+    res = _ct([FIXTURES / "contracts_ct102_clean.py"])
+    assert res.findings == []
+
+
+def test_contracts_ct103_bad_fixture():
+    res = _ct([FIXTURES / "contracts_ct103_bad.py",
+               FIXTURES / "contracts_ct103_decl.py"])
+    assert _codes(res) == {"CT103"}
+    msgs = "\n".join(f.message for f in res.findings)
+    assert "'engine.stray' is fired but not declared" in msgs
+    assert "non-literal point name" in msgs
+    assert "'engine.retire' is never fired" in msgs
+    assert "'engine.flush' has no injected(...) chaos coverage" in msgs
+    errors = [f for f in res.findings if f.severity == "error"]
+    assert len(errors) == 1                 # only the undeclared fire
+
+
+def test_contracts_ct103_clean_fixture():
+    res = _ct([FIXTURES / "contracts_ct103_clean.py",
+               FIXTURES / "contracts_ct103_decl_ok.py"])
+    assert res.findings == []
+
+
+def test_contracts_ct103_self_armed_adhoc_point_ok(tmp_path):
+    # a file that both arms a point (injected/install) and fires it is the
+    # injector's own unit test — no parity error even with a KNOWN_POINTS
+    # table elsewhere in the project
+    adhoc = """
+        from paddle_tpu.testing.faults import FAULTS, FailNth, injected
+
+        def test_probe():
+            with injected("p", FailNth(1)):
+                FAULTS.fire("p", rid=1)
+    """
+    decl = 'KNOWN_POINTS = frozenset({"engine.step"})\n'
+    a = tmp_path / "test_adhoc.py"
+    a.write_text(textwrap.dedent(adhoc))
+    d = tmp_path / "decl.py"
+    d.write_text(decl)
+    res = run([str(a), str(d)], select=["contracts"])
+    assert not [f for f in res.findings if f.severity == "error"]
+
+
+def test_contracts_ct104_bad_fixture():
+    res = _ct([FIXTURES / "contracts_ct104_bad.py"])
+    assert _codes(res) == {"CT104"}
+    msgs = "\n".join(f.message for f in res.findings)
+    assert "not a valid Prometheus name" in msgs
+    assert "non-literal name" in msgs
+    assert "redeclared as gauge but first declared as counter" in msgs
+
+
+def test_contracts_ct104_clean_fixture():
+    res = _ct([FIXTURES / "contracts_ct104_clean.py"])
+    assert res.findings == []
+
+
+# ------------------------------------------------- resource_lifecycle fixtures
+
+def test_resource_rl101_bad_fixture():
+    res = _rl([FIXTURES / "resource_rl101_bad.py"])
+    assert _codes(res) == {"RL101"}
+    msgs = "\n".join(f.message for f in res.findings)
+    assert "socket 'sock' can leak" in msgs
+    assert "constructor raises after acquiring" in msgs
+
+
+def test_resource_rl101_clean_fixture():
+    # closing except, guarded ctor, with-block, daemon thread, joined thread
+    res = _rl([FIXTURES / "resource_rl101_clean.py"])
+    assert res.findings == []
+
+
+def test_resource_rl102_bad_fixture():
+    res = _rl([FIXTURES / "resource_rl102_bad.py"])
+    assert _codes(res) == {"RL102"}
+    assert "alloc_page() ref can strand" in res.findings[0].message
+
+
+def test_resource_rl102_clean_fixture():
+    # rollback-guarded risky call and ownership transfer via return
+    res = _rl([FIXTURES / "resource_rl102_clean.py"])
+    assert res.findings == []
+
+
+def test_resource_rl103_bad_fixture():
+    res = _rl([FIXTURES / "resource_rl103_bad.py"])
+    assert _codes(res) == {"RL103"}
+    assert "membership lease 'self.lease'" in res.findings[0].message
+
+
+def test_resource_rl103_clean_fixture():
+    # release reachable from close() through an intra-class call
+    res = _rl([FIXTURES / "resource_rl103_clean.py"])
+    assert res.findings == []
+
+
+def test_resource_lifecycle_skips_test_files(tmp_path):
+    tdir = tmp_path / "tests"
+    tdir.mkdir()
+    leaky = (FIXTURES / "resource_rl101_bad.py").read_text()
+    p = tdir / "test_sockets.py"
+    p.write_text(leaky)
+    res = run([str(p)], select=["resource_lifecycle"])
+    assert res.findings == []
+
+
+# ------------------------------------- summary cache: cross-file invalidation
+
+CT_CLIENT = """
+    from paddle_tpu.inference.frontend.rpc import RpcClient
+
+
+    def gateway(host, port):
+        client = RpcClient(host, port)
+        return client.call("resume", rid=1)
+"""
+
+CT_WORKER = """
+    from paddle_tpu.inference.frontend.rpc import RpcServer
+
+
+    class Worker:
+        def serve(self):
+            self.srv = RpcServer(self._handle)
+            return self.srv
+
+        def _handle(self, op, kw):
+            if op == "submit":
+                return kw["rid"]
+            raise ValueError(f"unknown worker op {op!r}")
+"""
+
+
+def test_summary_cache_cross_file_invalidation(tmp_path):
+    """Editing the dispatcher must re-lint the (unchanged) client file —
+    the whole point of the per-domain digest deps, proven WITHOUT
+    --no-cache."""
+    client = tmp_path / "client.py"
+    worker = tmp_path / "worker.py"
+    client.write_text(textwrap.dedent(CT_CLIENT))
+    worker.write_text(textwrap.dedent(CT_WORKER))
+    cpath = str(tmp_path / "cache.json")
+    r1 = run([str(client), str(worker)], select=["contracts"],
+             cache=FileCache(cpath))
+    errs = [f for f in r1.findings if f.severity == "error"]
+    assert len(errs) == 1 and "'resume'" in errs[0].message
+    assert errs[0].path == str(client)
+    # add the missing arm to worker.py ONLY; client.py is byte-identical
+    worker.write_text(textwrap.dedent(CT_WORKER).replace(
+        'if op == "submit":', 'if op in ("submit", "resume"):'))
+    r2 = run([str(client), str(worker)], select=["contracts"],
+             cache=FileCache(cpath))
+    assert not [f for f in r2.findings if f.severity == "error"]
+    assert r2.cache_hits == 0            # rpc digest changed: both re-lint
+    # replay: nothing changed, both files served from cache
+    r3 = run([str(client), str(worker)], select=["contracts"],
+             cache=FileCache(cpath))
+    assert r3.cache_hits == 2
+    assert [f.to_dict() for f in r3.findings] == \
+           [f.to_dict() for f in r2.findings]
+
+
+def test_summary_cache_unrelated_edit_replays(tmp_path):
+    """Editing a file with no rpc/fault/metric facts must NOT re-lint the
+    others: only its own entry invalidates."""
+    client = tmp_path / "client.py"
+    worker = tmp_path / "worker.py"
+    other = tmp_path / "mathutil.py"
+    client.write_text(textwrap.dedent(CT_CLIENT))
+    worker.write_text(textwrap.dedent(CT_WORKER))
+    other.write_text("def double(x):\n    return 2 * x\n")
+    cpath = str(tmp_path / "cache.json")
+    run([str(client), str(worker), str(other)], select=["contracts"],
+        cache=FileCache(cpath))
+    other.write_text("def double(x):\n    return x + x\n")
+    r2 = run([str(client), str(worker), str(other)], select=["contracts"],
+             cache=FileCache(cpath))
+    assert r2.cache_hits == 2            # client+worker replay, other re-lints
+
+
 def test_cli_version_lists_rule_ids(capsys):
     assert cli.main(["--version"]) == 0
     out = capsys.readouterr().out
@@ -942,7 +1160,7 @@ def test_builtin_passes_registered():
     assert {"trace-safety", "registry-parity", "namespace-parity",
             "jit-cache-hygiene", "no-adhoc-telemetry",
             "sharding-spec-coverage", "dtype-rules", "robustness",
-            "concurrency"} <= set(PASSES)
+            "concurrency", "contracts", "resource_lifecycle"} <= set(PASSES)
 
 
 def test_unknown_pass_rejected(tmp_path):
@@ -983,6 +1201,33 @@ def test_cli_list_passes(capsys):
     out = capsys.readouterr().out
     assert "trace-safety" in out and "registry-parity" in out
     assert "sharding-spec-coverage" in out and "dtype-rules" in out
+    assert "contracts" in out and "resource_lifecycle" in out
+    assert "[summary]" in out            # summary-scope passes are tagged
+    assert "CT101 CT102 CT103 CT104" in out
+    assert "RL101 RL102 RL103" in out
+
+
+def test_cli_explain_rule(capsys):
+    assert cli.main(["--explain", "ct101"]) == 0    # case-insensitive
+    out = capsys.readouterr().out
+    assert "CT101 [contracts v" in out
+    assert "severity:" in out
+    assert "RPC op parity" in out
+    # the committed fixture pair renders as the example
+    assert "bad example" in out and "contracts_ct101_bad.py" in out
+    assert "clean example" in out and "contracts_ct101_clean.py" in out
+
+
+def test_cli_explain_every_declared_code(capsys):
+    for p in PASSES.values():
+        for code in p.codes:
+            assert cli.main(["--explain", code]) == 0
+    capsys.readouterr()
+
+
+def test_cli_explain_unknown_code(capsys):
+    assert cli.main(["--explain", "XX999"]) == 2
+    assert "unknown rule code" in capsys.readouterr().err
 
 
 def test_cli_sarif_output_valid(capsys, monkeypatch):
@@ -1037,6 +1282,32 @@ def test_repo_tree_is_clean(tmp_path):
     assert {"sharding-spec-coverage", "dtype-rules"} <= set(res.passes)
     assert not res.findings, "\n" + "\n".join(
         f.render() for f in res.findings)
+
+
+def test_repo_cross_process_contracts_clean(tmp_path):
+    """PR-20 gate: the contracts and resource-lifecycle passes must run
+    clean — warnings included — over the package AND the top-level test
+    files, because CT101/CT103 need both halves of each protocol (op sites
+    and dispatcher arms, fault fires and injected(...) coverage) in view.
+    Fixture files stay out: tests/*.py does not recurse."""
+    paths = [str(REPO / "paddle_tpu")] + sorted(
+        str(p) for p in (REPO / "tests").glob("*.py"))
+    res = run(paths, select=["contracts", "resource_lifecycle"],
+              cache=FileCache(str(tmp_path / "cache.json")))
+    assert res.files > 200
+    assert not res.findings, "\n" + "\n".join(
+        f.render() for f in res.findings)
+    # CT103's decl-side checks actually engaged: the declared table is
+    # non-empty and chaos coverage exists in the analyzed tree
+    from paddle_tpu.analysis.summaries import SummaryIndex
+    from paddle_tpu.analysis.framework import (Project, SourceFile,
+                                               iter_python_files)
+    idx = SummaryIndex(Project(
+        [SourceFile(p) for p in iter_python_files(paths)]))
+    assert len(idx.declared_points) >= 19
+    assert idx.declared_points <= idx.fault_coverage, (
+        "declared fault points without injected(...) coverage: "
+        f"{sorted(idx.declared_points - idx.fault_coverage)}")
 
 
 # ------------------------------------------- engine package layering guard

@@ -66,6 +66,20 @@ class TestFaultHarness:
         with injected("q", Never()):
             FAULTS.raise_if("q")            # never fires
 
+    def test_maybe_fire_is_raise_if_behind_idle_check(self):
+        # the one-line production probe: inert with nothing installed,
+        # raises when its point fires, and keeps ctx matching intact
+        FAULTS.maybe_fire("p", rid=1)       # nothing armed: no-op
+        with injected("p", Always(), transient=True):
+            with pytest.raises(InjectedFault) as ei:
+                FAULTS.maybe_fire("p", rid=1)
+            assert ei.value.transient and ei.value.point == "p"
+        with injected("p", Always(), match=lambda c: c.get("rid") == 9):
+            FAULTS.maybe_fire("p", rid=1)   # context mismatch: no fire
+            with pytest.raises(InjectedFault):
+                FAULTS.maybe_fire("p", rid=9)
+        assert not FAULTS.active
+
     def test_injected_removes_only_its_point(self):
         FAULTS.install("keep", Always())
         with injected("scoped", Always()):
